@@ -1,0 +1,55 @@
+"""distributed_gol_tpu — a TPU-native distributed Game of Life framework.
+
+A brand-new JAX / XLA / Pallas / pjit framework with the capabilities of the
+reference system ``Oliver-Cairns/distributed-gol`` (a Go controller + broker +
+4 worker servers over ``net/rpc``; see ``SURVEY.md``).  Instead of round-
+tripping the full board over TCP every generation (reference
+``gol/distributor.go:48-66``, ``broker/broker.go:37-56``), the board lives on
+device as a ``jnp.uint8`` array; the per-generation update is a 9-point
+stencil inside one jitted SPMD program, sharded over a ``jax.sharding.Mesh``
+with ``lax.ppermute`` halo exchange and on-device alive counts.
+
+Public API (mirrors the reference's ``gol`` package surface,
+``gol/gol.go:6-14`` and ``gol/event.go:9-68``):
+
+- :class:`Params` — run configuration (``gol/gol.go:6-11``).
+- :func:`run` — the engine façade, equivalent of ``gol.Run``
+  (``gol/gol.go:14``): drives a whole simulation, emitting events.
+- Event types: :class:`AliveCellsCount`, :class:`ImageOutputComplete`,
+  :class:`StateChange`, :class:`CellFlipped`, :class:`CellsFlipped`,
+  :class:`TurnComplete`, :class:`FinalTurnComplete` and the :class:`State`
+  enum (``gol/event.go:19-68``).
+- :class:`Cell` — an (x, y) coordinate (``util/cell.go:4-6``).
+"""
+
+from distributed_gol_tpu.utils.cell import Cell
+from distributed_gol_tpu.engine.params import Params
+from distributed_gol_tpu.engine.events import (
+    AliveCellsCount,
+    CellFlipped,
+    CellsFlipped,
+    Event,
+    FinalTurnComplete,
+    ImageOutputComplete,
+    State,
+    StateChange,
+    TurnComplete,
+)
+from distributed_gol_tpu.engine.gol import run
+
+__all__ = [
+    "AliveCellsCount",
+    "Cell",
+    "CellFlipped",
+    "CellsFlipped",
+    "Event",
+    "FinalTurnComplete",
+    "ImageOutputComplete",
+    "Params",
+    "State",
+    "StateChange",
+    "TurnComplete",
+    "run",
+]
+
+__version__ = "0.1.0"

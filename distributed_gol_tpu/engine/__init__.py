@@ -1,0 +1,5 @@
+"""Host runtime: configuration, events, IO, and the run controller.
+
+Equivalent of the reference's controller-side layers L5-L3 (``gol/gol.go``,
+``gol/event.go``, ``gol/io.go``, ``gol/distributor.go``) — but the data
+plane below it is a device-resident board instead of an RPC broker."""

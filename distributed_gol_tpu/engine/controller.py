@@ -1,0 +1,259 @@
+"""The run controller: orchestration of a whole simulation.
+
+Equivalent of the reference's distributor + its satellite goroutines
+(``gol/distributor.go``): load (or resume) a board, drive generations,
+emit the event stream, honour s/p/q/k keypresses, snapshot PGMs, and shut
+down cleanly.  Differences by design (SURVEY.md §7):
+
+- The per-turn RPC round-trip (``gol/distributor.go:48-66``) becomes a
+  device superstep: N generations per dispatch, per-turn alive counts
+  returned as one vector computed on device.
+- ``CellFlipped`` emission is a *view concern*: exact per-cell flips are
+  produced (from an on-device XOR mask) when a viewer needs them
+  (superstep == 1); headless runs skip them and keep only the exact
+  TurnComplete/count telemetry — the property the reference's own SDL test
+  actually checks per turn is the count (``sdl_test.go:107-116``).
+- Keypresses are honoured at superstep granularity with exact turn numbers.
+
+Threading model: the controller runs in the caller's thread (like
+``distributor`` runs in ``gol.Run``'s goroutine); the only helper thread is
+the 2-second alive-count ticker (``gol/distributor.go:168-191``).  Events go
+to a ``queue.Queue``; the stream ends with a ``None`` sentinel (the
+reference's ``close(events)``, ``gol/distributor.go:262``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from distributed_gol_tpu.engine import pgm
+from distributed_gol_tpu.engine.backend import Backend
+from distributed_gol_tpu.engine.events import (
+    AliveCellsCount,
+    CellFlipped,
+    CellsFlipped,
+    FinalTurnComplete,
+    ImageOutputComplete,
+    State,
+    StateChange,
+    TurnComplete,
+)
+from distributed_gol_tpu.engine.params import Params
+from distributed_gol_tpu.engine.session import Session, default_session
+from distributed_gol_tpu.utils.cell import Cell, alive_cells_from_board
+
+
+class _TickerState:
+    """(turn, count) pair shared with the ticker thread; always a consistent
+    pair (unlike the reference's one-behind latch, quirk Q7)."""
+
+    def __init__(self, turn: int, count: int):
+        self._lock = threading.Lock()
+        self._turn = turn
+        self._count = count
+
+    def set(self, turn: int, count: int):
+        with self._lock:
+            self._turn, self._count = turn, count
+
+    def get(self) -> tuple[int, int]:
+        with self._lock:
+            return self._turn, self._count
+
+
+class _Ticker(threading.Thread):
+    """Emits AliveCellsCount every ``period`` seconds
+    (``gol/distributor.go:228``: 2000 ms ticker), including while paused."""
+
+    def __init__(self, period: float, events: queue.Queue, state: _TickerState):
+        super().__init__(name="gol-alive-ticker", daemon=True)
+        self._period = period
+        self._events = events
+        self._state = state
+        # NB: not named _stop — threading.Thread uses that attribute name
+        # internally and shadowing it breaks Thread.join().
+        self._stop_evt = threading.Event()
+
+    def run(self):
+        while not self._stop_evt.wait(self._period):
+            turn, count = self._state.get()
+            self._events.put(AliveCellsCount(turn, count))
+
+    def stop(self):
+        self._stop_evt.set()
+
+
+class Controller:
+    def __init__(
+        self,
+        params: Params,
+        events: queue.Queue,
+        key_presses: Optional[queue.Queue] = None,
+        session: Optional[Session] = None,
+        backend: Optional[Backend] = None,
+    ):
+        self.params = params
+        self.events = events
+        self.key_presses = key_presses
+        self.session = session if session is not None else default_session()
+        self.backend = backend if backend is not None else Backend(params)
+        # "completed" | "detached" ('q') | "killed" ('k')
+        self._outcome = "completed"
+        self._paused = False
+
+    # -- event helpers ---------------------------------------------------------
+    def _emit(self, event):
+        self.events.put(event)
+
+    def _emit_flips(self, turn: int, coords: np.ndarray):
+        """coords: (n, 2) array of (y, x).  Per-cell events preserve the
+        reference contract (``gol/event.go:48-58``); the batch form is the
+        cheap framework extension."""
+        if self.params.flip_events == "batch":
+            self._emit(
+                CellsFlipped(turn, tuple(Cell(int(x), int(y)) for y, x in coords))
+            )
+        else:
+            for y, x in coords:
+                self._emit(CellFlipped(turn, Cell(int(x), int(y))))
+
+    # -- keypresses (gol/distributor.go:105-151) -------------------------------
+    def _snapshot(self, board, turn: int):
+        name = self.params.snapshot_name(turn)
+        pgm.write_pgm(self.params.out_dir / f"{name}.pgm", self.backend.fetch(board))
+        self._emit(ImageOutputComplete(turn, name))
+
+    def _handle_key(self, key: str, board, turn: int):
+        if key == "s":
+            self._snapshot(board, turn)
+        elif key == "p":
+            self._paused = not self._paused
+            self.session.pause(self._paused)
+            self._emit(
+                StateChange(turn, State.PAUSED if self._paused else State.EXECUTING)
+            )
+        elif key == "q":
+            # Detach: park the checkpoint on the session; a new controller
+            # resumes it (gol/distributor.go:139-147, broker/broker.go:143-148).
+            self._emit(StateChange(turn, State.QUITTING))
+            self.session.pause(True, world=self.backend.fetch(board), turn=turn)
+            self._outcome = "detached"
+        elif key == "k":
+            # Kill the whole system (gol/distributor.go:121-128).
+            self._snapshot(board, turn)
+            self._emit(StateChange(turn, State.QUITTING))
+            self.session.quit()
+            self._outcome = "killed"
+
+    def _poll_keys(self, board, turn: int):
+        """Drain pending keys; while paused, block here (stepping stops, the
+        ticker keeps ticking) until resumed or quit."""
+        if self.key_presses is None:
+            return
+        while True:
+            try:
+                key = self.key_presses.get(block=self._paused, timeout=0.05)
+            except queue.Empty:
+                if not self._paused:
+                    return
+                continue
+            self._handle_key(key, board, turn)
+            if self._outcome != "completed":
+                return
+            if not self._paused and self.key_presses.empty():
+                return
+
+    # -- the run (distributor, gol/distributor.go:194-262) ---------------------
+    def run(self):
+        """Drive the whole run; the event stream is always terminated with
+        the ``None`` sentinel, even on error — a viewer blocked on the queue
+        must never hang because the engine died (the reference relies on
+        ``close(events)`` for the same guarantee, ``gol/distributor.go:262``)."""
+        try:
+            self._run()
+        except BaseException:
+            self.events.put(None)
+            raise
+
+    def _run(self):
+        p = self.params
+        board_np, start_turn = self._initial_world()
+
+        viewer_wants_flips = p.flip_events in ("cell", "batch") or (
+            p.flip_events == "auto" and not p.no_vis
+        )
+        superstep = 1 if viewer_wants_flips else p.effective_superstep(False)
+
+        # Initial flips: one per alive cell of the *actual* starting world
+        # (the reference emits them from the freshly loaded PGM even when it
+        # then resumes from a checkpoint, desyncing viewers; deliberate fix).
+        if viewer_wants_flips:
+            ys, xs = np.nonzero(board_np)
+            self._emit_flips(start_turn, np.stack([ys, xs], axis=1))
+
+        board = self.backend.put(board_np)
+        turn = start_turn
+        state = _TickerState(turn, int(np.count_nonzero(board_np)))
+        ticker = _Ticker(p.ticker_period, self.events, state)
+        ticker.start()
+        try:
+            while turn < p.turns:
+                self._poll_keys(board, turn)
+                if self._outcome != "completed":
+                    break
+                k = min(superstep, p.turns - turn)  # superstep is 1 for viewers
+                if viewer_wants_flips:
+                    board, count, coords = self.backend.run_turn_with_flips(board)
+                    turn += 1
+                    state.set(turn, count)
+                    self._emit_flips(turn, coords)
+                    self._emit(TurnComplete(turn))
+                else:
+                    board, counts = self.backend.run_turns(board, k)
+                    for i in range(k):
+                        self._emit(TurnComplete(turn + i + 1))
+                    turn += k
+                    state.set(turn, int(counts[-1]))
+        finally:
+            ticker.stop()
+            ticker.join()
+
+        self._finalize(board, turn)
+
+    def _initial_world(self) -> tuple[np.ndarray, int]:
+        p = self.params
+        # Resume negotiation (makeCall, gol/distributor.go:69-91): with
+        # turns == 0 the reference skips the broker entirely; otherwise
+        # resume iff a paused same-size checkpoint exists.
+        if p.turns > 0:
+            ckpt = self.session.check_states(p.image_width, p.image_height)
+            if ckpt is not None:
+                return ckpt.world, ckpt.turn
+        board_np = pgm.read_pgm(p.input_path)
+        if board_np.shape != (p.image_height, p.image_width):
+            raise ValueError(
+                f"{p.input_path} is {board_np.shape[1]}x{board_np.shape[0]}, "
+                f"params want {p.image_width}x{p.image_height}"
+            )  # gol/io.go:105-112 panics on mismatch
+        return board_np, 0
+
+    def _finalize(self, board, turn: int):
+        p = self.params
+        if self._outcome == "completed":
+            final_np = self.backend.fetch(board)
+            # FinalTurnComplete carries the true turn count (quirk Q1 fixed)
+            # and the alive-cell list tests consume (gol_test.go:33-41).
+            self._emit(FinalTurnComplete(turn, tuple(alive_cells_from_board(final_np))))
+            # Final PGM write, no ImageOutputComplete for it — matching the
+            # reference (gol/distributor.go:246-253 emits no event).
+            pgm.write_pgm(p.out_dir / f"{p.final_output_name}.pgm", final_np)
+            self._emit(StateChange(turn, State.QUITTING))
+        else:
+            # Detach/kill paths still emit a FinalTurnComplete with an empty
+            # alive list so viewers exit (quirk Q2 semantics, true turn).
+            self._emit(FinalTurnComplete(turn, ()))
+        self.events.put(None)  # stream end: the close(events) analog

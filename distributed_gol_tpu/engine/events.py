@@ -1,0 +1,123 @@
+"""The typed event stream — the framework's observability contract.
+
+Reference: ``gol/event.go``.  The event channel IS the observability system
+(SURVEY.md §5): six event types flow from the engine to whoever is watching
+(SDL window, tests, headless drain).  Ordering contract (``gol/event.go:55-58``,
+enforced by ``sdl_test.go``): every ``CellFlipped`` for a turn is delivered
+before that turn's ``TurnComplete``.
+
+Python mapping: events are frozen dataclasses on a ``queue.Queue``; the
+channel-close that ends the reference's event stream (``gol/distributor.go:262``)
+becomes a ``None`` sentinel posted by the engine.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Sequence, Union
+
+from distributed_gol_tpu.utils.cell import Cell
+
+
+class State(enum.Enum):
+    """Execution states announced via StateChange (``gol/event.go:34-45``)."""
+
+    PAUSED = "Paused"
+    EXECUTING = "Executing"
+    QUITTING = "Quitting"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+@dataclass(frozen=True)
+class Event:
+    """Base event: everything carries the number of completed turns
+    (``gol/event.go:9-15``: the Event interface = Stringer +
+    GetCompletedTurns)."""
+
+    completed_turns: int
+
+    def __str__(self) -> str:  # non-empty => the viewer loop prints it
+        return ""
+
+
+@dataclass(frozen=True)
+class AliveCellsCount(Event):
+    """Emitted every 2 seconds (``gol/event.go:17-19``,
+    ``gol/distributor.go:178-179``).  Unlike the reference (quirk Q7: count
+    latched one event behind), ``cells_count`` here is exactly the alive
+    count at ``completed_turns``."""
+
+    cells_count: int = 0
+
+    def __str__(self) -> str:
+        return f"Alive Cells {self.cells_count}"
+
+
+@dataclass(frozen=True)
+class ImageOutputComplete(Event):
+    """A PGM snapshot hit the filesystem (``gol/event.go:22-26``)."""
+
+    filename: str = ""
+
+    def __str__(self) -> str:
+        return f"File {self.filename} output complete"
+
+
+@dataclass(frozen=True)
+class StateChange(Event):
+    """Pause/resume/quit announcements (``gol/event.go:29-45``)."""
+
+    new_state: State = State.EXECUTING
+
+    def __str__(self) -> str:
+        return f"State change to {self.new_state}"
+
+
+@dataclass(frozen=True)
+class CellFlipped(Event):
+    """One cell changed value this turn (``gol/event.go:48-50``).  All flips
+    for a turn precede its TurnComplete."""
+
+    cell: Cell = Cell(0, 0)
+
+
+@dataclass(frozen=True)
+class CellsFlipped(Event):
+    """Batch form of CellFlipped (framework extension): every changed cell of
+    one turn in a single event.  Viewers that understand it avoid a Python
+    object per cell; the engine can emit either form (see
+    ``Controller._emit_flips``).  Not part of the reference contract."""
+
+    cells: Sequence[Cell] = field(default_factory=tuple)
+
+
+@dataclass(frozen=True)
+class TurnComplete(Event):
+    """A full generation finished; a viewer may render (``gol/event.go:53-58``)."""
+
+
+@dataclass(frozen=True)
+class FinalTurnComplete(Event):
+    """The run is over; carries the final alive-cell list, consumed directly
+    by tests (``gol/event.go:61-65``, ``gol_test.go:33-41``).
+
+    Quirk decisions (SURVEY.md appendix Q1/Q2): ``completed_turns`` is the
+    TRUE number of completed turns (the reference always reported 0); a
+    controller-detach ('q') still emits this event with ``alive=()`` so
+    viewers exit, matching reference behaviour."""
+
+    alive: Sequence[Cell] = field(default_factory=tuple)
+
+
+AnyEvent = Union[
+    AliveCellsCount,
+    ImageOutputComplete,
+    StateChange,
+    CellFlipped,
+    CellsFlipped,
+    TurnComplete,
+    FinalTurnComplete,
+]

@@ -1,0 +1,54 @@
+"""The engine façade — equivalent of ``gol.Run`` (``gol/gol.go:14``).
+
+The reference's ``Run`` wires five IO channels plus the distributor/manager
+channel bundles and calls ``distributor`` synchronously inside the caller's
+goroutine (``gol/gol.go:31-56``).  Here the wiring is two queues and the
+controller object; :func:`run` is synchronous (callers that want the
+reference's ``go gol.Run(...)`` shape use :func:`start`).
+
+Contract:
+- ``events``: receives the typed event stream; a ``None`` sentinel marks the
+  end (the ``close(events)`` analog).
+- ``key_presses``: optional queue of single-character strings
+  ('s'/'p'/'q'/'k', ``sdl/loop.go:15-28`` semantics).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+from distributed_gol_tpu.engine.backend import Backend
+from distributed_gol_tpu.engine.controller import Controller
+from distributed_gol_tpu.engine.params import Params
+from distributed_gol_tpu.engine.session import Session
+
+
+def run(
+    params: Params,
+    events: queue.Queue,
+    key_presses: Optional[queue.Queue] = None,
+    session: Optional[Session] = None,
+    backend: Optional[Backend] = None,
+) -> None:
+    """Drive one whole simulation, blocking until the event stream ends."""
+    Controller(params, events, key_presses, session, backend).run()
+
+
+def start(
+    params: Params,
+    events: queue.Queue,
+    key_presses: Optional[queue.Queue] = None,
+    session: Optional[Session] = None,
+    backend: Optional[Backend] = None,
+) -> threading.Thread:
+    """``go gol.Run(...)``: run in a daemon thread, return it."""
+    t = threading.Thread(
+        target=run,
+        args=(params, events, key_presses, session, backend),
+        name="gol-run",
+        daemon=True,
+    )
+    t.start()
+    return t

@@ -1,0 +1,97 @@
+"""Run configuration (reference: ``Params``, ``gol/gol.go:6-11``).
+
+The reference exposes four knobs — ``Turns, Threads, ImageWidth,
+ImageHeight`` — plus the CLI's ``-noVis`` (``main.go:17-46``).  The TPU
+engine keeps those (``threads`` maps to intra-chip parallelism the XLA
+compiler already owns, so it is accepted for API compatibility and recorded
+but does not change the compiled program) and adds the TPU-native knobs:
+rule selection, superstep size, engine choice, and mesh shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from distributed_gol_tpu.models.life import CONWAY, LifeRule
+
+
+@dataclass(frozen=True)
+class Params:
+    # --- the reference's four knobs (gol/gol.go:6-11) ---
+    turns: int = 100
+    threads: int = 8  # accepted for parity; XLA owns intra-chip parallelism
+    image_width: int = 512
+    image_height: int = 512
+
+    # --- reference CLI extra (main.go:40-46) ---
+    no_vis: bool = True
+
+    # --- TPU-native knobs (no reference equivalent) ---
+    rule: LifeRule = CONWAY
+    # Generations per device dispatch when running headless.  1 => per-turn
+    # host visibility (exact CellFlipped streams, as the SDL viewer needs);
+    # larger values amortise dispatch overhead; 0 => auto (1 with a viewer,
+    # a bandwidth-friendly default otherwise).
+    superstep: int = 0
+    # "roll" (jnp.roll stencil, always correct) | "pallas" (tuned TPU kernel)
+    engine: str = "roll"
+    # CellFlipped emission policy: "auto" (per-cell when a viewer is attached
+    # i.e. not no_vis, off headless), "cell" (always, reference contract),
+    # "batch" (one CellsFlipped per turn), "off".  Any flip mode forces
+    # superstep 1 — exact per-turn diffs need per-turn host visibility.
+    flip_events: str = "auto"
+    # AliveCellsCount cadence in seconds (reference: 2000 ms ticker,
+    # gol/distributor.go:228); configurable so tests can run fast.
+    ticker_period: float = 2.0
+    # Device mesh shape (rows, cols) for sharded execution; (1, 1) = single
+    # device.  Replaces the reference's hardcoded 4-worker fan-out
+    # (broker/broker.go:192).
+    mesh_shape: tuple[int, int] = (1, 1)
+
+    # --- filesystem conventions (gol/io.go:46,96: images/ in, out/ out) ---
+    images_dir: Path = field(default=Path("images"))
+    out_dir: Path = field(default=Path("out"))
+
+    def __post_init__(self):
+        if self.turns < 0:
+            raise ValueError("turns must be >= 0")
+        if self.image_width <= 0 or self.image_height <= 0:
+            raise ValueError("board dimensions must be positive")
+        if self.engine not in ("roll", "pallas"):
+            raise ValueError(f"unknown engine {self.engine!r}")
+        if self.flip_events not in ("auto", "cell", "batch", "off"):
+            raise ValueError(f"unknown flip_events {self.flip_events!r}")
+        if self.ticker_period <= 0:
+            raise ValueError("ticker_period must be positive")
+        # Paths may arrive as strings from CLI/config files.
+        object.__setattr__(self, "images_dir", Path(self.images_dir))
+        object.__setattr__(self, "out_dir", Path(self.out_dir))
+
+    # Filename conventions are part of the reference contract:
+    #   input  images/<W>x<H>.pgm            (gol/distributor.go:205)
+    #   final  out/<W>x<H>x<Turns>.pgm       (gol/distributor.go:246)
+    #   manual out/<W>x<H>x<turn>current.pgm (gol/distributor.go:92-94 uses
+    #          p.Turns here; we deliberately use the *current* turn so
+    #          successive 's' snapshots don't overwrite each other — quirk
+    #          decision per SURVEY.md appendix)
+    @property
+    def input_path(self) -> Path:
+        return self.images_dir / f"{self.image_width}x{self.image_height}.pgm"
+
+    @property
+    def final_output_name(self) -> str:
+        return f"{self.image_width}x{self.image_height}x{self.turns}"
+
+    def snapshot_name(self, turn: int) -> str:
+        return f"{self.image_width}x{self.image_height}x{turn}current"
+
+    def effective_superstep(self, viewer_attached: bool) -> int:
+        if self.superstep > 0:
+            return self.superstep
+        if viewer_attached or not self.no_vis:
+            return 1
+        # Headless auto: large enough to amortise dispatch, small enough
+        # that pause/quit keypresses are honoured promptly (SURVEY.md §7
+        # hard part 3: interactivity is at superstep granularity).
+        return min(self.turns, 50) if self.turns else 1

@@ -1,0 +1,157 @@
+"""Detach/resume checkpoint state — the broker's control-plane contract.
+
+In the reference, the broker is a separate long-lived process that outlives
+controllers: 'q' parks ``{worldSave, turn, size}`` plus a paused flag on it
+(``gol/distributor.go:139-147``, ``broker/broker.go:143-148``) and a new
+controller resumes via ``Broker.CheckStates`` iff paused ∧ same board size
+(``broker/broker.go:124-141``, ``gol/distributor.go:69-91``).
+
+On TPU the broker's *data-plane* job (fan out strips, barrier, concatenate —
+``broker/broker.go:37-56,157-180``) disappears into the SPMD program, but
+the control-plane contract survives as :class:`Session`: a state holder that
+outlives any single :func:`run` call.  In-memory it supports
+detach/reattach within a process (the default global session); given a
+directory it also persists checkpoints as PGM + sidecar metadata, so a brand
+new process can resume — strictly more durable than the reference, whose
+checkpoint dies with the broker process.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from distributed_gol_tpu.engine import pgm
+
+
+@dataclass
+class Checkpoint:
+    world: np.ndarray  # uint8 {0,255}, shape (h, w)
+    turn: int
+
+
+class Session:
+    """Holds pause/quit/checkpoint state across controller attachments.
+
+    Thread-safe (the reference broker's ``paused`` flag is read/written
+    unsynchronized across goroutines — quirk Q4; here a lock guards all
+    state).
+    """
+
+    def __init__(self, checkpoint_dir: str | Path | None = None):
+        self._lock = threading.Lock()
+        self._paused = False
+        self._checkpoint: Checkpoint | None = None
+        self._shutdown = False
+        self._dir = Path(checkpoint_dir) if checkpoint_dir is not None else None
+
+    # -- Broker.Pause (broker/broker.go:143-155) ------------------------------
+    def pause(self, paused: bool, world: np.ndarray | None = None, turn: int = 0):
+        """Set/clear the paused flag; with a world attached this is the 'q'
+        checkpoint call (stubs.PauseCall carries World/Turn/Dimension,
+        stubs/stubs.go:31-36)."""
+        with self._lock:
+            self._paused = paused
+            if paused and world is not None:
+                self._checkpoint = Checkpoint(np.asarray(world, dtype=np.uint8), turn)
+                self._persist()
+
+    # -- Broker.CheckStates (broker/broker.go:124-141) ------------------------
+    def check_states(self, width: int, height: int) -> Checkpoint | None:
+        """Resume negotiation: returns the checkpoint iff paused ∧ the saved
+        world matches (height, width); clears paused as a side effect (the
+        reference broadcasts on its pause cond here,
+        ``broker/broker.go:137-138``)."""
+        with self._lock:
+            ckpt, paused = self._checkpoint, self._paused
+            if ckpt is None and self._dir is not None:
+                loaded = self._load()
+                if loaded is not None:
+                    ckpt, paused = loaded
+            if not paused or ckpt is None:
+                return None
+            if ckpt.world.shape != (height, width):
+                return None
+            # Adopt + consume: clear paused in memory AND on disk, so the
+            # checkpoint is resumed exactly once (a second fresh process must
+            # not silently restart from it).
+            self._checkpoint = ckpt
+            self._paused = False
+            self._persist_meta(paused=False)
+            return ckpt
+
+    # -- Broker.Quit (broker/broker.go:182-189) --------------------------------
+    def quit(self):
+        """'k' teardown: drop all state.  The reference kills the broker and
+        worker processes via os.Exit; in-process the analog is discarding the
+        checkpoint so nothing can resume."""
+        with self._lock:
+            self._shutdown = True
+            self._paused = False
+            self._checkpoint = None
+            if self._dir is not None:
+                for p in (self._meta_path, self._world_path):
+                    p.unlink(missing_ok=True)
+
+    @property
+    def paused(self) -> bool:
+        with self._lock:
+            return self._paused
+
+    @property
+    def is_shutdown(self) -> bool:
+        with self._lock:
+            return self._shutdown
+
+    def reset(self):
+        with self._lock:
+            self._paused = False
+            self._checkpoint = None
+            self._shutdown = False
+
+    # -- optional durable checkpoints (framework extension) --------------------
+    @property
+    def _world_path(self) -> Path:
+        assert self._dir is not None
+        return self._dir / "checkpoint.pgm"
+
+    @property
+    def _meta_path(self) -> Path:
+        assert self._dir is not None
+        return self._dir / "checkpoint.json"
+
+    def _persist(self):
+        if self._dir is None or self._checkpoint is None:
+            return
+        self._dir.mkdir(parents=True, exist_ok=True)
+        pgm.write_pgm(self._world_path, self._checkpoint.world)
+        self._persist_meta(paused=True)
+
+    def _persist_meta(self, paused: bool):
+        if self._dir is None or self._checkpoint is None:
+            return
+        self._dir.mkdir(parents=True, exist_ok=True)
+        self._meta_path.write_text(
+            json.dumps({"turn": self._checkpoint.turn, "paused": paused})
+        )
+
+    def _load(self) -> tuple[Checkpoint, bool] | None:
+        """Read a durable checkpoint; no side effects on session state."""
+        if self._dir is None or not self._meta_path.exists():
+            return None
+        meta = json.loads(self._meta_path.read_text())
+        world = pgm.read_pgm(self._world_path)
+        return Checkpoint(world, int(meta["turn"])), bool(meta.get("paused", False))
+
+
+# The default in-process session: the analog of "the one broker at
+# 44.193.6.26:8031" (gol/distributor.go:218) every controller dials.
+_default_session = Session()
+
+
+def default_session() -> Session:
+    return _default_session

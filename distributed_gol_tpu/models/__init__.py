@@ -1,0 +1,28 @@
+"""Cellular-automaton model families.
+
+The reference hardcodes Conway's B3/S23 in its worker kernel
+(``server/server.go:33-53``).  Here the rule is a first-class model: any
+outer-totalistic "life-like" rule (birth/survive sets over the 8-neighbour
+Moore neighbourhood, toroidal wrap) compiles to the same TPU stencil via an
+18-entry lookup table, so the framework generalises without a new kernel.
+"""
+
+from distributed_gol_tpu.models.life import (
+    CONWAY,
+    DAY_AND_NIGHT,
+    HIGHLIFE,
+    LIFE_WITHOUT_DEATH,
+    RULES,
+    SEEDS,
+    LifeRule,
+)
+
+__all__ = [
+    "CONWAY",
+    "DAY_AND_NIGHT",
+    "HIGHLIFE",
+    "LIFE_WITHOUT_DEATH",
+    "RULES",
+    "SEEDS",
+    "LifeRule",
+]

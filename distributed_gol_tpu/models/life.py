@@ -1,0 +1,90 @@
+"""Life-like cellular-automaton rules as data.
+
+The reference implements exactly one rule, Conway's B3/S23, as branchy Go
+(``server/server.go:33-53``: a cell is born with 3 neighbours, survives with
+2 or 3, dies otherwise, on a toroidal board of {0, 255} bytes).  A TPU-first
+design wants the rule as *data* the stencil kernel can apply branch-free: an
+outer-totalistic rule is fully described by an 18-entry uint8 table indexed
+by ``9 * alive + neighbour_count`` — one gather per cell on the VPU, no
+control flow inside ``jit``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import cached_property
+
+import numpy as np
+
+ALIVE = 255  # cell byte values, as in the reference PGM boards
+DEAD = 0
+
+
+@dataclass(frozen=True)
+class LifeRule:
+    """An outer-totalistic rule B{birth}/S{survive} on the Moore neighbourhood.
+
+    ``birth``: neighbour counts that turn a dead cell alive.
+    ``survive``: neighbour counts that keep a live cell alive.
+    """
+
+    name: str
+    birth: frozenset[int]
+    survive: frozenset[int]
+
+    def __post_init__(self):
+        for n in self.birth | self.survive:
+            if not 0 <= n <= 8:
+                raise ValueError(f"neighbour count {n} out of range [0, 8]")
+
+    @cached_property
+    def table(self) -> np.ndarray:
+        """18-entry lookup: ``table[9 * alive + n]`` → next cell byte (0/255).
+
+        Rows: [dead-cell outcomes for n=0..8, live-cell outcomes for n=0..8].
+        """
+        t = np.zeros(18, dtype=np.uint8)
+        for n in self.birth:
+            t[n] = ALIVE
+        for n in self.survive:
+            t[9 + n] = ALIVE
+        return t
+
+    @property
+    def notation(self) -> str:
+        b = "".join(str(n) for n in sorted(self.birth))
+        s = "".join(str(n) for n in sorted(self.survive))
+        return f"B{b}/S{s}"
+
+    def __str__(self) -> str:
+        return f"{self.name} ({self.notation})"
+
+
+def _rule(name: str, birth: tuple[int, ...], survive: tuple[int, ...]) -> LifeRule:
+    return LifeRule(name, frozenset(birth), frozenset(survive))
+
+
+# The reference's rule (server/server.go:33-53) and a zoo of well-known
+# life-like rules the generalised kernel supports for free.
+CONWAY = _rule("conway", (3,), (2, 3))
+HIGHLIFE = _rule("highlife", (3, 6), (2, 3))
+SEEDS = _rule("seeds", (2,), ())
+DAY_AND_NIGHT = _rule("day-and-night", (3, 6, 7, 8), (3, 4, 6, 7, 8))
+LIFE_WITHOUT_DEATH = _rule("life-without-death", (3,), (0, 1, 2, 3, 4, 5, 6, 7, 8))
+
+RULES: dict[str, LifeRule] = {
+    r.name: r for r in (CONWAY, HIGHLIFE, SEEDS, DAY_AND_NIGHT, LIFE_WITHOUT_DEATH)
+}
+
+
+def parse_rule(spec: str) -> LifeRule:
+    """Parse ``"conway"`` (a zoo name) or ``"B36/S23"`` notation."""
+    key = spec.strip().lower()
+    if key in RULES:
+        return RULES[key]
+    if key.startswith("b") and "/s" in key:
+        b_part, s_part = key[1:].split("/s", 1)
+        birth = tuple(int(c) for c in b_part)
+        survive = tuple(int(c) for c in s_part)
+        return _rule(spec, birth, survive)
+    raise ValueError(f"unknown rule {spec!r}; known: {sorted(RULES)} or B…/S… notation")

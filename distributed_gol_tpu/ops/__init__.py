@@ -1,0 +1,28 @@
+"""Device compute ops: the stencil kernels and on-device reductions.
+
+This package is the TPU-native replacement for the reference's worker
+compute layer (``server/server.go:21-107``): instead of goroutines looping
+over byte slices with per-cell edge branches, one generation is a 9-point
+stencil over the whole device-resident board — ``jnp.roll`` based for the
+always-correct baseline, Pallas for the tuned kernel — with multi-generation
+supersteps under ``lax.fori_loop``/``lax.scan`` so thousands of generations
+run per dispatch.
+"""
+
+from distributed_gol_tpu.ops.stencil import (
+    alive_count,
+    make_step_fn,
+    neighbour_counts,
+    step,
+    steps_with_counts,
+    superstep,
+)
+
+__all__ = [
+    "alive_count",
+    "make_step_fn",
+    "neighbour_counts",
+    "step",
+    "steps_with_counts",
+    "superstep",
+]

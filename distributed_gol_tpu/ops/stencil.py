@@ -1,0 +1,109 @@
+"""Roll-based 9-point stencil: the always-correct baseline generation kernel.
+
+Behavioural spec (reference ``server/server.go:33-75``): cells are uint8
+{0, 255}; the board is a torus; a generation applies an outer-totalistic
+rule (Conway B3/S23 in the reference) to every cell's 8-neighbour count.
+The reference computes this with per-cell branches for the four wrap edges
+and a ``/255`` per neighbour load; here the torus is four ``jnp.roll``s and
+the rule is a branch-free 18-entry table gather, so the whole generation is
+a fused elementwise XLA program on the VPU — no data-dependent control flow,
+static shapes, uint8 end to end.
+
+Everything is pure and jit-compatible; multi-generation supersteps use
+``lax.fori_loop`` (no per-turn host round-trip — the reference pays two TCP
+hops per generation, ``gol/distributor.go:48-66``) and ``lax.scan`` when a
+per-turn alive-count telemetry vector is needed (``check/alive/*.csv``
+oracle, ``count_test.go``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from distributed_gol_tpu.models.life import CONWAY, LifeRule
+
+
+def neighbour_counts(alive: jax.Array) -> jax.Array:
+    """8-neighbour Moore counts with toroidal wrap, for a {0,1} uint8 grid.
+
+    Separable form: sum the 3-row window first, then the 3-column window of
+    that, then subtract the centre — 4 rolls + 4 adds instead of 8 rolls +
+    7 adds.  Max value 8 fits uint8.
+    """
+    rows = alive + jnp.roll(alive, 1, axis=0) + jnp.roll(alive, -1, axis=0)
+    return rows + jnp.roll(rows, 1, axis=1) + jnp.roll(rows, -1, axis=1) - alive
+
+
+def apply_rule(alive: jax.Array, counts: jax.Array, table: jax.Array) -> jax.Array:
+    """Next-generation board bytes via the 18-entry rule table.
+
+    ``table[9 * alive + count]`` → 0/255 (see ``LifeRule.table``).  One
+    gather per cell, no branches — the TPU-friendly form of the reference's
+    ``updateCell`` switch (``server/server.go:33-53``).
+    """
+    idx = counts.astype(jnp.int32) + 9 * alive.astype(jnp.int32)
+    return jnp.take(table, idx, axis=0)
+
+
+def step(board: jax.Array, table: jax.Array) -> jax.Array:
+    """One generation on a {0,255} uint8 board (torus)."""
+    alive = board & 1  # 255 & 1 == 1, 0 & 1 == 0: LSB is the alive bit
+    return apply_rule(alive, neighbour_counts(alive), table)
+
+
+def alive_count(board: jax.Array) -> jax.Array:
+    """On-device alive-cell count (int32 scalar).
+
+    Replaces the reference's per-turn host rescan of the whole world
+    (``gol/distributor.go:185-186``, an O(N²) Go loop per generation).
+    """
+    return jnp.sum(board & 1, dtype=jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("turns",))
+def superstep(board: jax.Array, table: jax.Array, turns: int) -> jax.Array:
+    """``turns`` generations in one dispatch (no host involvement between)."""
+    return jax.lax.fori_loop(0, turns, lambda _, b: step(b, table), board)
+
+
+@partial(jax.jit, static_argnames=("turns",))
+def steps_with_counts(
+    board: jax.Array, table: jax.Array, turns: int
+) -> tuple[jax.Array, jax.Array]:
+    """``turns`` generations, returning (final board, int32[turns] counts).
+
+    ``counts[i]`` is the alive count after generation ``i + 1`` — the same
+    indexing as the golden count CSVs (``check/alive/*.csv`` rows are
+    ``completed_turns, alive_cells`` for turns 1..10000).
+    """
+
+    def body(b, _):
+        nb = step(b, table)
+        return nb, alive_count(nb)
+
+    final, counts = jax.lax.scan(body, board, None, length=turns)
+    return final, counts
+
+
+@jax.jit
+def flip_mask(prev: jax.Array, new: jax.Array) -> jax.Array:
+    """Cells that changed between two boards, as a uint8 0/1 mask.
+
+    On-device replacement for the reference's client-side O(N²) diff loop
+    that drives ``CellFlipped`` events (``gol/distributor.go:53-59``); the
+    host fetches only the (mostly-zero) mask when a viewer is attached.
+    """
+    return (prev ^ new) & 1
+
+
+def make_step_fn(rule: LifeRule = CONWAY):
+    """A jitted one-generation function specialised to ``rule``.
+
+    The rule table is closed over as a constant so XLA folds it; the
+    returned fn has signature ``board -> board``.
+    """
+    table = jnp.asarray(rule.table)
+    return jax.jit(lambda board: step(board, table))

@@ -1,0 +1,25 @@
+"""SPMD sharded execution over a TPU device mesh.
+
+This package is the TPU-native replacement for the reference's entire
+distribution stack — broker fan-out (``broker/broker.go:37-56``), worker RPC
+(``server/server.go:77-107``), and the full-board-broadcast-instead-of-halo
+invariant (SURVEY.md §1): the board is sharded 2-D over a
+``jax.sharding.Mesh``, each device exchanges 1-cell halos with its torus
+neighbours via ``lax.ppermute`` over ICI, and alive counts are ``psum``
+reductions — all inside one jitted SPMD program, no host on the data path.
+"""
+
+from distributed_gol_tpu.parallel.mesh import make_mesh, mesh_shape_for
+from distributed_gol_tpu.parallel.halo import (
+    sharded_step,
+    sharded_steps_with_counts,
+    sharded_superstep,
+)
+
+__all__ = [
+    "make_mesh",
+    "mesh_shape_for",
+    "sharded_step",
+    "sharded_steps_with_counts",
+    "sharded_superstep",
+]

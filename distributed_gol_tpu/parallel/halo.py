@@ -1,0 +1,141 @@
+"""Halo-exchange stencil step under ``shard_map``.
+
+The reference has **no halo exchange** — it broadcasts the entire board to
+every worker every turn (SURVEY.md §1 key invariant; ``broker/broker.go:51``,
+``server/server.go:70-72``), which is exactly what stops it scaling.  Here
+each device owns an (h/ny, w/nx) block and exchanges only its boundary
+ring per generation:
+
+1. rows along mesh axis ``y`` via ``lax.ppermute`` (neighbour-only, rides
+   ICI — the same ring topology as ring attention);
+2. columns along ``x`` using the *row-extended* block, so the four corner
+   cells arrive for free in the second exchange — no separate diagonal
+   sends.
+
+Because the permutation is the cyclic shift over each axis, a 1-sized axis
+sends to itself, which IS the toroidal wrap — so the same kernel is correct
+on any mesh shape including (1, 1), and sharded output is bit-identical to
+the single-device roll stencil (both are pure boolean algebra).
+
+Alive counts are ``psum`` over both axes inside the same program
+(reference analog: the broker's in-order barrier + host recount,
+``broker/broker.go:168-174``, ``gol/distributor.go:185``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_gol_tpu.ops.stencil import apply_rule
+
+BOARD_SPEC = P("y", "x")
+
+
+def _shift_perm(axis_size: int, forward: bool) -> list[tuple[int, int]]:
+    """Cyclic shift permutation; self-send when axis_size == 1 (= torus wrap)."""
+    if forward:
+        return [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    return [(i, (i - 1) % axis_size) for i in range(axis_size)]
+
+
+def _exchange_and_extend(local: jax.Array) -> jax.Array:
+    """(h, w) block -> (h+2, w+2) block with halo ring from torus neighbours."""
+    ny = lax.axis_size("y")
+    nx = lax.axis_size("x")
+    # Row halos: my last row is my south neighbour's top halo.
+    from_north = lax.ppermute(local[-1:, :], "y", _shift_perm(ny, forward=True))
+    from_south = lax.ppermute(local[:1, :], "y", _shift_perm(ny, forward=False))
+    ext = jnp.concatenate([from_north, local, from_south], axis=0)  # (h+2, w)
+    # Column halos on the extended block: corners ride along.
+    from_west = lax.ppermute(ext[:, -1:], "x", _shift_perm(nx, forward=True))
+    from_east = lax.ppermute(ext[:, :1], "x", _shift_perm(nx, forward=False))
+    return jnp.concatenate([from_west, ext, from_east], axis=1)  # (h+2, w+2)
+
+
+def _local_step(local: jax.Array, table: jax.Array) -> jax.Array:
+    """One generation of the local block, halo-exchanged, no wrap arithmetic:
+    the separable 3x3 window sum over the extended block."""
+    ext = _exchange_and_extend(local) & 1  # alive bits, (h+2, w+2)
+    rows = ext[:-2, :] + ext[1:-1, :] + ext[2:, :]  # (h, w+2)
+    counts = rows[:, :-2] + rows[:, 1:-1] + rows[:, 2:] - ext[1:-1, 1:-1]
+    return apply_rule(ext[1:-1, 1:-1], counts, table)
+
+
+def _local_count(local: jax.Array) -> jax.Array:
+    return lax.psum(jnp.sum(local & 1, dtype=jnp.int32), ("y", "x"))
+
+
+def board_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, BOARD_SPEC)
+
+
+def sharded_step(mesh: Mesh):
+    """Jitted one-generation step over ``mesh``: board -> board."""
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(BOARD_SPEC, P()),
+        out_specs=BOARD_SPEC,
+    )
+    def step(board, table):
+        return _local_step(board, table)
+
+    return step
+
+
+def sharded_superstep(mesh: Mesh):
+    """Jitted (board, table, turns) -> board, all generations on device."""
+
+    @partial(jax.jit, static_argnames=("turns",))
+    def run(board, table, turns: int):
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(BOARD_SPEC, P()),
+            out_specs=BOARD_SPEC,
+        )
+        def inner(local, table):
+            return lax.fori_loop(
+                0, turns, lambda _, b: _local_step(b, table), local
+            )
+
+        return inner(board, table)
+
+    return run
+
+
+def sharded_steps_with_counts(mesh: Mesh):
+    """Jitted (board, table, turns) -> (board, int32[turns] global counts).
+
+    Counts are psum-reduced inside the program, so the host receives the
+    full per-turn telemetry vector in one transfer per superstep — the
+    replacement for the reference's per-turn O(N²) host recount
+    (``gol/distributor.go:185-186``).
+    """
+
+    @partial(jax.jit, static_argnames=("turns",))
+    def run(board, table, turns: int):
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(BOARD_SPEC, P()),
+            out_specs=(BOARD_SPEC, P()),
+        )
+        def inner(local, table):
+            def body(b, _):
+                nb = _local_step(b, table)
+                return nb, _local_count(nb)
+
+            final, counts = lax.scan(body, local, None, length=turns)
+            return final, counts
+
+        return inner(board, table)
+
+    return run

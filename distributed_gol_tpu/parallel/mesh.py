@@ -1,0 +1,54 @@
+"""Device-mesh construction.
+
+The reference's topology is configuration-by-hardcoding: 4 worker IPs in
+``broker/broker.go:192``.  Here the topology is a ``jax.sharding.Mesh`` with
+axes ``("y", "x")`` — rows and columns of the board's 2-D domain
+decomposition.  ``("y",)`` sharding alone reproduces the reference's
+contiguous row strips (``broker/broker.go:37-56``); the 2-D form halves halo
+bytes per device at scale.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax.sharding import Mesh
+
+AXES = ("y", "x")
+
+
+def make_mesh(shape: tuple[int, int], devices=None) -> Mesh:
+    """A (ny, nx) mesh with axes ("y", "x") over the first ny*nx devices."""
+    ny, nx = shape
+    if devices is None:
+        devices = jax.devices()
+    n = ny * nx
+    if len(devices) < n:
+        raise ValueError(f"mesh {shape} needs {n} devices, have {len(devices)}")
+    import numpy as np
+
+    return Mesh(np.asarray(devices[:n]).reshape(ny, nx), AXES)
+
+
+def mesh_shape_for(
+    n_devices: int, height: int, width: int
+) -> tuple[int, int]:
+    """Pick a (ny, nx) factorisation of n_devices that divides the board and
+    is as square as possible (minimises halo perimeter per device)."""
+    best = None
+    for ny in range(1, n_devices + 1):
+        if n_devices % ny:
+            continue
+        nx = n_devices // ny
+        if height % ny or width % nx:
+            continue
+        score = abs(math.log(ny) - math.log(nx))
+        if best is None or score < best[0]:
+            best = (score, (ny, nx))
+    if best is None:
+        raise ValueError(
+            f"no factorisation of {n_devices} devices divides a "
+            f"{height}x{width} board"
+        )
+    return best[1]

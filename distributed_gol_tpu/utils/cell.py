@@ -1,0 +1,41 @@
+"""The board-coordinate type (reference: ``util/cell.go:4-6``)."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class Cell(NamedTuple):
+    """An (x, y) coordinate on the board.
+
+    ``x`` is the column, ``y`` the row — the same convention as the
+    reference's ``util.Cell{X, Y}`` (``util/cell.go:4-6``), which tests
+    compare as an order-insensitive multiset (``gol_test.go:58-86``).
+    """
+
+    x: int
+    y: int
+
+
+def alive_cells_from_board(board: np.ndarray) -> list[Cell]:
+    """All alive cells of a {0, 255} uint8 board, row-major order.
+
+    Equivalent of the reference's ``calculateAliveCells``
+    (``gol/distributor.go:153-166``), but vectorised on the host: the board
+    is fetched from device once and scanned with NumPy instead of a nested
+    Go loop.
+    """
+    ys, xs = np.nonzero(np.asarray(board))
+    return [Cell(int(x), int(y)) for x, y in zip(xs, ys)]
+
+
+def board_from_alive_cells(
+    cells: list[Cell] | list[tuple[int, int]], width: int, height: int
+) -> np.ndarray:
+    """Rebuild a {0, 255} uint8 board from a list of alive (x, y) cells."""
+    board = np.zeros((height, width), dtype=np.uint8)
+    for x, y in cells:
+        board[y, x] = 255
+    return board

@@ -1,0 +1,69 @@
+"""Test session setup: hermetic multi-device JAX on CPU.
+
+The reference's tests require a live AWS broker + 4 workers (SURVEY.md §4);
+ours run anywhere by forcing the JAX host platform with 8 virtual devices,
+so sharded-mesh tests exercise real collectives (`ppermute`, `psum`) without
+TPU hardware.  Must run before the first `import jax` anywhere in the test
+process — hence module top-level in conftest.
+"""
+
+import os
+from pathlib import Path
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# A TPU-terminal site hook may have force-selected its own platform via
+# jax.config (overriding the env var we just set); re-assert CPU before any
+# backend initializes so tests are hermetic on any machine.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# The reference repo supplies the golden oracles (input soups, golden
+# boards, golden count CSVs) — implementation-independent data, read
+# in place, never copied into this repo.
+REFERENCE_DIR = Path(os.environ.get("GOL_REFERENCE_DIR", "/root/reference"))
+
+needs_reference = pytest.mark.skipif(
+    not REFERENCE_DIR.is_dir(),
+    reason=f"reference oracle data not mounted at {REFERENCE_DIR}",
+)
+
+
+@pytest.fixture(scope="session")
+def reference_dir() -> Path:
+    if not REFERENCE_DIR.is_dir():
+        pytest.skip("reference oracle data not mounted")
+    return REFERENCE_DIR
+
+
+@pytest.fixture(scope="session")
+def golden_images(reference_dir) -> Path:
+    return reference_dir / "check" / "images"
+
+
+@pytest.fixture(scope="session")
+def golden_alive(reference_dir) -> Path:
+    return reference_dir / "check" / "alive"
+
+
+@pytest.fixture(scope="session")
+def input_images(reference_dir) -> Path:
+    return reference_dir / "images"
+
+
+@pytest.fixture()
+def rng() -> np.random.Generator:
+    return np.random.default_rng(42)
+
+
+def random_board(rng: np.random.Generator, h: int, w: int, p: float = 0.3) -> np.ndarray:
+    return np.where(rng.random((h, w)) < p, 255, 0).astype(np.uint8)

@@ -1,0 +1,38 @@
+"""Independent NumPy oracle for life-like rules on a torus.
+
+Deliberately written with a different algorithm from the engine under test
+(padded-array slicing here vs. jnp.roll / Pallas there) so shared bugs are
+unlikely.  Mirrors the *behaviour* of the reference kernel
+``server/server.go:33-75`` (B3/S23 on {0,255} bytes, toroidal wrap).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_gol_tpu.models.life import CONWAY, LifeRule
+
+
+def oracle_step(board: np.ndarray, rule: LifeRule = CONWAY) -> np.ndarray:
+    alive = (board == 255).astype(np.int64)
+    padded = np.pad(alive, 1, mode="wrap")
+    counts = np.zeros_like(alive)
+    for dy in (0, 1, 2):
+        for dx in (0, 1, 2):
+            if dy == 1 and dx == 1:
+                continue
+            h, w = alive.shape
+            counts += padded[dy : dy + h, dx : dx + w]
+    out = np.zeros_like(board, dtype=np.uint8)
+    for n in range(9):
+        if n in rule.birth:
+            out[(alive == 0) & (counts == n)] = 255
+        if n in rule.survive:
+            out[(alive == 1) & (counts == n)] = 255
+    return out
+
+
+def oracle_run(board: np.ndarray, turns: int, rule: LifeRule = CONWAY) -> np.ndarray:
+    for _ in range(turns):
+        board = oracle_step(board, rule)
+    return board

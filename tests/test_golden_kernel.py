@@ -1,0 +1,69 @@
+"""Golden-oracle lock-in: the kernel vs the reference's check/ data.
+
+These are the reference's own correctness baselines (SURVEY.md §6, BASELINE.md):
+golden boards {16², 64², 512²} × {0, 1, 100} turns (check/images/*.pgm,
+gol_test.go:24-28) and the 10k-turn alive-count series (check/alive/*.csv,
+count_test.go) including the 512² period-2 steady state (5565 even / 5567 odd).
+"""
+
+import csv
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_gol_tpu.engine.pgm import read_pgm
+from distributed_gol_tpu.models.life import CONWAY
+from distributed_gol_tpu.ops.stencil import steps_with_counts, superstep
+from distributed_gol_tpu.utils.visualise import boards_to_string
+
+TABLE = jnp.asarray(CONWAY.table)
+
+SIZES = [16, 64, 512]
+TURNS = [0, 1, 100]
+
+
+def read_alive_csv(path):
+    with open(path) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["completed_turns", "alive_cells"]
+    return {int(t): int(c) for t, c in rows[1:]}
+
+
+@pytest.mark.parametrize("size", SIZES)
+@pytest.mark.parametrize("turns", TURNS)
+def test_golden_boards(input_images, golden_images, size, turns):
+    start = read_pgm(input_images / f"{size}x{size}.pgm")
+    expected = read_pgm(golden_images / f"{size}x{size}x{turns}.pgm")
+    got = np.asarray(superstep(jnp.asarray(start), TABLE, turns))
+    if size == 16 and not np.array_equal(got, expected):
+        pytest.fail("board mismatch:\n" + boards_to_string(expected, got))
+    np.testing.assert_array_equal(got, expected)
+
+
+@pytest.mark.parametrize("size", SIZES)
+def test_golden_count_series_10k(input_images, golden_alive, size):
+    """The 10,000-turn soak — catches torus-seam off-by-ones nothing else
+    does (SURVEY.md §7 hard part 1).  One scan dispatch, counts on device."""
+    expected = read_alive_csv(golden_alive / f"{size}x{size}.csv")
+    start = read_pgm(input_images / f"{size}x{size}.pgm")
+    _, counts = steps_with_counts(jnp.asarray(start), TABLE, 10_000)
+    counts = np.asarray(counts)
+    assert len(expected) == 10_000
+    mismatches = [
+        (t, expected[t], int(counts[t - 1]))
+        for t in expected
+        if int(counts[t - 1]) != expected[t]
+    ]
+    assert not mismatches, f"first mismatches: {mismatches[:5]}"
+
+
+def test_steady_state_512_period_2(input_images):
+    """After turn 10000 the 512² soup is a period-2 oscillator: 5565 alive on
+    even turns, 5567 on odd (count_test.go:45-51)."""
+    start = read_pgm(input_images / "512x512.pgm")
+    board = superstep(jnp.asarray(start), TABLE, 10_000)
+    _, counts = steps_with_counts(board, TABLE, 6)
+    for i, c in enumerate(np.asarray(counts)):
+        turn = 10_001 + i
+        assert int(c) == (5567 if turn % 2 else 5565), f"turn {turn}"

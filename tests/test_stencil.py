@@ -1,0 +1,126 @@
+"""Kernel unit tests: one-step correctness vs the NumPy oracle.
+
+The reference has no kernel unit tests (SURVEY.md §4: black-box only); these
+are the added coverage the survey's rebuild test plan calls for.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_gol_tpu.models.life import CONWAY, HIGHLIFE, RULES, SEEDS, parse_rule
+from distributed_gol_tpu.ops.stencil import (
+    alive_count,
+    flip_mask,
+    make_step_fn,
+    step,
+    steps_with_counts,
+    superstep,
+)
+from tests.conftest import random_board
+from tests.oracle import oracle_run, oracle_step
+
+
+def jstep(board, rule=CONWAY):
+    return np.asarray(step(jnp.asarray(board), jnp.asarray(rule.table)))
+
+
+class TestSingleStep:
+    def test_blinker_oscillates(self):
+        """Period-2 blinker: the canonical hand-checkable pattern."""
+        b = np.zeros((5, 5), dtype=np.uint8)
+        b[2, 1:4] = 255  # horizontal bar
+        expected = np.zeros((5, 5), dtype=np.uint8)
+        expected[1:4, 2] = 255  # vertical bar
+        np.testing.assert_array_equal(jstep(b), expected)
+        np.testing.assert_array_equal(jstep(expected), b)
+
+    def test_block_is_still(self):
+        b = np.zeros((6, 6), dtype=np.uint8)
+        b[2:4, 2:4] = 255
+        np.testing.assert_array_equal(jstep(b), b)
+
+    def test_toroidal_wrap_corner(self):
+        """A 2x2 block straddling all four corners must survive: wrap is the
+        behaviour the reference implements with edge branches
+        (server/server.go:55-75)."""
+        b = np.zeros((8, 8), dtype=np.uint8)
+        for y in (0, 7):
+            for x in (0, 7):
+                b[y, x] = 255
+        np.testing.assert_array_equal(jstep(b), b)
+
+    def test_toroidal_wrap_blinker_on_edge(self):
+        b = np.zeros((8, 8), dtype=np.uint8)
+        b[0, 3] = b[7, 3] = b[1, 3] = 255  # vertical blinker across the seam
+        np.testing.assert_array_equal(jstep(b), oracle_step(b))
+
+    @pytest.mark.parametrize("shape", [(16, 16), (17, 31), (64, 64), (5, 128)])
+    def test_random_boards_match_oracle(self, rng, shape):
+        b = random_board(rng, *shape)
+        np.testing.assert_array_equal(jstep(b), oracle_step(b))
+
+    @pytest.mark.parametrize("rule", list(RULES.values()), ids=lambda r: r.name)
+    def test_rule_zoo_matches_oracle(self, rng, rule):
+        b = random_board(rng, 32, 32)
+        np.testing.assert_array_equal(jstep(b, rule), oracle_step(b, rule))
+
+    def test_make_step_fn(self, rng):
+        b = random_board(rng, 16, 16)
+        f = make_step_fn(HIGHLIFE)
+        np.testing.assert_array_equal(np.asarray(f(jnp.asarray(b))), oracle_step(b, HIGHLIFE))
+
+
+class TestMultiStep:
+    def test_superstep_equals_iterated_step(self, rng):
+        b = random_board(rng, 32, 32)
+        table = jnp.asarray(CONWAY.table)
+        got = np.asarray(superstep(jnp.asarray(b), table, 10))
+        np.testing.assert_array_equal(got, oracle_run(b, 10))
+
+    def test_steps_with_counts(self, rng):
+        b = random_board(rng, 32, 32)
+        table = jnp.asarray(CONWAY.table)
+        final, counts = steps_with_counts(jnp.asarray(b), table, 8)
+        expect = b
+        for i in range(8):
+            expect = oracle_step(expect)
+            assert int(counts[i]) == int((expect == 255).sum()), f"turn {i + 1}"
+        np.testing.assert_array_equal(np.asarray(final), expect)
+
+    def test_zero_turns_identity(self, rng):
+        b = random_board(rng, 16, 16)
+        table = jnp.asarray(CONWAY.table)
+        np.testing.assert_array_equal(np.asarray(superstep(jnp.asarray(b), table, 0)), b)
+
+
+class TestHelpers:
+    def test_alive_count(self, rng):
+        b = random_board(rng, 33, 65)
+        assert int(alive_count(jnp.asarray(b))) == int((b == 255).sum())
+
+    def test_flip_mask(self, rng):
+        a = random_board(rng, 16, 16)
+        b = random_board(rng, 16, 16)
+        got = np.asarray(flip_mask(jnp.asarray(a), jnp.asarray(b)))
+        np.testing.assert_array_equal(got, (a != b).astype(np.uint8))
+
+
+class TestRuleParsing:
+    def test_named(self):
+        assert parse_rule("conway") is CONWAY
+        assert parse_rule("Seeds") is SEEDS
+
+    def test_notation(self):
+        r = parse_rule("B36/S23")
+        assert r.birth == frozenset({3, 6}) and r.survive == frozenset({2, 3})
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            parse_rule("nope")
+
+    def test_table_shape(self):
+        t = CONWAY.table
+        assert t.shape == (18,)
+        assert t[3] == 255 and t[9 + 2] == 255 and t[9 + 3] == 255
+        assert t.sum() == 3 * 255
