@@ -1,0 +1,139 @@
+"""Benchmark runner: generations/sec of the device-resident engine.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline config (BASELINE.json config 3): a 16384x16384 random board on one
+chip, multi-generation supersteps (one dispatch per KTURNS generations, no
+host round-trips — the thing the reference could never do: it paid 2 TCP
+hops per generation, gol/distributor.go:48-66).  ``vs_baseline`` is measured
+gens/sec over the 1,000,000 gens/sec north star from BASELINE.md (the
+reference itself publishes no numbers).
+
+Extra diagnostics go to stderr; stdout carries exactly the one JSON line.
+
+Usage: python bench.py [--size N] [--kturns K] [--engine roll|pallas|auto]
+                       [--reps R] [--all]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def make_board(size: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return np.where(rng.random((size, size)) < 0.3, 255, 0).astype(np.uint8)
+
+
+def bench_config(size: int, kturns: int, engine: str, reps: int):
+    """Time `reps` supersteps of `kturns` generations each; returns
+    (gens_per_sec, cell_updates_per_sec)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_gol_tpu.models.life import CONWAY
+
+    table = jnp.asarray(CONWAY.table)
+    board = jnp.asarray(make_board(size))
+
+    if engine == "pallas":
+        try:
+            from distributed_gol_tpu.ops import pallas_stencil
+        except ImportError:
+            sys.exit("error: engine='pallas' kernel not available in this build")
+
+        superstep = pallas_stencil.make_superstep(CONWAY)
+        run = lambda b: superstep(b, kturns)
+    else:
+        from distributed_gol_tpu.ops.stencil import superstep
+
+        run = lambda b: superstep(b, table, kturns)
+
+    t0 = time.perf_counter()
+    board = jax.block_until_ready(run(board))  # compile + warm up
+    log(f"  compile+first superstep: {time.perf_counter() - t0:.2f}s")
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        board = run(board)
+    jax.block_until_ready(board)
+    dt = time.perf_counter() - t0
+    gens = reps * kturns
+    gps = gens / dt
+    log(
+        f"  {size}x{size} engine={engine}: {gens} gens in {dt:.3f}s "
+        f"-> {gps:,.0f} gens/s, {gps * size * size:.3e} cell-updates/s"
+    )
+    return gps, gps * size * size
+
+
+def pick_engine(requested: str) -> str:
+    if requested != "auto":
+        return requested
+    try:
+        from distributed_gol_tpu.ops import pallas_stencil  # noqa: F401
+
+        import jax
+
+        if jax.devices()[0].platform != "cpu":
+            return "pallas"
+    except Exception:
+        pass
+    return "roll"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=16384)
+    ap.add_argument("--kturns", type=int, default=256)
+    ap.add_argument("--engine", default="auto", choices=["auto", "roll", "pallas"])
+    ap.add_argument("--reps", type=int, default=4)
+    ap.add_argument("--all", action="store_true", help="also bench 512/4096 configs")
+    args = ap.parse_args()
+
+    import jax
+
+    from distributed_gol_tpu.utils.platform import honour_env_platforms
+
+    honour_env_platforms()
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} platform={dev.platform}")
+    size = args.size
+    if dev.platform == "cpu" and size > 4096:
+        size = 2048  # keep CI/laptop runs sane; the headline number is TPU
+        log(f"cpu fallback: size -> {size}")
+
+    engine = pick_engine(args.engine)
+    if args.all:
+        for s in (512, 4096):
+            if s <= size:
+                bench_config(s, args.kturns, engine, args.reps)
+
+    gps, cups = bench_config(size, args.kturns, engine, args.reps)
+
+    baseline = 1_000_000.0  # north-star gens/sec (BASELINE.md)
+    print(
+        json.dumps(
+            {
+                "metric": f"gol_gens_per_sec_{size}x{size}_{engine}_{dev.platform}",
+                "value": round(gps, 2),
+                "unit": "generations/sec",
+                "vs_baseline": round(gps / baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

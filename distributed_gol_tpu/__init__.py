@@ -35,7 +35,7 @@ from distributed_gol_tpu.engine.events import (
     StateChange,
     TurnComplete,
 )
-from distributed_gol_tpu.engine.gol import run
+from distributed_gol_tpu.engine.gol import run, start
 
 __all__ = [
     "AliveCellsCount",
@@ -50,6 +50,7 @@ __all__ = [
     "StateChange",
     "TurnComplete",
     "run",
+    "start",
 ]
 
 __version__ = "0.1.0"

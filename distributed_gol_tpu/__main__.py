@@ -1,0 +1,120 @@
+"""CLI entry — the reference's ``main.go`` equivalent.
+
+Flag parity (``main.go:17-46``): ``-t`` threads (default 8), ``-w`` width
+(512), ``-h`` height (512), ``-turns`` (default 10_000_000_000), ``-noVis``
+— note ``-h`` is board height as in the reference, so help is ``--help``.
+TPU-native extras: ``--rule``, ``--engine``, ``--superstep``, ``--mesh``,
+``--images-dir``, ``--out-dir``, ``--checkpoint-dir``, ``--ticker``.
+
+Process shape: the engine runs in a worker thread (the ``go gol.Run``
+analog, ``main.go:55``) while the main thread runs the viewer loop and the
+keyboard listener feeds s/p/q/k — mirroring ``main.go:52-57`` with the SDL
+window swapped for the terminal renderer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import queue
+import sys
+import threading
+
+from distributed_gol_tpu.engine.gol import start
+from distributed_gol_tpu.engine.params import Params
+from distributed_gol_tpu.engine.session import Session, default_session
+from distributed_gol_tpu.models.life import parse_rule
+from distributed_gol_tpu.utils.platform import honour_env_platforms
+from distributed_gol_tpu.viewer.keyboard import keyboard_listener
+from distributed_gol_tpu.viewer.loop import run_headless, run_terminal
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="distributed_gol_tpu",
+        add_help=False,  # -h is board height, as in the reference CLI
+        description="TPU-native distributed Game of Life engine",
+    )
+    ap.add_argument("--help", action="help", help="show this help message")
+    ap.add_argument("-t", type=int, default=8, metavar="THREADS",
+                    help="threads knob (accepted for parity; XLA owns intra-chip parallelism)")
+    ap.add_argument("-w", type=int, default=512, metavar="WIDTH")
+    ap.add_argument("-h", type=int, default=512, metavar="HEIGHT")
+    ap.add_argument("-turns", type=int, default=10_000_000_000)
+    ap.add_argument("-noVis", action="store_true", dest="no_vis")
+    ap.add_argument("--rule", default="conway", help="conway | highlife | ... | B36/S23")
+    ap.add_argument("--engine", default="roll", choices=["roll", "pallas"])
+    ap.add_argument("--superstep", type=int, default=0,
+                    help="generations per device dispatch (0 = auto)")
+    ap.add_argument("--mesh", default="1x1", metavar="NYxNX",
+                    help="device mesh shape, e.g. 2x4")
+    ap.add_argument("--images-dir", default="images")
+    ap.add_argument("--out-dir", default="out")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="durable 'q'-detach checkpoints live here")
+    ap.add_argument("--ticker", type=float, default=2.0,
+                    help="AliveCellsCount period in seconds")
+    return ap
+
+
+def params_from_args(args) -> Params:
+    ny, _, nx = args.mesh.partition("x")
+    if not (ny.isdigit() and nx.isdigit()):
+        raise ValueError(f"--mesh wants NYxNX (e.g. 2x4), got {args.mesh!r}")
+    return Params(
+        turns=args.turns,
+        threads=args.t,
+        image_width=args.w,
+        image_height=args.h,
+        no_vis=args.no_vis,
+        rule=parse_rule(args.rule),
+        superstep=args.superstep,
+        engine=args.engine,
+        mesh_shape=(int(ny), int(nx)),
+        images_dir=args.images_dir,
+        out_dir=args.out_dir,
+        ticker_period=args.ticker,
+    )
+
+
+def main(argv=None) -> int:
+    honour_env_platforms()
+    ap = build_parser()
+    args = ap.parse_args(argv)
+    try:
+        params = params_from_args(args)
+    except ValueError as e:
+        ap.error(str(e))  # clean usage error, exit 2 — not a traceback
+    session = (
+        Session(args.checkpoint_dir) if args.checkpoint_dir else default_session()
+    )
+
+    events: queue.Queue = queue.Queue()
+    key_presses: queue.Queue = queue.Queue()
+    stop = threading.Event()
+    restore_tty = keyboard_listener(key_presses, stop)
+
+    engine_thread = start(params, events, key_presses, session)
+    try:
+        if params.no_vis:
+            final = run_headless(params, events)
+        else:
+            final = run_terminal(params, events)
+    except KeyboardInterrupt:
+        key_presses.put("q")  # graceful detach, checkpoint parked on session
+        final = run_headless(params, events)
+    finally:
+        stop.set()
+        if restore_tty is not None:
+            restore_tty()
+    engine_thread.join(timeout=30)
+    if final is None:
+        # The stream ended without a FinalTurnComplete: the engine died
+        # (its traceback went to stderr).  Scripts must see the failure.
+        print("error: engine terminated without completing", file=sys.stderr)
+        return 1
+    print(f"Final turn {final.completed_turns}: {len(final.alive)} alive")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,9 @@ class Params:
             raise ValueError(f"unknown engine {self.engine!r}")
         if self.flip_events not in ("auto", "cell", "batch", "off"):
             raise ValueError(f"unknown flip_events {self.flip_events!r}")
+        ny, nx = self.mesh_shape
+        if ny < 1 or nx < 1:
+            raise ValueError(f"mesh_shape must be positive, got {self.mesh_shape}")
         if self.ticker_period <= 0:
             raise ValueError("ticker_period must be positive")
         # Paths may arrive as strings from CLI/config files.

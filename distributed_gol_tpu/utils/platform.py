@@ -1,0 +1,24 @@
+"""Platform-selection guard shared by every process entry point.
+
+Some TPU-terminal environments install a site hook that force-selects their
+own PJRT platform via ``jax.config`` *after* JAX has parsed the
+``JAX_PLATFORMS`` env var, so the env var alone silently stops working.
+Every entry point (CLI, bench, driver hooks, tests) calls this once before
+any backend initialises to make the env var authoritative again.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honour_env_platforms() -> None:
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platforms)
+    except Exception:
+        pass  # backends already initialized — too late to change, not fatal

@@ -1,0 +1,14 @@
+"""Viewer frontends: the SDL-window replacement.
+
+The reference's GUI layer is an SDL window fed by the event stream plus a
+keyboard poller (``sdl/loop.go``, ``sdl/window.go``).  SURVEY.md §2 notes the
+contract to preserve is the *event stream*, not the SDL binding — so this
+package ships a pure-terminal renderer (ANSI half-blocks, downsampling for
+big boards) and a headless drain, both consuming the same typed events; a
+keyboard thread feeds s/p/q/k to the engine exactly like the SDL poller.
+"""
+
+from distributed_gol_tpu.viewer.loop import run_headless, run_terminal
+from distributed_gol_tpu.viewer.keyboard import keyboard_listener
+
+__all__ = ["run_headless", "run_terminal", "keyboard_listener"]

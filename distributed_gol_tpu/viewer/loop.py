@@ -1,0 +1,84 @@
+"""Viewer event loops — the ``sdl.Run`` equivalents (``sdl/loop.go:9-54``).
+
+Both loops consume the typed event stream until FinalTurnComplete or the
+``None`` sentinel and print any event with a non-empty ``str()`` as
+``Completed Turns <n>       <event>`` — the same console telemetry the
+reference prints for count/state/image events (``sdl/loop.go:44-47``).
+
+``run_terminal`` additionally keeps a shadow board from CellFlipped /
+CellsFlipped events (the FlipPixel XOR, ``sdl/window.go:78-88``) and redraws
+it on TurnComplete, honouring the flips-before-TurnComplete ordering
+contract (``gol/event.go:55-58``).
+"""
+
+from __future__ import annotations
+
+import queue
+import sys
+import time
+
+import numpy as np
+
+from distributed_gol_tpu.engine.events import (
+    CellFlipped,
+    CellsFlipped,
+    FinalTurnComplete,
+    TurnComplete,
+)
+from distributed_gol_tpu.engine.params import Params
+from distributed_gol_tpu.viewer import render as R
+
+
+def _print_event(event) -> None:
+    s = str(event)
+    if s:
+        print(f"Completed Turns {event.completed_turns:<8}{s}", flush=True)
+
+
+def run_headless(params: Params, events: queue.Queue) -> FinalTurnComplete | None:
+    """Drain the stream, printing telemetry; returns the final event.
+    Equivalent of the reference's -noVis drain loop (``main.go:56-67``)."""
+    final = None
+    while True:
+        e = events.get()
+        if e is None:
+            return final
+        if isinstance(e, FinalTurnComplete):
+            final = e
+        _print_event(e)
+
+
+def run_terminal(
+    params: Params,
+    events: queue.Queue,
+    max_fps: float = 20.0,
+    out=sys.stdout,
+) -> FinalTurnComplete | None:
+    """Live ANSI rendering fed purely by the event stream."""
+    shadow = np.zeros((params.image_height, params.image_width), dtype=np.uint8)
+    final = None
+    min_dt = 1.0 / max_fps
+    last_draw = 0.0
+    out.write(R.clear_screen())
+    while True:
+        e = events.get()
+        if e is None:
+            break
+        if isinstance(e, CellFlipped):
+            shadow[e.cell.y, e.cell.x] ^= 255
+        elif isinstance(e, CellsFlipped):
+            for c in e.cells:
+                shadow[c.y, c.x] ^= 255
+        elif isinstance(e, TurnComplete):
+            now = time.monotonic()
+            if now - last_draw >= min_dt:
+                last_draw = now
+                out.write(R.home_cursor() + R.render(shadow))
+                out.write(f"\nturn {e.completed_turns}   [s]nap [p]ause [q]uit [k]ill\n")
+                out.flush()
+        elif isinstance(e, FinalTurnComplete):
+            final = e
+            _print_event(e)
+        else:
+            _print_event(e)
+    return final

@@ -65,5 +65,15 @@ def rng() -> np.random.Generator:
     return np.random.default_rng(42)
 
 
+@pytest.fixture(autouse=True)
+def _fresh_default_session():
+    """A 'q' detach parks a checkpoint on the global default session (the
+    one-broker analog); isolate tests from each other's checkpoints."""
+    yield
+    from distributed_gol_tpu.engine.session import default_session
+
+    default_session().reset()
+
+
 def random_board(rng: np.random.Generator, h: int, w: int, p: float = 0.3) -> np.ndarray:
     return np.where(rng.random((h, w)) < p, 255, 0).astype(np.uint8)

@@ -1,0 +1,92 @@
+"""CLI flag parity (main.go:17-46) and viewer loop/renderer behaviour."""
+
+import io
+import queue
+
+import numpy as np
+import pytest
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.__main__ import build_parser, main, params_from_args
+from distributed_gol_tpu.viewer import render as R
+from distributed_gol_tpu.viewer.loop import run_headless, run_terminal
+
+
+class TestParser:
+    def test_defaults_match_reference(self):
+        a = build_parser().parse_args([])
+        p = params_from_args(a)
+        # main.go defaults: t=8, w=512, h=512, turns=10^10
+        assert (p.threads, p.image_width, p.image_height) == (8, 512, 512)
+        assert p.turns == 10_000_000_000
+        assert p.no_vis is False
+
+    def test_reference_flag_spelling(self):
+        a = build_parser().parse_args(
+            ["-t", "4", "-w", "64", "-h", "32", "-turns", "7", "-noVis"]
+        )
+        p = params_from_args(a)
+        assert (p.threads, p.image_width, p.image_height, p.turns) == (4, 64, 32, 7)
+        assert p.no_vis is True
+
+    def test_h_is_height_not_help(self):
+        assert build_parser().parse_args(["-h", "128"]).h == 128
+
+    def test_tpu_extras(self):
+        a = build_parser().parse_args(
+            ["--rule", "B36/S23", "--mesh", "2x4", "--engine", "roll",
+             "--superstep", "16"]
+        )
+        p = params_from_args(a)
+        assert p.mesh_shape == (2, 4)
+        assert p.superstep == 16
+        assert p.rule.birth == frozenset({3, 6})
+
+
+class TestCliRun:
+    def test_headless_run(self, tmp_path, input_images, capsys):
+        rc = main(
+            ["-w", "16", "-h", "16", "-turns", "5", "-noVis",
+             "--images-dir", str(input_images), "--out-dir", str(tmp_path)]
+        )
+        assert rc == 0
+        assert (tmp_path / "16x16x5.pgm").exists()
+        assert "Final turn 5" in capsys.readouterr().out
+
+
+class TestRenderer:
+    def test_downsample_maxpool(self):
+        b = np.zeros((8, 8), np.uint8)
+        b[0, 0] = 255
+        small = R.downsample(b, 2, 2)
+        assert small.shape == (2, 2)
+        assert small[0, 0] == 255 and small[1, 1] == 0
+
+    def test_render_smoke(self):
+        b = np.zeros((4, 4), np.uint8)
+        b[0, 1] = 255
+        frame = R.render(b, term_size=(4, 4))
+        assert R.HALF in frame and "\x1b[" in frame
+
+    def test_terminal_loop_consumes_stream(self, tmp_path, input_images):
+        params = gol.Params(
+            turns=3, image_width=16, image_height=16,
+            images_dir=input_images, out_dir=tmp_path,
+            no_vis=False, flip_events="cell",
+        )
+        events: queue.Queue = queue.Queue()
+        gol.run(params, events)
+        out = io.StringIO()
+        final = run_terminal(params, events, max_fps=1000.0, out=out)
+        assert final is not None and final.completed_turns == 3
+        assert R.HALF in out.getvalue()
+
+    def test_headless_loop_returns_final(self, tmp_path, input_images):
+        params = gol.Params(
+            turns=2, image_width=16, image_height=16,
+            images_dir=input_images, out_dir=tmp_path,
+        )
+        events: queue.Queue = queue.Queue()
+        gol.run(params, events)
+        final = run_headless(params, events)
+        assert final is not None and final.completed_turns == 2

@@ -1,0 +1,84 @@
+"""Port of the reference's TestSdl/TestMain (sdl_test.go): the viewer-facing
+event-ordering contract.
+
+Contract (gol/event.go:55-58, sdl_test.go:58,107-116): a shadow board built
+ONLY from CellFlipped XORs must be consistent at every TurnComplete — its
+alive count equals the golden count for that turn — and all of a turn's
+flips arrive before its TurnComplete.  The reference checks 512²×100; we
+check 64²×100 per-cell (same contract, hermetic-friendly) plus the batch
+flip extension.
+"""
+
+import csv
+import queue
+
+import numpy as np
+
+import distributed_gol_tpu as gol
+
+
+def golden_counts(golden_alive, size):
+    with open(golden_alive / f"{size}x{size}.csv") as f:
+        return {int(t): int(c) for t, c in list(csv.reader(f))[1:]}
+
+
+def run_viewer_mode(size, turns, tmp_path, input_images, flip_events):
+    params = gol.Params(
+        turns=turns,
+        image_width=size,
+        image_height=size,
+        images_dir=input_images,
+        out_dir=tmp_path,
+        no_vis=False,
+        flip_events=flip_events,
+    )
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events)
+    out = []
+    while (e := events.get(timeout=60)) is not None:
+        out.append(e)
+    return out
+
+
+def check_shadow_board(events, size, counts, turns):
+    """Replays the stream exactly like the reference's replica SDL loop:
+    XOR flips into a shadow board, check the count at every TurnComplete."""
+    shadow = np.zeros((size, size), dtype=np.uint8)
+    turns_seen = 0
+    for e in events:
+        if isinstance(e, gol.CellFlipped):
+            shadow[e.cell.y, e.cell.x] ^= 255
+        elif isinstance(e, gol.CellsFlipped):
+            for c in e.cells:
+                shadow[c.y, c.x] ^= 255
+        elif isinstance(e, gol.TurnComplete):
+            turns_seen += 1
+            assert e.completed_turns == turns_seen, "TurnComplete out of order"
+            got = int(np.count_nonzero(shadow))
+            assert got == counts[e.completed_turns], (
+                f"shadow board count {got} != golden "
+                f"{counts[e.completed_turns]} at turn {e.completed_turns}"
+            )
+        elif isinstance(e, gol.FinalTurnComplete):
+            final_alive = {(c.x, c.y) for c in e.alive}
+            from_shadow = {
+                (int(x), int(y)) for y, x in zip(*np.nonzero(shadow))
+            }
+            assert final_alive == from_shadow, "final alive set != shadow board"
+    assert turns_seen == turns
+
+
+def test_per_cell_flip_contract(tmp_path, input_images, golden_alive):
+    events = run_viewer_mode(64, 100, tmp_path, input_images, "cell")
+    check_shadow_board(events, 64, golden_counts(golden_alive, 64), 100)
+
+
+def test_batch_flip_contract(tmp_path, input_images, golden_alive):
+    events = run_viewer_mode(64, 100, tmp_path, input_images, "batch")
+    check_shadow_board(events, 64, golden_counts(golden_alive, 64), 100)
+
+
+def test_flips_512_smoke(tmp_path, input_images, golden_alive):
+    """The reference's actual size, batch mode for speed, fewer turns."""
+    events = run_viewer_mode(512, 10, tmp_path, input_images, "batch")
+    check_shadow_board(events, 512, golden_counts(golden_alive, 512), 10)

@@ -1,0 +1,121 @@
+"""Port of the reference's TestAlive (count_test.go): the AliveCellsCount
+telemetry stream.
+
+Contract: events carry (completed_turns, count) pairs where count is exactly
+the alive count at that turn (our engine reports exact pairs; the reference
+latched one behind, quirk Q7, which its own test tolerated only because it
+indexes by the event's turn).  Golden series: check/alive/WxH.csv turns
+1..10000; beyond 10000 the 512² board is a period-2 oscillator (5565 even /
+5567 odd, count_test.go:45-51).
+"""
+
+import csv
+import queue
+import threading
+import time
+
+import pytest
+
+import distributed_gol_tpu as gol
+
+
+def read_alive_csv(path):
+    with open(path) as f:
+        rows = list(csv.reader(f))
+    return {int(t): int(c) for t, c in rows[1:]}
+
+
+def expected_count(expected: dict, turn: int, size: int) -> int | None:
+    if turn == 0:
+        return None  # pre-first-turn tick; CSV starts at turn 1
+    if turn <= 10_000:
+        return expected[turn]
+    if size == 512:
+        return 5567 if turn % 2 else 5565
+    return None
+
+
+def test_alive_counts_cadence_and_values(tmp_path, input_images, golden_alive):
+    """The reference's shape: long run (Turns=1e8), 2s default ticker, first
+    count event within a 5s watchdog, first events checked against the CSV,
+    then a 'q' graceful quit (count_test.go:19-68)."""
+    expected = read_alive_csv(golden_alive / "512x512.csv")
+    params = gol.Params(
+        turns=10**8,
+        image_width=512,
+        image_height=512,
+        images_dir=input_images,
+        out_dir=tmp_path,
+    )
+    events: queue.Queue = queue.Queue()
+    keys: queue.Queue = queue.Queue()
+    t = gol.start(params, events, keys)
+
+    deadline = time.monotonic() + 5.0  # the 5-second watchdog
+    counts_seen = 0
+    while counts_seen < 3:
+        timeout = (
+            deadline - time.monotonic() if counts_seen == 0 else 30.0
+        )
+        assert timeout > 0, "no AliveCellsCount within 5s of start"
+        e = events.get(timeout=timeout)
+        assert e is not None, "stream ended before any count event"
+        if isinstance(e, gol.AliveCellsCount):
+            counts_seen += 1
+            exp = expected_count(expected, e.completed_turns, 512)
+            if exp is not None:
+                assert e.cells_count == exp, f"turn {e.completed_turns}"
+    keys.put("q")  # graceful quit, also exercises the detach path
+    t.join(timeout=60)
+    assert not t.is_alive()
+    # Drain to the sentinel; a FinalTurnComplete must be present.
+    finals = []
+    while (e := events.get(timeout=30)) is not None:
+        if isinstance(e, gol.FinalTurnComplete):
+            finals.append(e)
+    assert len(finals) == 1
+
+
+def test_fast_ticker_exact_pairs(tmp_path, input_images, golden_alive):
+    """Every (turn, count) pair the ticker ever emits matches the golden
+    series — run bounded so all turns stay within the CSV."""
+    expected = read_alive_csv(golden_alive / "64x64.csv")
+    params = gol.Params(
+        turns=3000,
+        image_width=64,
+        image_height=64,
+        images_dir=input_images,
+        out_dir=tmp_path,
+        ticker_period=0.02,
+        superstep=2,
+    )
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events)
+    checked = 0
+    while (e := events.get(timeout=30)) is not None:
+        if isinstance(e, gol.AliveCellsCount):
+            exp = expected_count(expected, e.completed_turns, 64)
+            if exp is not None:
+                assert e.cells_count == exp, f"turn {e.completed_turns}"
+                checked += 1
+    assert checked >= 3, "ticker produced too few checkable events"
+
+
+def test_turn_complete_stream_is_dense(tmp_path, input_images):
+    """TurnComplete events are emitted for every turn in order, regardless
+    of superstep batching."""
+    params = gol.Params(
+        turns=137,
+        image_width=16,
+        image_height=16,
+        images_dir=input_images,
+        out_dir=tmp_path,
+        superstep=10,
+    )
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events)
+    turns = []
+    while (e := events.get(timeout=30)) is not None:
+        if isinstance(e, gol.TurnComplete):
+            turns.append(e.completed_turns)
+    assert turns == list(range(1, 138))

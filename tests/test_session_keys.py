@@ -1,0 +1,242 @@
+"""Keypress semantics + pause/detach/resume checkpointing.
+
+Behavioural spec: gol/distributor.go:105-151 (keypress manager),
+broker/broker.go:124-155 (pause/CheckStates contract).  The reference never
+tests these paths in isolation (SURVEY.md §4: no unit tests); these are the
+added hermetic coverage.
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.engine.pgm import read_pgm
+from distributed_gol_tpu.engine.session import Checkpoint, Session
+
+
+def make_params(tmp_path, input_images, **kw):
+    defaults = dict(
+        turns=10**6,
+        image_width=16,
+        image_height=16,
+        images_dir=input_images,
+        out_dir=tmp_path,
+        ticker_period=0.2,
+        superstep=5,
+    )
+    defaults.update(kw)
+    return gol.Params(**defaults)
+
+
+def start_run(params, session):
+    events: queue.Queue = queue.Queue()
+    keys: queue.Queue = queue.Queue()
+    thread = gol.start(params, events, keys, session)
+    return events, keys, thread
+
+
+def drain(events):
+    out = []
+    while (e := events.get(timeout=30)) is not None:
+        out.append(e)
+    return out
+
+
+def wait_for_turns(events, min_turn, collected, timeout=30):
+    """Consume events until a TurnComplete >= min_turn is seen."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            e = events.get(timeout=0.5)
+        except queue.Empty:
+            continue
+        if e is None:
+            raise AssertionError("stream ended early")
+        collected.append(e)
+        if isinstance(e, gol.TurnComplete) and e.completed_turns >= min_turn:
+            return
+    raise AssertionError(f"no TurnComplete >= {min_turn} within {timeout}s")
+
+
+class TestPause:
+    def test_pause_stops_stepping_and_resume_continues(
+        self, tmp_path, input_images
+    ):
+        session = Session()
+        events, keys, thread = start_run(
+            make_params(tmp_path, input_images), session
+        )
+        seen = []
+        wait_for_turns(events, 10, seen)
+        keys.put("p")
+        # Find the StateChange{Paused}; note the turn at which it paused.
+        deadline = time.monotonic() + 10
+        paused_evt = None
+        while paused_evt is None and time.monotonic() < deadline:
+            e = events.get(timeout=5)
+            assert e is not None
+            seen.append(e)
+            if isinstance(e, gol.StateChange) and e.new_state is gol.State.PAUSED:
+                paused_evt = e
+        assert paused_evt is not None
+        assert session.paused
+        # While paused, no new TurnComplete events appear...
+        time.sleep(0.6)
+        frozen = [
+            e
+            for e in _drain_nonblocking(events)
+            if isinstance(e, gol.TurnComplete)
+        ]
+        max_frozen = max(
+            [e.completed_turns for e in frozen], default=paused_evt.completed_turns
+        )
+        time.sleep(0.6)
+        later = _drain_nonblocking(events)
+        assert not any(isinstance(e, gol.TurnComplete) for e in later)
+        # ...but the ticker still ticks (reference: ticker runs during pause).
+        time.sleep(0.5)
+        assert any(
+            isinstance(e, gol.AliveCellsCount) for e in _drain_nonblocking(events)
+        )
+        keys.put("p")  # resume
+        more = []
+        wait_for_turns(events, max_frozen + 1, more)
+        assert any(
+            isinstance(e, gol.StateChange) and e.new_state is gol.State.EXECUTING
+            for e in more
+        )
+        keys.put("k")
+        thread.join(timeout=30)
+        assert not thread.is_alive()
+
+
+class TestSnapshot:
+    def test_s_writes_current_board(self, tmp_path, input_images, golden_images):
+        """'s' at a known turn: snapshot must equal the golden board for that
+        turn (we pause first so the turn is deterministic)."""
+        session = Session()
+        params = make_params(tmp_path, input_images, superstep=1, turns=100)
+        events, keys, thread = start_run(params, session)
+        seen = []
+        wait_for_turns(events, 1, seen)
+        keys.put("p")
+        time.sleep(0.5)
+        keys.put("s")
+        keys.put("p")
+        thread.join(timeout=60)
+        imgs = [e for e in drain(events) if isinstance(e, gol.ImageOutputComplete)]
+        assert imgs, "no ImageOutputComplete after 's'"
+        snap_turn = imgs[0].completed_turns
+        snap = read_pgm(tmp_path / f"{imgs[0].filename}.pgm")
+        assert imgs[0].filename == f"16x16x{snap_turn}current"
+        if snap_turn in (0, 1, 100):
+            golden = read_pgm(golden_images / f"16x16x{snap_turn}.pgm")
+            np.testing.assert_array_equal(snap, golden)
+
+
+class TestDetachResume:
+    def test_q_then_resume_in_memory(self, tmp_path, input_images):
+        session = Session()
+        events, keys, thread = start_run(
+            make_params(tmp_path, input_images), session
+        )
+        seen = []
+        wait_for_turns(events, 20, seen)
+        keys.put("q")
+        thread.join(timeout=30)
+        all_events = seen + drain(events)
+        final = [e for e in all_events if isinstance(e, gol.FinalTurnComplete)][0]
+        detach_turn = final.completed_turns
+        assert final.alive == ()  # detach carries no board (quirk Q2 semantics)
+        assert any(
+            isinstance(e, gol.StateChange) and e.new_state is gol.State.QUITTING
+            for e in all_events
+        )
+        # New controller with the same session: resumes at detach_turn + 1.
+        params2 = make_params(
+            tmp_path, input_images, turns=detach_turn + 10, superstep=1
+        )
+        events2: queue.Queue = queue.Queue()
+        gol.run(params2, events2, None, session)
+        log2 = drain(events2)
+        first_tc = [e for e in log2 if isinstance(e, gol.TurnComplete)][0]
+        assert first_tc.completed_turns == detach_turn + 1
+        final2 = [e for e in log2 if isinstance(e, gol.FinalTurnComplete)][0]
+        assert final2.completed_turns == detach_turn + 10
+
+    def test_resume_requires_matching_size(self, tmp_path, input_images):
+        session = Session()
+        session.pause(True, world=np.zeros((32, 32), np.uint8), turn=7)
+        # 16x16 params: size mismatch -> fresh start from the input PGM
+        # (broker/broker.go:131-135 SameSize=false path).
+        params = make_params(tmp_path, input_images, turns=3, superstep=1)
+        events: queue.Queue = queue.Queue()
+        gol.run(params, events, None, session)
+        log = drain(events)
+        first_tc = [e for e in log if isinstance(e, gol.TurnComplete)][0]
+        assert first_tc.completed_turns == 1  # started from turn 0
+
+    def test_resume_consumed_exactly_once(self, tmp_path, input_images):
+        session = Session()
+        session.pause(True, world=np.zeros((16, 16), np.uint8), turn=5)
+        ck = session.check_states(16, 16)
+        assert ck is not None and ck.turn == 5
+        assert session.check_states(16, 16) is None  # paused flag cleared
+
+    def test_durable_checkpoint_across_processes(self, tmp_path, input_images):
+        """'q' with a checkpoint_dir: a brand-new Session (new process
+        analog) resumes from disk; the checkpoint is consumed exactly once."""
+        ckpt_dir = tmp_path / "ckpt"
+        s1 = Session(ckpt_dir)
+        events, keys, thread = start_run(
+            make_params(tmp_path, input_images), s1
+        )
+        seen = []
+        wait_for_turns(events, 10, seen)
+        keys.put("q")
+        thread.join(timeout=30)
+        final = [
+            e
+            for e in seen + drain(events)
+            if isinstance(e, gol.FinalTurnComplete)
+        ][0]
+        s2 = Session(ckpt_dir)  # "new process"
+        ck = s2.check_states(16, 16)
+        assert ck is not None and ck.turn == final.completed_turns
+        s3 = Session(ckpt_dir)  # resumed already consumed the paused flag
+        assert s3.check_states(16, 16) is None
+
+
+class TestKill:
+    def test_k_snapshots_and_shuts_down(self, tmp_path, input_images):
+        session = Session()
+        events, keys, thread = start_run(
+            make_params(tmp_path, input_images), session
+        )
+        seen = []
+        wait_for_turns(events, 5, seen)
+        keys.put("k")
+        thread.join(timeout=30)
+        log = seen + drain(events)
+        imgs = [e for e in log if isinstance(e, gol.ImageOutputComplete)]
+        assert imgs and (tmp_path / f"{imgs[-1].filename}.pgm").exists()
+        assert [e for e in log if isinstance(e, gol.FinalTurnComplete)]
+        assert session.is_shutdown
+        # After 'k' nothing can resume (broker + workers are gone).
+        assert session.check_states(16, 16) is None
+
+
+def _drain_nonblocking(events):
+    out = []
+    while True:
+        try:
+            e = events.get_nowait()
+        except queue.Empty:
+            return out
+        if e is None:
+            raise AssertionError("unexpected stream end")
+        out.append(e)
