@@ -41,19 +41,24 @@ class Backend:
         if (ny, nx) == (1, 1):
             self.mesh = None
             self._sharding = None
+            use_pallas = False
             if params.engine == "pallas":
+                shape = (params.image_height, params.image_width)
                 try:
                     from distributed_gol_tpu.ops import pallas_stencil
-                except ImportError as e:
-                    raise NotImplementedError(
-                        "engine='pallas' kernel not available in this build"
-                    ) from e
 
+                    use_pallas = pallas_stencil.supports(shape)
+                except ImportError:
+                    use_pallas = False  # stripped jax build: roll still works
+            if use_pallas:
                 self._superstep = pallas_stencil.make_superstep(params.rule)
                 self._steps_with_counts = pallas_stencil.make_steps_with_counts(
                     params.rule
                 )
             else:
+                # engine='pallas' on a board the kernel's TPU layout rules
+                # can't tile (W % 128 != 0 or indivisible H) falls back to
+                # the roll stencil — bit-identical, just not hand-tiled.
                 self._superstep = lambda b, k: stencil.superstep(b, self.table, k)
                 self._steps_with_counts = lambda b, k: stencil.steps_with_counts(
                     b, self.table, k

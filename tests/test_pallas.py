@@ -1,0 +1,105 @@
+"""Pallas stencil kernel: bit-identity vs the roll stencil and the oracle.
+
+On CPU these run in interpret mode (the kernel's hermetic gate, SURVEY.md §7
+stage 5); the same kernel compiles via Mosaic on TPU, where bench.py
+compares it against the roll baseline.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.models.life import CONWAY, DAY_AND_NIGHT, HIGHLIFE, SEEDS
+from distributed_gol_tpu.ops import pallas_stencil as ps
+from distributed_gol_tpu.ops.stencil import steps_with_counts, superstep
+from tests.conftest import random_board
+from tests.oracle import oracle_step
+
+
+class TestSupports:
+    def test_lane_rule(self):
+        assert ps.supports((512, 512))
+        assert ps.supports((8, 128))
+        assert ps.supports((100, 128))  # tile_h=100 (whole board) is legal
+        assert not ps.supports((16, 16))  # W % 128 != 0
+        assert not ps.supports((7, 128))  # H below the minimum tile height
+
+    def test_build_rejects_unsupported(self):
+        with pytest.raises(ValueError):
+            ps._build_step((16, 16), CONWAY, True)
+
+
+class TestBitIdentity:
+    @pytest.mark.parametrize(
+        "shape", [(8, 128), (64, 256), (512, 512), (96, 384), (100, 128)]
+    )
+    def test_step_vs_roll(self, rng, shape):
+        b = random_board(rng, *shape)
+        table = jnp.asarray(CONWAY.table)
+        roll_out = np.asarray(superstep(jnp.asarray(b), table, 1))
+        pallas_out = np.asarray(ps.make_step_fn()(jnp.asarray(b)))
+        np.testing.assert_array_equal(pallas_out, roll_out)
+
+    @pytest.mark.parametrize("rule", [HIGHLIFE, SEEDS, DAY_AND_NIGHT], ids=str)
+    def test_rules_vs_oracle(self, rng, rule):
+        b = random_board(rng, 64, 128)
+        out = np.asarray(ps.make_step_fn(rule)(jnp.asarray(b)))
+        np.testing.assert_array_equal(out, oracle_step(b, rule))
+
+    def test_superstep_and_counts(self, rng):
+        b = random_board(rng, 128, 128)
+        table = jnp.asarray(CONWAY.table)
+        ref_final, ref_counts = steps_with_counts(jnp.asarray(b), table, 20)
+        fin, counts = ps.make_steps_with_counts()(jnp.asarray(b), 20)
+        np.testing.assert_array_equal(np.asarray(fin), np.asarray(ref_final))
+        np.testing.assert_array_equal(np.asarray(counts), np.asarray(ref_counts))
+
+    def test_wrap_seams(self):
+        """Gliders crossing every tile boundary and the torus seam: 512-tall
+        board forces multiple tiles; run long enough to cross them."""
+        b = np.zeros((512, 128), dtype=np.uint8)
+        # glider headed down-right
+        for x, y in [(1, 0), (2, 1), (0, 2), (1, 2), (2, 2)]:
+            b[y, x] = 255
+        table = jnp.asarray(CONWAY.table)
+        roll_b, pallas_b = jnp.asarray(b), jnp.asarray(b)
+        sstep = ps.make_superstep()
+        for _ in range(60):
+            roll_b = superstep(roll_b, table, 16)
+            pallas_b = sstep(pallas_b, 16)
+        np.testing.assert_array_equal(np.asarray(pallas_b), np.asarray(roll_b))
+        assert int(np.asarray(pallas_b).sum()) // 255 == 5  # glider intact
+
+
+class TestEngineSelection:
+    def test_pallas_engine_golden_512(self, tmp_path, input_images, golden_images):
+        """Full run() with engine='pallas' on the 512² golden path."""
+        import queue
+
+        p = gol.Params(
+            turns=100, image_width=512, image_height=512,
+            images_dir=input_images, out_dir=tmp_path, engine="pallas",
+        )
+        events: queue.Queue = queue.Queue()
+        gol.run(p, events)
+        while events.get(timeout=60) is not None:
+            pass
+        assert (tmp_path / "512x512x100.pgm").read_bytes() == (
+            golden_images / "512x512x100.pgm"
+        ).read_bytes()
+
+    def test_pallas_engine_falls_back_small_board(self, tmp_path, input_images, golden_images):
+        import queue
+
+        p = gol.Params(
+            turns=100, image_width=16, image_height=16,
+            images_dir=input_images, out_dir=tmp_path, engine="pallas",
+        )
+        events: queue.Queue = queue.Queue()
+        gol.run(p, events)
+        while events.get(timeout=60) is not None:
+            pass
+        assert (tmp_path / "16x16x100.pgm").read_bytes() == (
+            golden_images / "16x16x100.pgm"
+        ).read_bytes()
