@@ -35,6 +35,17 @@ def make_board(size: int, seed: int = 0) -> np.ndarray:
     return np.where(rng.random((size, size)) < 0.3, 255, 0).astype(np.uint8)
 
 
+def _sync(board):
+    """Force completion of everything `board` depends on.
+
+    `jax.block_until_ready` can return before remote execution finishes on
+    tunnelled TPU runtimes; a device_get of one element is a data-dependent
+    fetch and therefore a true barrier (1-byte transfer)."""
+    import jax
+
+    return np.asarray(jax.device_get(board[0, 0]))
+
+
 def bench_config(size: int, kturns: int, engine: str, reps: int):
     """Time `reps` supersteps of `kturns` generations each; returns
     (gens_per_sec, cell_updates_per_sec)."""
@@ -60,13 +71,14 @@ def bench_config(size: int, kturns: int, engine: str, reps: int):
         run = lambda b: superstep(b, table, kturns)
 
     t0 = time.perf_counter()
-    board = jax.block_until_ready(run(board))  # compile + warm up
+    board = run(board)  # compile + warm up
+    _sync(board)
     log(f"  compile+first superstep: {time.perf_counter() - t0:.2f}s")
 
     t0 = time.perf_counter()
     for _ in range(reps):
         board = run(board)
-    jax.block_until_ready(board)
+    _sync(board)  # data-dependent fetch: waits for the whole dispatch chain
     dt = time.perf_counter() - t0
     gens = reps * kturns
     gps = gens / dt

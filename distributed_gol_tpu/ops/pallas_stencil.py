@@ -1,29 +1,39 @@
-"""Pallas TPU stencil kernel — the tuned single-chip generation engine.
+"""Pallas TPU stencil kernel — the tuned byte-board generation engine.
 
 Same behavioural spec as ``ops/stencil.py`` (B/S rule, toroidal wrap, uint8
 {0,255} cells; reference kernel ``server/server.go:33-75``), but built for
 the TPU memory hierarchy instead of leaning on XLA's roll lowering:
 
 - The board stays in HBM (``memory_space=ANY``); each grid step DMAs one
-  row-tile plus its two wrap halo rows into a VMEM scratch — three async
-  copies with mod-H source indices, so the torus needs no padded copy and
-  no materialised ``jnp.roll`` arrays.  HBM traffic per generation is
-  ~(1 + 2/TILE_H) reads + 1 write of the board, the bandwidth floor for a
-  one-generation-per-pass stencil.
-- In-VMEM compute is uint8/bool only (VPU-native): separable 3-row sum,
-  then column neighbours via ``pltpu.roll`` on the full-width tile (full
-  rows in VMEM means the x-wrap is globally correct), then the rule as
-  static ``n == k`` comparisons unrolled from the (compile-time) rule sets
-  — no gathers, no int32 blow-up, no branches.
+  row-tile plus an 8-row wrap halo above and below into a VMEM scratch —
+  three async copies whose source offsets are ``tile_index * TILE_H +
+  const·8`` so Mosaic can prove the (8, 128) tiling alignment of every HBM
+  slice (real-hardware constraint; arbitrary ``rem`` offsets are rejected
+  with "failed to prove divisibility").
+- In-VMEM compute widens the alive bits to int32 immediately: Mosaic's
+  vector ALUs accept only i16/i32 arithmetic and ``tpu.dynamic_rotate``
+  (``pltpu.roll``) is 32-bit only — vector<i8> math does NOT compile on
+  real TPUs (it does in interpret mode, which is why CPU tests alone can't
+  gate this kernel).  The neighbour sum is separable: a 3-row vertical sum
+  via sublane rolls, then a 3-column horizontal sum via lane rolls (full
+  rows in VMEM make the x-wrap globally correct; the 8-row halo makes the
+  tile-local vertical roll correct for every kept row).
+- The rule is evaluated arithmetically — ``Σ_b (n==b)·(1-a) + Σ_s (n==s)·a``
+  with mutually exclusive terms — because Mosaic rejects vector<i1> selects
+  against uint8 constants (relayout limitation); comparisons are cast to
+  int32 the moment they are produced.
 
 The rule generality matches ``models.life.LifeRule``: any outer-totalistic
 B/S rule compiles to the same kernel with different comparison constants.
 
-Boards must have W % 128 == 0 and H divisible by a tile height ≥ 8 (TPU
-lane/sublane layout); ``supports(shape)`` reports eligibility and the
-engine falls back to the roll stencil otherwise (small boards are host-
-latency-bound anyway).  On CPU the kernel runs in interpret mode so tests
-stay hermetic.
+Boards must have W % 128 == 0 and H divisible by a multiple-of-8 tile
+height; ``supports(shape)`` reports eligibility and the engine falls back
+to the roll stencil otherwise (small boards are host-latency-bound anyway).
+On CPU the kernel runs in interpret mode so tests stay hermetic.
+
+For the fastest single-chip engine see ``ops/pallas_packed.py`` (bit-packed
+SWAR); this byte kernel is kept as the simplest hardware-validated Pallas
+path and as the fallback when the board width is not a multiple of 1024.
 """
 
 from __future__ import annotations
@@ -38,9 +48,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 from distributed_gol_tpu.models.life import CONWAY, LifeRule
 
-# Per-tile uint8 budget for the (TILE_H + 2, W) scratch; intermediates are
-# also uint8/bool so a ~1 MiB tile keeps everything comfortably in VMEM.
-_TILE_BYTES = 1 << 20
+# VMEM budget for one grid step: the uint8 (TILE_H + 16, W) tile plus ~3
+# live int32 intermediates of the same shape ≈ 13 bytes per tile cell.
+# Default scoped-VMEM limit on v5e is 16 MiB; 12 MiB leaves headroom for
+# Mosaic's own spills (measured: TILE_H=32 @ 16384² fits and runs).
+_VMEM_BUDGET = 12 << 20
+_BYTES_PER_CELL = 13
+_HALO = 8  # sublane tiling is 8 rows; a 1-row halo would be unaligned
 _MIN_TILE_H = 8
 _LANES = 128
 
@@ -51,54 +65,64 @@ def supports(shape: tuple[int, int]) -> bool:
 
 
 def _pick_tile_h(h: int, w: int) -> int | None:
-    """Largest divisor of h with tile_h * w <= budget and tile_h >= 8."""
+    """Largest multiple-of-8 divisor of h fitting the VMEM budget."""
     best = None
-    cap = max(_MIN_TILE_H, _TILE_BYTES // max(w, 1))
-    for th in range(_MIN_TILE_H, min(h, cap) + 1):
-        if h % th == 0:
+    for th in range(_MIN_TILE_H, h + 1, 8):
+        if h % th == 0 and _BYTES_PER_CELL * (th + 2 * _HALO) * w <= _VMEM_BUDGET:
             best = th
     return best
 
 
-def _apply_rule_static(alive_bool, counts, rule: LifeRule):
-    """Unrolled rule: OR of n==k comparisons from the static B/S sets."""
-    false = jnp.zeros_like(alive_bool)
-    born = functools.reduce(
-        jnp.logical_or, [counts == b for b in sorted(rule.birth)], false
-    )
-    surv = functools.reduce(
-        jnp.logical_or, [counts == s for s in sorted(rule.survive)], false
-    )
-    return jnp.where(alive_bool, surv, born)
+def _rule_terms(alive_i32, counts_i32, rule: LifeRule):
+    """Next-gen alive bit (int32 0/1) as a sum of mutually exclusive
+    arithmetic terms — no vector<i1> survives into a select/store."""
+    nxt = jnp.zeros_like(counts_i32)
+    dead = 1 - alive_i32
+    for b in sorted(rule.birth):
+        nxt = nxt + (counts_i32 == b).astype(jnp.int32) * dead
+    for s in sorted(rule.survive):
+        nxt = nxt + (counts_i32 == s).astype(jnp.int32) * alive_i32
+    return nxt
 
 
-def _stencil_kernel(x_hbm, o_ref, tile, sems, *, tile_h: int, height: int, rule: LifeRule):
+def _stencil_kernel(
+    x_hbm, o_ref, tile, sems, *, tile_h: int, grid: int, rule: LifeRule
+):
     i = pl.program_id(0)
-    top = jax.lax.rem(i * tile_h - 1 + height, height)
-    bot = jax.lax.rem(i * tile_h + tile_h, height)
+    # Wrap halo source offsets expressed as tile_index * tile_h + k·8 so
+    # every HBM slice offset is provably 8-divisible.
+    top = jax.lax.rem(i + grid - 1, grid) * tile_h + (tile_h - _HALO)
+    bot = jax.lax.rem(i + 1, grid) * tile_h
 
-    main = pltpu.make_async_copy(
-        x_hbm.at[pl.ds(i * tile_h, tile_h), :], tile.at[pl.ds(1, tile_h), :], sems.at[0]
-    )
-    halo_top = pltpu.make_async_copy(
-        x_hbm.at[pl.ds(top, 1), :], tile.at[pl.ds(0, 1), :], sems.at[1]
-    )
-    halo_bot = pltpu.make_async_copy(
-        x_hbm.at[pl.ds(bot, 1), :], tile.at[pl.ds(tile_h + 1, 1), :], sems.at[2]
-    )
-    main.start()
-    halo_top.start()
-    halo_bot.start()
-    main.wait()
-    halo_top.wait()
-    halo_bot.wait()
+    copies = [
+        pltpu.make_async_copy(
+            x_hbm.at[pl.ds(i * tile_h, tile_h), :],
+            tile.at[pl.ds(_HALO, tile_h), :],
+            sems.at[0],
+        ),
+        pltpu.make_async_copy(
+            x_hbm.at[pl.ds(top, _HALO), :], tile.at[pl.ds(0, _HALO), :], sems.at[1]
+        ),
+        pltpu.make_async_copy(
+            x_hbm.at[pl.ds(bot, _HALO), :],
+            tile.at[pl.ds(tile_h + _HALO, _HALO), :],
+            sems.at[2],
+        ),
+    ]
+    for c in copies:
+        c.start()
+    for c in copies:
+        c.wait()
 
-    a = tile[:] & 1  # alive bits of the (tile_h + 2, W) window
-    rows = a[:-2, :] + a[1:-1, :] + a[2:, :]  # 3-row window sums, (tile_h, W)
-    w = rows.shape[1]
-    counts = rows + pltpu.roll(rows, 1, 1) + pltpu.roll(rows, w - 1, 1) - a[1:-1, :]
-    alive = a[1:-1, :] == 1
-    o_ref[:] = _apply_rule_static(alive, counts, rule).astype(jnp.uint8) * 255
+    a = tile[:].astype(jnp.int32) & 1  # alive bits, (tile_h + 16, W)
+    hh, w = a.shape
+    # Vertical 3-row sum via sublane rolls: wrong only in the outermost halo
+    # rows, which are never kept.  Horizontal via lane rolls: full rows in
+    # VMEM, so the x-wrap is the true torus wrap.
+    rows = a + pltpu.roll(a, 1, 0) + pltpu.roll(a, hh - 1, 0)
+    counts = rows + pltpu.roll(rows, 1, 1) + pltpu.roll(rows, w - 1, 1) - a
+    nxt = _rule_terms(a, counts, rule)
+    o_ref[:] = (nxt[_HALO : _HALO + tile_h, :] * 255).astype(jnp.uint8)
 
 
 def _use_interpret() -> bool:
@@ -112,18 +136,19 @@ def _build_step(shape: tuple[int, int], rule: LifeRule, interpret: bool):
     if tile_h is None or w % _LANES:
         raise ValueError(
             f"pallas stencil needs W % {_LANES} == 0 and H divisible by a "
-            f"tile height >= {_MIN_TILE_H}; got {h}x{w} "
+            f"multiple-of-8 tile height; got {h}x{w} "
             f"(use supports() / the roll engine)"
         )
-    kernel = partial(_stencil_kernel, tile_h=tile_h, height=h, rule=rule)
+    grid = h // tile_h
+    kernel = partial(_stencil_kernel, tile_h=tile_h, grid=grid, rule=rule)
     return pl.pallas_call(
         kernel,
-        grid=(h // tile_h,),
+        grid=(grid,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((tile_h, w), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((h, w), jnp.uint8),
         scratch_shapes=[
-            pltpu.VMEM((tile_h + 2, w), jnp.uint8),
+            pltpu.VMEM((tile_h + 2 * _HALO, w), jnp.uint8),
             pltpu.SemaphoreType.DMA((3,)),
         ],
         interpret=interpret,

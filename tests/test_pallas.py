@@ -21,7 +21,9 @@ class TestSupports:
     def test_lane_rule(self):
         assert ps.supports((512, 512))
         assert ps.supports((8, 128))
-        assert ps.supports((100, 128))  # tile_h=100 (whole board) is legal
+        # Real-TPU constraint: HBM slice offsets must be 8-aligned, so H
+        # needs a multiple-of-8 tile height — H % 8 != 0 is unsupported.
+        assert not ps.supports((100, 128))
         assert not ps.supports((16, 16))  # W % 128 != 0
         assert not ps.supports((7, 128))  # H below the minimum tile height
 
@@ -32,7 +34,7 @@ class TestSupports:
 
 class TestBitIdentity:
     @pytest.mark.parametrize(
-        "shape", [(8, 128), (64, 256), (512, 512), (96, 384), (100, 128)]
+        "shape", [(8, 128), (64, 256), (512, 512), (96, 384), (104, 128)]
     )
     def test_step_vs_roll(self, rng, shape):
         b = random_board(rng, *shape)
