@@ -12,8 +12,8 @@ reference itself publishes no numbers).
 
 Extra diagnostics go to stderr; stdout carries exactly the one JSON line.
 
-Usage: python bench.py [--size N] [--kturns K] [--engine roll|pallas|auto]
-                       [--reps R] [--all]
+Usage: python bench.py [--size N] [--kturns K]
+                       [--engine auto|roll|pallas|packed] [--reps R] [--all]
 """
 
 from __future__ import annotations
@@ -65,6 +65,13 @@ def bench_config(size: int, kturns: int, engine: str, reps: int):
 
         superstep = pallas_stencil.make_superstep(CONWAY)
         run = lambda b: superstep(b, kturns)
+    elif engine == "packed":
+        # Board lives bit-packed on device (32 cells/uint32); pack/unpack are
+        # outside the timed loop, as a real long run would hold packed state.
+        from distributed_gol_tpu.ops import packed
+
+        board = packed.pack(board)
+        run = lambda b: packed.superstep(b, CONWAY, kturns)
     else:
         from distributed_gol_tpu.ops.stencil import superstep
 
@@ -90,8 +97,17 @@ def bench_config(size: int, kturns: int, engine: str, reps: int):
 
 
 def pick_engine(requested: str, size: int) -> str:
-    """Resolve 'auto' and downgrade 'pallas' to 'roll' when the kernel can't
-    tile the board — the metric name must record the engine actually run."""
+    """Resolve 'auto' and downgrade unsupported engines — the metric name
+    must record the engine actually run.  'auto' prefers the bit-packed SWAR
+    engine (fastest on every platform), then the byte Pallas kernel on TPU."""
+    from distributed_gol_tpu.ops import packed
+
+    if requested in ("auto", "packed"):
+        if packed.supports((size, size)):
+            return "packed"
+        if requested == "packed":
+            log(f"packed needs W % 32 == 0; {size}x{size} falls back to roll")
+            return "roll"
     try:
         from distributed_gol_tpu.ops import pallas_stencil
     except ImportError:
@@ -143,7 +159,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=16384)
     ap.add_argument("--kturns", type=int, default=256)
-    ap.add_argument("--engine", default="auto", choices=["auto", "roll", "pallas"])
+    ap.add_argument(
+        "--engine", default="auto", choices=["auto", "roll", "pallas", "packed"]
+    )
     ap.add_argument("--reps", type=int, default=4)
     ap.add_argument("--all", action="store_true", help="also bench 512/4096 configs")
     args = ap.parse_args()

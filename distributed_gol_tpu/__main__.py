@@ -42,7 +42,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-turns", type=int, default=10_000_000_000)
     ap.add_argument("-noVis", action="store_true", dest="no_vis")
     ap.add_argument("--rule", default="conway", help="conway | highlife | ... | B36/S23")
-    ap.add_argument("--engine", default="roll", choices=["roll", "pallas"])
+    ap.add_argument(
+        "--engine", default="auto", choices=["auto", "roll", "pallas", "packed"]
+    )
     ap.add_argument("--superstep", type=int, default=0,
                     help="generations per device dispatch (0 = auto)")
     ap.add_argument("--mesh", default="1x1", metavar="NYxNX",

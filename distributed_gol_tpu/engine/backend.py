@@ -22,11 +22,18 @@ from distributed_gol_tpu.parallel import halo, mesh as mesh_lib
 
 
 class Backend:
-    """Holds compiled step programs for one (rule, engine, mesh) config."""
+    """Holds compiled step programs for one (rule, engine, mesh) config.
+
+    ``params.engine`` requests an engine; ``self.engine_used`` records what
+    actually runs after capability fallbacks (e.g. the packed SWAR engine
+    needs W % 32 == 0 per device, the byte Pallas kernel W % 128 == 0).
+    "auto" prefers packed (fastest everywhere) then pallas (TPU) then roll.
+    """
 
     def __init__(self, params: Params, devices=None):
         self.params = params
         self.table = jnp.asarray(params.rule.table)
+        shape = (params.image_height, params.image_width)
         ny, nx = params.mesh_shape
         if params.image_height % ny or params.image_width % nx:
             raise ValueError(
@@ -36,29 +43,25 @@ class Backend:
         if params.engine == "pallas" and (ny, nx) != (1, 1):
             raise NotImplementedError(
                 "engine='pallas' is single-device for now; sharded meshes use "
-                "the roll stencil (engine='roll')"
+                "engine='packed' (word-granular halos) or 'roll'"
             )
         if (ny, nx) == (1, 1):
             self.mesh = None
             self._sharding = None
-            use_pallas = False
-            if params.engine == "pallas":
-                shape = (params.image_height, params.image_width)
-                try:
-                    from distributed_gol_tpu.ops import pallas_stencil
+            self.engine_used = self._resolve_single(params, shape)
+            if self.engine_used == "packed":
+                from distributed_gol_tpu.ops import packed
 
-                    use_pallas = pallas_stencil.supports(shape)
-                except ImportError:
-                    use_pallas = False  # stripped jax build: roll still works
-            if use_pallas:
+                self._superstep = packed.make_superstep(params.rule)
+                self._steps_with_counts = packed.make_steps_with_counts(params.rule)
+            elif self.engine_used == "pallas":
+                from distributed_gol_tpu.ops import pallas_stencil
+
                 self._superstep = pallas_stencil.make_superstep(params.rule)
                 self._steps_with_counts = pallas_stencil.make_steps_with_counts(
                     params.rule
                 )
             else:
-                # engine='pallas' on a board the kernel's TPU layout rules
-                # can't tile (W % 128 != 0 or indivisible H) falls back to
-                # the roll stencil — bit-identical, just not hand-tiled.
                 self._superstep = lambda b, k: stencil.superstep(b, self.table, k)
                 self._steps_with_counts = lambda b, k: stencil.steps_with_counts(
                     b, self.table, k
@@ -66,10 +69,62 @@ class Backend:
         else:
             self.mesh = mesh_lib.make_mesh((ny, nx), devices)
             self._sharding = halo.board_sharding(self.mesh)
-            _superstep = halo.sharded_superstep(self.mesh)
-            _counts = halo.sharded_steps_with_counts(self.mesh)
-            self._superstep = lambda b, k: _superstep(b, self.table, k)
-            self._steps_with_counts = lambda b, k: _counts(b, self.table, k)
+            use_packed = params.engine in ("packed", "auto")
+            if params.engine == "auto" and params.effective_superstep(
+                not params.no_vis
+            ) == 1:
+                use_packed = False  # per-turn pack/unpack never amortises
+            if use_packed:
+                from distributed_gol_tpu.parallel import packed_halo
+
+                use_packed = packed_halo.supports(shape, (ny, nx))
+            if use_packed:
+                self.engine_used = "packed"
+                self._superstep = packed_halo.make_superstep_bytes(
+                    self.mesh, params.rule
+                )
+                self._steps_with_counts = packed_halo.make_steps_with_counts_bytes(
+                    self.mesh, params.rule
+                )
+            else:
+                self.engine_used = "roll"
+                _superstep = halo.sharded_superstep(self.mesh)
+                _counts = halo.sharded_steps_with_counts(self.mesh)
+                self._superstep = lambda b, k: _superstep(b, self.table, k)
+                self._steps_with_counts = lambda b, k: _counts(b, self.table, k)
+
+    @staticmethod
+    def _resolve_single(params: Params, shape: tuple[int, int]) -> str:
+        """Requested engine -> the engine that actually runs (single device).
+        Fallback order: capability-gated, always ending at the roll stencil,
+        which supports every shape — all engines are bit-identical, so a
+        fallback changes speed, never results."""
+        if params.engine == "roll":
+            return "roll"
+        if params.engine in ("packed", "auto"):
+            from distributed_gol_tpu.ops import packed
+
+            # The byte drivers pack+unpack inside every dispatch; that only
+            # amortises over multi-generation supersteps.  A per-turn-visible
+            # run (viewer / per-turn flips => effective superstep 1) is
+            # faster on the roll stencil, so 'auto' avoids packed there.
+            per_turn = params.effective_superstep(not params.no_vis) == 1
+            if packed.supports(shape) and not (params.engine == "auto" and per_turn):
+                return "packed"
+            if params.engine == "packed":
+                return "roll"
+        # engine == "pallas", or auto on a width the packed engine can't take
+        try:
+            from distributed_gol_tpu.ops import pallas_stencil
+
+            if pallas_stencil.supports(shape):
+                import jax
+
+                if params.engine == "pallas" or jax.default_backend() != "cpu":
+                    return "pallas"
+        except ImportError:
+            pass  # stripped jax build: roll still works
+        return "roll"
 
     # -- board placement -------------------------------------------------------
     def put(self, board: np.ndarray) -> jax.Array:

@@ -34,8 +34,11 @@ class Params:
     # larger values amortise dispatch overhead; 0 => auto (1 with a viewer,
     # a bandwidth-friendly default otherwise).
     superstep: int = 0
-    # "roll" (jnp.roll stencil, always correct) | "pallas" (tuned TPU kernel)
-    engine: str = "roll"
+    # "roll" (jnp.roll stencil, always correct) | "pallas" (tuned byte TPU
+    # kernel) | "packed" (bit-packed SWAR, 32 cells/word — fastest) |
+    # "auto" (best available for the board/mesh/platform).  All engines are
+    # bit-identical; unsupported shapes fall back (see Backend.engine_used).
+    engine: str = "auto"
     # CellFlipped emission policy: "auto" (per-cell when a viewer is attached
     # i.e. not no_vis, off headless), "cell" (always, reference contract),
     # "batch" (one CellsFlipped per turn), "off".  Any flip mode forces
@@ -58,7 +61,7 @@ class Params:
             raise ValueError("turns must be >= 0")
         if self.image_width <= 0 or self.image_height <= 0:
             raise ValueError("board dimensions must be positive")
-        if self.engine not in ("roll", "pallas"):
+        if self.engine not in ("roll", "pallas", "packed", "auto"):
             raise ValueError(f"unknown engine {self.engine!r}")
         if self.flip_events not in ("auto", "cell", "batch", "off"):
             raise ValueError(f"unknown flip_events {self.flip_events!r}")

@@ -31,9 +31,9 @@ height; ``supports(shape)`` reports eligibility and the engine falls back
 to the roll stencil otherwise (small boards are host-latency-bound anyway).
 On CPU the kernel runs in interpret mode so tests stay hermetic.
 
-For the fastest single-chip engine see ``ops/pallas_packed.py`` (bit-packed
-SWAR); this byte kernel is kept as the simplest hardware-validated Pallas
-path and as the fallback when the board width is not a multiple of 1024.
+For the fastest engine see ``ops/packed.py`` (bit-packed SWAR, 32
+cells/word); this byte kernel is kept as the simplest hardware-validated
+Pallas path and as a fallback for widths the packed engine can't take.
 """
 
 from __future__ import annotations

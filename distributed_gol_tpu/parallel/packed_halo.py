@@ -1,0 +1,156 @@
+"""Sharded bit-packed stencil: halo exchange at uint32-word granularity.
+
+Same communication topology as ``parallel/halo.py`` (neighbour-only
+``lax.ppermute`` rings over the ("y", "x") mesh — the design that replaces
+the reference's full-board broadcast, ``broker/broker.go:51``), but the
+board is the 32-cells-per-word bitboard of ``ops/packed.py``:
+
+- Each device owns an (h/ny, wp/nx) block of uint32 words.
+- Row halos are one packed row each way (W/nx/8 bytes — already 8× smaller
+  than the byte engine's halos).
+- Column halos are one *word* column each way: the horizontal shift with
+  cross-word carry (``packed._west``/``_east``) needs only the adjacent
+  word, so a single uint32 column carries the 1-bit halo plus 31 bits of
+  slack — word granularity is the natural ICI message unit here.
+- Corners ride along by exchanging columns of the row-extended block,
+  exactly as in the byte path.
+
+Bit-identical to ``ops/packed.py`` on any mesh shape (a 1-sized axis
+self-sends, which IS the torus wrap), which is in turn gated bit-identical
+to ``ops/stencil.py`` and the golden oracles.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_gol_tpu.models.life import LifeRule
+from distributed_gol_tpu.ops.packed import _maj, apply_rule_planes
+from distributed_gol_tpu.parallel.halo import (
+    BOARD_SPEC,
+    _exchange_and_extend,  # dtype-agnostic: one packed row/word-column per side
+)
+
+
+def packed_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for the (H, W // 32) uint32 bitboard."""
+    return NamedSharding(mesh, BOARD_SPEC)
+
+
+def _hshift(v: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """West/east 1-bit shifts of a column-extended plane (h, wp+2); the
+    cross-word carry words are the extended columns, so no roll is needed.
+    Returns (west, east) planes of shape (h, wp)."""
+    west = (v[:, 1:-1] << 1) | (v[:, :-2] >> 31)
+    east = (v[:, 1:-1] >> 1) | (v[:, 2:] << 31)
+    return west, east
+
+
+def _local_step(local: jax.Array, rule: LifeRule) -> jax.Array:
+    """One packed generation of the local block via the halo-extended
+    neighbourhood — the shard-local form of ``packed.step``: same adder
+    network and rule application, but horizontal carries come from the
+    exchanged word columns instead of ``jnp.roll``."""
+    ext = _exchange_and_extend(local)  # (h+2, wp+2)
+    centre = ext[1:-1, 1:-1]  # (h, wp)
+    # Vertical 3-row adder across the full extended width, then horizontal.
+    v0 = ext[:-2, :] ^ ext[1:-1, :] ^ ext[2:, :]  # (h, wp+2)
+    v1 = _maj(ext[:-2, :], ext[1:-1, :], ext[2:, :])
+    v0w, v0e = _hshift(v0)
+    v1w, v1e = _hshift(v1)
+    v0c, v1c = v0[:, 1:-1], v1[:, 1:-1]
+    s0 = v0c ^ v0w ^ v0e
+    c0 = _maj(v0c, v0w, v0e)
+    s1 = v1c ^ v1w ^ v1e
+    c1 = _maj(v1c, v1w, v1e)
+    k = c0 & s1
+    totals = (s0, c0 ^ s1, c1 ^ k, c1 & k)  # 9-cell total planes
+    return apply_rule_planes(totals, centre, rule)
+
+
+def _local_count(local: jax.Array) -> jax.Array:
+    return lax.psum(
+        jnp.sum(lax.population_count(local), dtype=jnp.int32), ("y", "x")
+    )
+
+
+def sharded_superstep(mesh: Mesh, rule: LifeRule):
+    """Jitted (packed, turns) -> packed, all generations on device."""
+
+    @partial(jax.jit, static_argnames=("turns",))
+    def run(board, turns: int):
+        @partial(jax.shard_map, mesh=mesh, in_specs=BOARD_SPEC, out_specs=BOARD_SPEC)
+        def inner(local):
+            return lax.fori_loop(0, turns, lambda _, b: _local_step(b, rule), local)
+
+        return inner(board)
+
+    return run
+
+
+def sharded_steps_with_counts(mesh: Mesh, rule: LifeRule):
+    """Jitted (packed, turns) -> (packed, int32[turns] global counts)."""
+
+    @partial(jax.jit, static_argnames=("turns",))
+    def run(board, turns: int):
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=BOARD_SPEC,
+            out_specs=(BOARD_SPEC, P()),
+        )
+        def inner(local):
+            def body(b, _):
+                nb = _local_step(b, rule)
+                return nb, _local_count(nb)
+
+            return lax.scan(body, local, None, length=turns)
+
+        return inner(board)
+
+    return run
+
+
+# -- byte-board drivers (engine-layer drop-ins, uint8 {0,255} in/out) ---------
+#
+# The board stays a sharded uint8 array at the engine layer (same put/fetch
+# contract as every other engine); pack/unpack run inside the jit, pinned to
+# the mesh sharding so packing is local to each device (no resharding).
+
+
+def supports(shape: tuple[int, int], mesh_shape: tuple[int, int]) -> bool:
+    h, w = shape
+    ny, nx = mesh_shape
+    return h % ny == 0 and w % nx == 0 and (w // nx) % 32 == 0
+
+
+def make_superstep_bytes(mesh: Mesh, rule: LifeRule):
+    from distributed_gol_tpu.ops.packed import pack, unpack
+
+    inner = sharded_superstep(mesh, rule)
+
+    @partial(jax.jit, static_argnames=("turns",))
+    def run(board, turns: int):
+        p = jax.lax.with_sharding_constraint(pack(board), packed_sharding(mesh))
+        return unpack(inner(p, turns))
+
+    return run
+
+
+def make_steps_with_counts_bytes(mesh: Mesh, rule: LifeRule):
+    from distributed_gol_tpu.ops.packed import pack, unpack
+
+    inner = sharded_steps_with_counts(mesh, rule)
+
+    @partial(jax.jit, static_argnames=("turns",))
+    def run(board, turns: int):
+        p = jax.lax.with_sharding_constraint(pack(board), packed_sharding(mesh))
+        final, counts = inner(p, turns)
+        return unpack(final), counts
+
+    return run

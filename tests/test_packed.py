@@ -1,0 +1,158 @@
+"""Bit-packed SWAR engine tests: bit-identity with the roll stencil + oracle.
+
+Engines are interchangeable only because each one is gated here against the
+same spec (reference kernel ``server/server.go:33-75``); the packed engine
+additionally round-trips its uint32 representation.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_gol_tpu.models.life import CONWAY, RULES
+from distributed_gol_tpu.ops import packed
+from tests.conftest import random_board
+from tests.oracle import oracle_run, oracle_step
+
+
+def pstep(board, rule=CONWAY):
+    return np.asarray(packed.unpack(packed.step(packed.pack(jnp.asarray(board)), rule)))
+
+
+class TestPacking:
+    @pytest.mark.parametrize("shape", [(1, 32), (8, 32), (16, 64), (33, 96), (7, 256)])
+    def test_roundtrip(self, rng, shape):
+        b = random_board(rng, *shape)
+        got = np.asarray(packed.unpack(packed.pack(jnp.asarray(b))))
+        np.testing.assert_array_equal(got, b)
+
+    def test_bit_order_lsb_first(self):
+        """Bit k of word wx is the cell at column 32*wx + k."""
+        b = np.zeros((1, 64), dtype=np.uint8)
+        b[0, 0] = 255  # word 0, bit 0
+        b[0, 33] = 255  # word 1, bit 1
+        p = np.asarray(packed.pack(jnp.asarray(b)))
+        assert p[0, 0] == 1 and p[0, 1] == 2
+
+    def test_width_not_multiple_raises(self):
+        with pytest.raises(ValueError):
+            packed.pack(jnp.zeros((4, 48), dtype=jnp.uint8))
+
+    def test_supports(self):
+        assert packed.supports((16, 64))
+        assert not packed.supports((64, 16))
+
+
+class TestStep:
+    def test_blinker(self):
+        b = np.zeros((5, 32), dtype=np.uint8)
+        b[2, 1:4] = 255
+        np.testing.assert_array_equal(pstep(b), oracle_step(b))
+
+    @pytest.mark.parametrize(
+        "shape", [(1, 32), (2, 32), (3, 64), (16, 32), (64, 64), (33, 96), (128, 128)]
+    )
+    def test_random_boards_match_oracle(self, rng, shape):
+        """Includes the H in {1, 2} degenerate tori and single-word width
+        (in-word rotate wrap)."""
+        b = random_board(rng, *shape)
+        np.testing.assert_array_equal(pstep(b), oracle_step(b))
+
+    @pytest.mark.parametrize("rule", list(RULES.values()), ids=lambda r: r.name)
+    def test_rule_zoo(self, rng, rule):
+        b = random_board(rng, 32, 64)
+        np.testing.assert_array_equal(pstep(b, rule), oracle_step(b, rule))
+
+    def test_edge_wrap_blinkers(self):
+        """Blinkers straddling the word boundary and the torus seam — the
+        cross-word carry paths of _west/_east."""
+        b = np.zeros((8, 64), dtype=np.uint8)
+        b[3, 31] = b[3, 32] = b[3, 33] = 255  # across the word 0/1 boundary
+        b[6, 63] = b[6, 0] = b[6, 1] = 255  # across the torus seam
+        np.testing.assert_array_equal(pstep(b), oracle_step(b))
+
+
+class TestDrivers:
+    def test_superstep_matches_oracle(self, rng):
+        b = random_board(rng, 48, 64)
+        got = np.asarray(packed.unpack(packed.superstep(packed.pack(jnp.asarray(b)), CONWAY, 12)))
+        np.testing.assert_array_equal(got, oracle_run(b, 12))
+
+    def test_steps_with_counts(self, rng):
+        b = random_board(rng, 32, 32)
+        final, counts = packed.steps_with_counts(packed.pack(jnp.asarray(b)), CONWAY, 8)
+        expect = b
+        for i in range(8):
+            expect = oracle_step(expect)
+            assert int(counts[i]) == int((expect == 255).sum()), f"turn {i + 1}"
+        np.testing.assert_array_equal(np.asarray(packed.unpack(final)), expect)
+
+    def test_alive_count(self, rng):
+        b = random_board(rng, 33, 64)
+        assert int(packed.alive_count(packed.pack(jnp.asarray(b)))) == int((b == 255).sum())
+
+    def test_byte_driver_matches_roll_engine(self, rng):
+        """The engine-layer drop-ins: uint8 in/out, bit-identical to the roll
+        stencil over a long run."""
+        from distributed_gol_tpu.ops.stencil import steps_with_counts as roll_counts
+
+        b = random_board(rng, 64, 64)
+        run = packed.make_steps_with_counts(CONWAY)
+        got_final, got_counts = run(jnp.asarray(b), 32)
+        ref_final, ref_counts = roll_counts(
+            jnp.asarray(b), jnp.asarray(CONWAY.table), 32
+        )
+        np.testing.assert_array_equal(np.asarray(got_final), np.asarray(ref_final))
+        np.testing.assert_array_equal(np.asarray(got_counts), np.asarray(ref_counts))
+
+    def test_byte_superstep(self, rng):
+        b = random_board(rng, 32, 64)
+        run = packed.make_superstep(CONWAY)
+        np.testing.assert_array_equal(np.asarray(run(jnp.asarray(b), 5)), oracle_run(b, 5))
+
+
+class TestEngineResolution:
+    """Backend.engine_used after capability + superstep fallbacks."""
+
+    def _params(self, **kw):
+        from distributed_gol_tpu.engine.params import Params
+
+        return Params(**{"turns": 1000, "image_width": 64, "image_height": 64, **kw})
+
+    def _resolve(self, **kw):
+        from distributed_gol_tpu.engine.backend import Backend
+
+        return Backend(self._params(**kw)).engine_used
+
+    def test_auto_prefers_packed_headless(self):
+        assert self._resolve(engine="auto") == "packed"
+
+    def test_auto_avoids_packed_per_turn(self):
+        """Viewer-attached (superstep 1) runs pay pack/unpack per generation;
+        auto must pick roll there."""
+        assert self._resolve(engine="auto", no_vis=False) == "roll"
+        assert self._resolve(engine="auto", superstep=1) == "roll"
+
+    def test_explicit_packed_honoured_per_turn(self):
+        assert self._resolve(engine="packed", no_vis=False) == "packed"
+
+    def test_packed_unsupported_width_falls_back(self):
+        assert self._resolve(engine="packed", image_width=16, image_height=16) == "roll"
+
+    def test_sharded_auto_packed(self):
+        assert self._resolve(engine="auto", mesh_shape=(2, 2)) == "packed"
+        # 64 / 4 = 16 columns per device — not a whole word: roll halo path.
+        assert self._resolve(engine="auto", mesh_shape=(2, 4)) == "roll"
+
+
+@pytest.mark.parametrize("size", [64])
+def test_golden_board(golden_images, input_images, size):
+    """Direct golden-oracle check: 64²×100 turns vs check/images (the same
+    oracle TestGol uses, gol_test.go:24-28)."""
+    from distributed_gol_tpu.engine import pgm
+
+    board = pgm.read_pgm(input_images / f"{size}x{size}.pgm")
+    run = packed.make_superstep(CONWAY)
+    got = np.asarray(run(jnp.asarray(board), 100))
+    expect = pgm.read_pgm(golden_images / f"{size}x{size}x100.pgm")
+    np.testing.assert_array_equal(got, expect)
