@@ -12,8 +12,8 @@ reference itself publishes no numbers).
 
 Extra diagnostics go to stderr; stdout carries exactly the one JSON line.
 
-Usage: python bench.py [--size N] [--kturns K]
-                       [--engine auto|roll|pallas|packed] [--reps R] [--all]
+Usage: python bench.py [--size N] [--kturns K] [--reps R] [--all]
+                       [--engine auto|roll|pallas|packed|pallas-packed]
 """
 
 from __future__ import annotations
@@ -72,6 +72,13 @@ def bench_config(size: int, kturns: int, engine: str, reps: int):
 
         board = packed.pack(board)
         run = lambda b: packed.superstep(b, CONWAY, kturns)
+    elif engine == "pallas-packed":
+        from distributed_gol_tpu.ops import packed, pallas_packed
+
+        board = packed.pack(board)
+        superstep = pallas_packed.make_superstep(CONWAY)
+        log(f"  temporal blocking: T={pallas_packed.launch_turns(board.shape, kturns)}")
+        run = lambda b: superstep(b, kturns)
     else:
         from distributed_gol_tpu.ops.stencil import superstep
 
@@ -102,8 +109,28 @@ def pick_engine(requested: str, size: int) -> str:
     engine (fastest on every platform), then the byte Pallas kernel on TPU."""
     from distributed_gol_tpu.ops import packed
 
+    if requested == "pallas-packed":
+        from distributed_gol_tpu.ops import pallas_packed
+
+        if packed.supports((size, size)) and pallas_packed.supports(
+            (size, size // 32)
+        ):
+            return requested
+        log(f"pallas-packed cannot tile {size}x{size}; falling back to packed/roll")
+        requested = "packed"
     if requested in ("auto", "packed"):
         if packed.supports((size, size)):
+            if requested == "auto":
+                import jax
+
+                try:
+                    from distributed_gol_tpu.ops import pallas_packed
+                except ImportError:
+                    return "packed"  # stripped jax build
+                if jax.devices()[0].platform != "cpu" and pallas_packed.supports(
+                    (size, size // 32)
+                ):
+                    return "pallas-packed"
             return "packed"
         if requested == "packed":
             log(f"packed needs W % 32 == 0; {size}x{size} falls back to roll")
@@ -158,9 +185,11 @@ def ensure_live_backend(probe_timeout: float = 180.0) -> None:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=16384)
-    ap.add_argument("--kturns", type=int, default=256)
+    ap.add_argument("--kturns", type=int, default=1024)
     ap.add_argument(
-        "--engine", default="auto", choices=["auto", "roll", "pallas", "packed"]
+        "--engine",
+        default="auto",
+        choices=["auto", "roll", "pallas", "packed", "pallas-packed"],
     )
     ap.add_argument("--reps", type=int, default=4)
     ap.add_argument("--all", action="store_true", help="also bench 512/4096 configs")
@@ -185,7 +214,7 @@ def main():
     if args.all:
         for s in (512, 4096):
             if s <= size:
-                bench_config(s, args.kturns, engine, args.reps)
+                bench_config(s, args.kturns, pick_engine(args.engine, s), args.reps)
 
     gps, cups = bench_config(size, args.kturns, engine, args.reps)
 

@@ -43,7 +43,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-noVis", action="store_true", dest="no_vis")
     ap.add_argument("--rule", default="conway", help="conway | highlife | ... | B36/S23")
     ap.add_argument(
-        "--engine", default="auto", choices=["auto", "roll", "pallas", "packed"]
+        "--engine",
+        default="auto",
+        choices=["auto", "roll", "pallas", "packed", "pallas-packed"],
     )
     ap.add_argument("--superstep", type=int, default=0,
                     help="generations per device dispatch (0 = auto)")

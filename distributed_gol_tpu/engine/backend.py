@@ -49,7 +49,15 @@ class Backend:
             self.mesh = None
             self._sharding = None
             self.engine_used = self._resolve_single(params, shape)
-            if self.engine_used == "packed":
+            if self.engine_used == "pallas-packed":
+                from distributed_gol_tpu.ops import packed, pallas_packed
+
+                # Supersteps through the temporally-blocked VMEM kernel;
+                # per-turn telemetry (counts) through the XLA packed engine —
+                # both bit-identical, each fastest at its access pattern.
+                self._superstep = pallas_packed.make_superstep_bytes(params.rule)
+                self._steps_with_counts = packed.make_steps_with_counts(params.rule)
+            elif self.engine_used == "packed":
                 from distributed_gol_tpu.ops import packed
 
                 self._superstep = packed.make_superstep(params.rule)
@@ -69,10 +77,8 @@ class Backend:
         else:
             self.mesh = mesh_lib.make_mesh((ny, nx), devices)
             self._sharding = halo.board_sharding(self.mesh)
-            use_packed = params.engine in ("packed", "auto")
-            if params.engine == "auto" and params.effective_superstep(
-                not params.no_vis
-            ) == 1:
+            use_packed = params.engine in ("packed", "pallas-packed", "auto")
+            if params.engine == "auto" and params.runtime_superstep() == 1:
                 use_packed = False  # per-turn pack/unpack never amortises
             if use_packed:
                 from distributed_gol_tpu.parallel import packed_halo
@@ -101,17 +107,32 @@ class Backend:
         fallback changes speed, never results."""
         if params.engine == "roll":
             return "roll"
-        if params.engine in ("packed", "auto"):
+        if params.engine in ("packed", "pallas-packed", "auto"):
+            import jax
+
             from distributed_gol_tpu.ops import packed
 
             # The byte drivers pack+unpack inside every dispatch; that only
             # amortises over multi-generation supersteps.  A per-turn-visible
-            # run (viewer / per-turn flips => effective superstep 1) is
-            # faster on the roll stencil, so 'auto' avoids packed there.
-            per_turn = params.effective_superstep(not params.no_vis) == 1
+            # run (viewer / per-turn flips => superstep 1) is faster on the
+            # roll stencil, so 'auto' avoids packed there.
+            per_turn = params.runtime_superstep() == 1
             if packed.supports(shape) and not (params.engine == "auto" and per_turn):
+                # Explicit 'pallas-packed' is honoured on CPU too (interpret
+                # mode); 'auto' only upgrades on real accelerators.
+                want_kernel = params.engine == "pallas-packed" or (
+                    params.engine == "auto" and jax.default_backend() != "cpu"
+                )
+                if want_kernel:
+                    try:
+                        from distributed_gol_tpu.ops import pallas_packed
+
+                        if pallas_packed.supports((shape[0], shape[1] // 32)):
+                            return "pallas-packed"
+                    except ImportError:
+                        pass  # stripped jax build: packed still works
                 return "packed"
-            if params.engine == "packed":
+            if params.engine in ("packed", "pallas-packed"):
                 return "roll"
         # engine == "pallas", or auto on a width the packed engine can't take
         try:

@@ -183,10 +183,8 @@ class Controller:
         p = self.params
         board_np, start_turn = self._initial_world()
 
-        viewer_wants_flips = p.flip_events in ("cell", "batch") or (
-            p.flip_events == "auto" and not p.no_vis
-        )
-        superstep = 1 if viewer_wants_flips else p.effective_superstep(False)
+        viewer_wants_flips = p.wants_flips()
+        superstep = p.runtime_superstep()
 
         # Initial flips: one per alive cell of the *actual* starting world
         # (the reference emits them from the freshly loaded PGM even when it

@@ -35,8 +35,9 @@ class Params:
     # a bandwidth-friendly default otherwise).
     superstep: int = 0
     # "roll" (jnp.roll stencil, always correct) | "pallas" (tuned byte TPU
-    # kernel) | "packed" (bit-packed SWAR, 32 cells/word — fastest) |
-    # "auto" (best available for the board/mesh/platform).  All engines are
+    # kernel) | "packed" (bit-packed SWAR, 32 cells/word) | "pallas-packed"
+    # (packed + temporally-blocked Pallas kernel — fastest on TPU) | "auto"
+    # (best available for the board/mesh/platform).  All engines are
     # bit-identical; unsupported shapes fall back (see Backend.engine_used).
     engine: str = "auto"
     # CellFlipped emission policy: "auto" (per-cell when a viewer is attached
@@ -61,7 +62,7 @@ class Params:
             raise ValueError("turns must be >= 0")
         if self.image_width <= 0 or self.image_height <= 0:
             raise ValueError("board dimensions must be positive")
-        if self.engine not in ("roll", "pallas", "packed", "auto"):
+        if self.engine not in ("roll", "pallas", "packed", "pallas-packed", "auto"):
             raise ValueError(f"unknown engine {self.engine!r}")
         if self.flip_events not in ("auto", "cell", "batch", "off"):
             raise ValueError(f"unknown flip_events {self.flip_events!r}")
@@ -101,3 +102,16 @@ class Params:
         # that pause/quit keypresses are honoured promptly (SURVEY.md §7
         # hard part 3: interactivity is at superstep granularity).
         return min(self.turns, 50) if self.turns else 1
+
+    def wants_flips(self) -> bool:
+        """Whether this run emits per-turn CellFlipped/CellsFlipped events
+        (which forces per-turn host visibility)."""
+        return self.flip_events in ("cell", "batch") or (
+            self.flip_events == "auto" and not self.no_vis
+        )
+
+    def runtime_superstep(self) -> int:
+        """Generations per device dispatch the controller will actually use —
+        the single source of truth shared by the controller's run loop and
+        the backend's engine auto-selection."""
+        return 1 if self.wants_flips() else self.effective_superstep(False)

@@ -23,10 +23,11 @@ The adder network (classic bitboard-life construction):
 2. horizontal 3-column sum of those 2-bit numbers via in-word shifts with
    cross-word carry (``_west``/``_east``), yielding the 9-cell total
    T ∈ [0, 9] as 4 bit planes.
-3. neighbour count NC = T − centre by ripple-borrow subtraction of 1 bit.
-4. rule application: OR of ``NC == k`` plane-matches for k ∈ birth (dead
-   cells) and k ∈ survive (live cells) — compile-time unrolled from the
-   ``LifeRule``, so any B/S rule costs only its number of terms.
+3. rule application directly on the totals — a dead cell has T == NC and a
+   live cell T == NC + 1, so birth terms match ``T == b`` and survive terms
+   ``T == s + 1``; no neighbour-count subtraction is ever materialised.
+   Compile-time unrolled from the ``LifeRule``, so any B/S rule costs only
+   its number of terms.
 
 Constraints: board width must be a multiple of 32 (``supports``); height is
 unconstrained (the bitwise vertical forms are exact even for H ∈ {1, 2}
@@ -111,24 +112,6 @@ def total_planes(a: jax.Array):
     return s0, c0 ^ s1, c1 ^ k, c1 & k
 
 
-def neighbour_planes_from_total(totals, centre: jax.Array):
-    """The 8-neighbour count NC = T − centre as 4 bit planes (ripple
-    borrow); shared by the single-device and sharded-halo paths."""
-    t0, t1, t2, t3 = totals
-    n0 = t0 ^ centre
-    borrow = ~t0 & centre
-    n1 = t1 ^ borrow
-    borrow = ~t1 & borrow
-    n2 = t2 ^ borrow
-    borrow = ~t2 & borrow
-    return n0, n1, n2, t3 ^ borrow
-
-
-def neighbour_planes(a: jax.Array):
-    """The 8-neighbour count of a packed board as 4 bit planes."""
-    return neighbour_planes_from_total(total_planes(a), a)
-
-
 def _match(planes, k: int) -> jax.Array:
     """Plane that is all-ones where the 4-bit plane number equals ``k``."""
     n0, n1, n2, n3 = planes
@@ -142,13 +125,16 @@ def _match(planes, k: int) -> jax.Array:
 def apply_rule_planes(totals, centre: jax.Array, rule: LifeRule) -> jax.Array:
     """Next-generation packed board from 9-cell total planes + centre plane —
     the compile-time-unrolled B/S rule application (one code path for every
-    engine variant that produces total planes)."""
-    nc = neighbour_planes_from_total(totals, centre)
+    engine variant that produces total planes).
+
+    No neighbour-count subtraction is needed: a dead cell has T == NC, a
+    live cell T == NC + 1, so birth terms match ``T == b`` and survive terms
+    ``T == s + 1`` — saving the 10-op ripple borrow per generation."""
     out = jnp.zeros_like(centre)
     for b in sorted(rule.birth):
-        out |= _match(nc, b) & ~centre
+        out |= _match(totals, b) & ~centre
     for s in sorted(rule.survive):
-        out |= _match(nc, s) & centre
+        out |= _match(totals, s + 1) & centre
     return out
 
 

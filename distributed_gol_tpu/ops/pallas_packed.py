@@ -1,0 +1,231 @@
+"""Pallas TPU kernel on the bit-packed board: temporal blocking over VMEM.
+
+The XLA packed engine (``ops/packed.py``) is HBM-bound: every generation
+streams the whole bitboard through HBM, and XLA materialises the roll
+intermediates.  This kernel holds a row-tile of the packed board in VMEM and
+advances it **T generations per HBM pass** (temporal blocking): the tile is
+loaded once with a ``pad``-row halo on each side, stepped T ≤ pad times
+in-register — each generation invalidates one boundary row per side, the
+halo absorbs all T — and only then written back.  HBM traffic per
+generation drops by T× (T = 128 at the 16384² headline config), leaving the
+kernel compute-bound on the VPU's bitwise throughput.
+
+Layout/lowering notes (constraints inherited from the byte kernel,
+``ops/pallas_stencil.py``, validated on real v5e hardware):
+
+- Same horizontal packing as ``ops/packed.py`` (32 cells/uint32, LSB =
+  lowest x), so no repacking at the engine boundary.  The word axis is the
+  lane axis: ``wp = W / 32`` must be a multiple of 128 lanes → W % 4096 == 0
+  (the 16384² and 65536² headline boards qualify).
+- Vertical neighbours are ``pltpu.roll`` sublane rotates (exact only away
+  from the tile edge — the halo absorbs that); horizontal neighbours are
+  in-word shifts with cross-word carry from a 1-lane rotate, and the lane
+  rotate over full rows makes the x-wrap the true torus wrap every
+  generation.
+- All compute is 32-bit (``pltpu.roll`` and the vector ALUs are 32-bit);
+  the bit-plane network is pure ``& | ^ ~`` plus shifts — no selects, no
+  comparisons, none of the vector<i1> relayout traps.
+- HBM slice offsets are ``tile_index * tile_h + k·8`` with ``tile_h`` and
+  ``pad`` multiples of 8, so Mosaic can prove (8, 128) tiling alignment of
+  every DMA.
+
+Reference behavioural spec: ``server/server.go:33-75`` (B/S rule, torus),
+reached here as: counts = bit-plane full adders (``ops/packed.py``), rule =
+``apply_rule_planes`` on the 9-cell totals.  Bit-identity with the XLA
+packed engine is test-gated (interpret mode hermetically; real hardware via
+``bench.py --engine pallas-packed``).
+"""
+
+from __future__ import annotations
+
+import functools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_gol_tpu.models.life import CONWAY, LifeRule
+from distributed_gol_tpu.ops.packed import _maj, apply_rule_planes
+
+_LANES = 128
+_VMEM_BUDGET = 10 << 20
+# Peak live bit-planes during one generation (tile + n/s or v/shifted pairs
+# + rule accumulator); Mosaic manages them, this budgets the tile size.
+_PLANES = 6
+_MAX_T = 128  # generations per HBM pass at the headline configs
+
+
+def supports(shape: tuple[int, int]) -> bool:
+    """Packed-board shapes this kernel can tile: (H, wp) with wp a lane
+    multiple and H divisible by some multiple-of-8 tile height."""
+    h, wp = shape
+    return wp % _LANES == 0 and h % 8 == 0 and h >= 8
+
+
+def _round8(x: int) -> int:
+    return (x + 7) // 8 * 8
+
+
+def _tile_for_pad(h: int, wp: int, pad: int) -> int | None:
+    """Largest multiple-of-8 divisor of h whose (tile + 2·pad)-row working
+    set fits the VMEM budget, or None.  ``pad ≤ tile_h`` keeps the wrap-halo
+    DMA offsets inside one neighbouring tile."""
+    best = None
+    for tile_h in range(8, h + 1, 8):
+        if h % tile_h:
+            continue
+        if pad <= tile_h and _PLANES * (tile_h + 2 * pad) * wp * 4 <= _VMEM_BUDGET:
+            best = tile_h
+    return best
+
+
+@functools.lru_cache(maxsize=None)
+def launch_turns(shape: tuple[int, int], t_target: int) -> int:
+    """Deepest temporal blocking T ≤ t_target for ``shape``: the most
+    generations per HBM pass whose halo fits VMEM with compute redundancy
+    2·pad/tile_h ≤ 1; if no depth passes the redundancy bar, the deepest
+    feasible depth (tiny boards are latency- not compute-bound)."""
+    t_max = max(1, min(t_target, _MAX_T))
+    fallback = None
+    for t in range(t_max, 0, -1):
+        pad = _round8(t)
+        tile_h = _tile_for_pad(shape[0], shape[1], pad)
+        if tile_h is None:
+            continue
+        if tile_h >= 2 * pad:
+            return t
+        if fallback is None:
+            fallback = t
+    if fallback is None:
+        raise ValueError(f"no VMEM tiling for packed board {shape}")
+    return fallback
+
+
+def _gen(a: jax.Array, rule: LifeRule) -> jax.Array:
+    """One packed generation of a VMEM-resident tile (hh, wp).  Vertical
+    wrap is the tile-local rotate (exact for the kept rows as long as the
+    halo is deeper than the generation index); horizontal wrap is exact."""
+    hh, wp = a.shape
+    n = pltpu.roll(a, 1, 0)
+    s = pltpu.roll(a, hh - 1, 0)
+    v0 = a ^ n ^ s
+    v1 = _maj(a, n, s)
+
+    def hsum(v):
+        west = (v << 1) | (pltpu.roll(v, 1, 1) >> 31)
+        east = (v >> 1) | (pltpu.roll(v, wp - 1, 1) << 31)
+        return v ^ west ^ east, _maj(v, west, east)
+
+    s0, c0 = hsum(v0)
+    s1, c1 = hsum(v1)
+    k = c0 & s1
+    totals = (s0, c0 ^ s1, c1 ^ k, c1 & k)
+    return apply_rule_planes(totals, a, rule)
+
+
+def _kernel(x_hbm, o_ref, tile, sems, *, tile_h, pad, grid, turns, rule):
+    i = pl.program_id(0)
+    # Halo source offsets as tile_index * tile_h + k·8: provably 8-aligned.
+    top = jax.lax.rem(i + grid - 1, grid) * tile_h + (tile_h - pad)
+    bot = jax.lax.rem(i + 1, grid) * tile_h
+    copies = [
+        pltpu.make_async_copy(
+            x_hbm.at[pl.ds(i * tile_h, tile_h), :],
+            tile.at[pl.ds(pad, tile_h), :],
+            sems.at[0],
+        ),
+        pltpu.make_async_copy(
+            x_hbm.at[pl.ds(top, pad), :], tile.at[pl.ds(0, pad), :], sems.at[1]
+        ),
+        pltpu.make_async_copy(
+            x_hbm.at[pl.ds(bot, pad), :],
+            tile.at[pl.ds(pad + tile_h, pad), :],
+            sems.at[2],
+        ),
+    ]
+    for c in copies:
+        c.start()
+    for c in copies:
+        c.wait()
+
+    out = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), tile[:])
+    o_ref[:] = out[pad : pad + tile_h, :]
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+@functools.lru_cache(maxsize=None)
+def _build_launch(
+    shape: tuple[int, int], rule: LifeRule, turns: int, interpret: bool
+):
+    """A pallas_call advancing a packed (H, wp) board ``turns`` generations
+    in one HBM pass (turns ≤ pad ≤ _MAX_T)."""
+    h, wp = shape
+    if not supports(shape):
+        raise ValueError(
+            f"pallas packed kernel needs wp % {_LANES} == 0 and H % 8 == 0; "
+            f"got packed shape {h}x{wp} (use supports())"
+        )
+    pad = _round8(turns)
+    tile_h = _tile_for_pad(h, wp, pad)
+    if tile_h is None:
+        raise ValueError(f"no VMEM tiling for {turns} turns on {h}x{wp}")
+    grid = h // tile_h
+    kernel = partial(
+        _kernel, tile_h=tile_h, pad=pad, grid=grid, turns=turns, rule=rule
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((tile_h, wp), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((h, wp), jnp.uint32),
+        scratch_shapes=[
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        interpret=interpret,
+    )
+
+
+def make_superstep(rule: LifeRule = CONWAY, interpret: bool | None = None):
+    """``(packed, turns) -> packed``: temporally-blocked supersteps.
+
+    ``turns`` is split into launches of T = ``launch_turns(shape, turns)``
+    generations plus one remainder launch; every launch is one pallas_call
+    with all T generations computed in VMEM.
+    """
+
+    @partial(jax.jit, static_argnames=("turns",))
+    def run(board: jax.Array, turns: int) -> jax.Array:
+        if turns == 0:
+            return board
+        ip = _use_interpret() if interpret is None else interpret
+        shape = board.shape
+        t = launch_turns(shape, turns)
+        full, rem = divmod(turns, t)
+        call = _build_launch(shape, rule, t, ip)
+        board = jax.lax.fori_loop(0, full, lambda _, b: call(b), board)
+        if rem:
+            board = _build_launch(shape, rule, rem, ip)(board)
+        return board
+
+    return run
+
+
+def make_superstep_bytes(rule: LifeRule = CONWAY, interpret: bool | None = None):
+    """``(board_u8, turns) -> board_u8`` engine-layer drop-in: pack/unpack
+    inside the jit around the temporally-blocked kernel."""
+    from distributed_gol_tpu.ops.packed import pack, unpack
+
+    inner = make_superstep(rule, interpret)
+
+    @partial(jax.jit, static_argnames=("turns",))
+    def run(board: jax.Array, turns: int) -> jax.Array:
+        return unpack(inner(pack(board), turns))
+
+    return run

@@ -133,8 +133,25 @@ class TestEngineResolution:
         assert self._resolve(engine="auto", no_vis=False) == "roll"
         assert self._resolve(engine="auto", superstep=1) == "roll"
 
+    def test_auto_avoids_packed_for_flip_runs(self):
+        """flip_events='cell'/'batch' force superstep 1 in the controller
+        even headless; auto must see that through runtime_superstep."""
+        assert self._resolve(engine="auto", flip_events="cell") == "roll"
+        assert self._resolve(engine="auto", flip_events="batch") == "roll"
+
     def test_explicit_packed_honoured_per_turn(self):
         assert self._resolve(engine="packed", no_vis=False) == "packed"
+
+    def test_explicit_pallas_packed_on_cpu_interpret(self):
+        """Explicit 'pallas-packed' is honoured on CPU via interpret mode
+        when the kernel can tile the shape (wp % 128)."""
+        got = self._resolve(engine="pallas-packed", image_width=4096, image_height=64)
+        assert got == "pallas-packed"
+        # untileable width degrades to packed, not roll
+        assert self._resolve(engine="pallas-packed") == "packed"
+
+    def test_pallas_packed_mesh_degrades_to_packed_halo(self):
+        assert self._resolve(engine="pallas-packed", mesh_shape=(2, 2)) == "packed"
 
     def test_packed_unsupported_width_falls_back(self):
         assert self._resolve(engine="packed", image_width=16, image_height=16) == "roll"

@@ -1,0 +1,69 @@
+"""Temporally-blocked Pallas packed kernel: bit-identity with the XLA packed
+engine (itself oracle-gated) in interpret mode.
+
+Real-hardware lowering is exercised by ``bench.py --engine pallas-packed``;
+these tests pin the algorithm: halo depth vs generations per launch, wrap
+correctness across tile seams, launch splitting (full + remainder).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_gol_tpu.models.life import CONWAY, HIGHLIFE
+from distributed_gol_tpu.ops import packed, pallas_packed
+from tests.conftest import random_board
+
+
+def run_both(rng, h, w, turns, rule=CONWAY):
+    b = random_board(rng, h, w)
+    p = packed.pack(jnp.asarray(b))
+    got = pallas_packed.make_superstep(rule, interpret=True)(p, turns)
+    want = packed.superstep(p, rule, turns)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestTiling:
+    def test_headline_shape_deep_blocking(self):
+        """16384²: tile picking must find a deep T with ≤2× redundancy."""
+        t = pallas_packed.launch_turns((16384, 512), 10_000)
+        assert t >= 64
+
+    def test_small_board_feasible(self):
+        assert pallas_packed.launch_turns((64, 128), 1000) >= 1
+
+    def test_supports(self):
+        assert pallas_packed.supports((16384, 512))
+        assert not pallas_packed.supports((16384, 64))  # wp % 128 != 0
+        assert not pallas_packed.supports((12, 128))  # H % 8 != 0
+
+
+class TestBitIdentity:
+    def test_single_tile_board(self, rng):
+        run_both(rng, 64, 4096, turns=20)
+
+    def test_multi_tile_seams(self, rng):
+        """H forces several tiles; 40 turns crosses tile boundaries deeply
+        enough that any halo under-fill corrupts kept rows."""
+        run_both(rng, 256, 4096, turns=40)
+
+    def test_remainder_launch(self, rng):
+        """turns chosen so divmod(turns, T) has both full launches and a
+        remainder with a different pad."""
+        t = pallas_packed.launch_turns((64, 128), 50)
+        assert 50 % t != 0 or 50 // t > 1
+        run_both(rng, 64, 4096, turns=50)
+
+    def test_zero_turns(self, rng):
+        b = random_board(rng, 64, 4096)
+        p = packed.pack(jnp.asarray(b))
+        got = pallas_packed.make_superstep(CONWAY, interpret=True)(p, 0)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(p))
+
+    def test_rule_zoo(self, rng):
+        run_both(rng, 64, 4096, turns=12, rule=HIGHLIFE)
+
+    @pytest.mark.parametrize("turns", [1, 7, 8, 9])
+    def test_turn_boundaries(self, rng, turns):
+        """Around the pad-rounding boundary (multiples of 8)."""
+        run_both(rng, 64, 4096, turns=turns)
