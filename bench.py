@@ -77,7 +77,13 @@ def bench_config(size: int, kturns: int, engine: str, reps: int):
 
         board = packed.pack(board)
         superstep = pallas_packed.make_superstep(CONWAY)
-        log(f"  temporal blocking: T={pallas_packed.launch_turns(board.shape, kturns)}")
+        if pallas_packed.is_vmem_resident(board.shape):
+            log("  VMEM-resident: whole superstep in one launch")
+        else:
+            log(
+                "  temporal blocking: "
+                f"T={pallas_packed.launch_turns(board.shape, kturns)}"
+            )
         run = lambda b: superstep(b, kturns)
     else:
         from distributed_gol_tpu.ops.stencil import superstep

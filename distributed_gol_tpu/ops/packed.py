@@ -80,6 +80,31 @@ def unpack(packed: jax.Array) -> jax.Array:
     return (bits.astype(jnp.uint8) * jnp.uint8(255)).reshape(h, wp * WORD)
 
 
+def pack_vertical(board: jax.Array) -> jax.Array:
+    """uint8 {0,255} board (H, W) → uint32 bitboard (H // 32, W), bit ``k``
+    of word (wy, x) = cell (32*wy + k, x).
+
+    The transposed layout of ``pack``: columns are packed instead of rows.
+    On TPU this puts the full board width on the lane axis, so any
+    W % 128 == 0 board (512² upward) tiles vector registers exactly — the
+    layout the VMEM-resident Pallas kernel uses.  Host-side contract stays
+    ``pack``/horizontal; this is an internal kernel layout.
+    """
+    h, w = board.shape
+    if h % WORD:
+        raise ValueError(f"height {h} not a multiple of {WORD}")
+    bits = (board & 1).astype(_U32).reshape(h // WORD, WORD, w)
+    weights = (jnp.uint32(1) << jnp.arange(WORD, dtype=_U32))[:, None]
+    return jnp.sum(bits * weights, axis=1, dtype=_U32)
+
+
+def unpack_vertical(packed_v: jax.Array) -> jax.Array:
+    """uint32 bitboard (H // 32, W) → uint8 {0,255} board (H, W)."""
+    hw, w = packed_v.shape
+    bits = (packed_v[:, None, :] >> jnp.arange(WORD, dtype=_U32)[:, None]) & jnp.uint32(1)
+    return (bits.astype(jnp.uint8) * jnp.uint8(255)).reshape(hw * WORD, w)
+
+
 # -- the adder network --------------------------------------------------------
 
 

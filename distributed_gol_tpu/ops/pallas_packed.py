@@ -47,7 +47,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from distributed_gol_tpu.models.life import CONWAY, LifeRule
-from distributed_gol_tpu.ops.packed import _maj, apply_rule_planes
+from distributed_gol_tpu.ops.packed import (
+    _maj,
+    apply_rule_planes,
+    pack,
+    pack_vertical,
+    unpack,
+    unpack_vertical,
+)
 
 _LANES = 128
 _VMEM_BUDGET = 10 << 20
@@ -55,13 +62,40 @@ _VMEM_BUDGET = 10 << 20
 # + rule accumulator); Mosaic manages them, this budgets the tile size.
 _PLANES = 6
 _MAX_T = 128  # generations per HBM pass at the headline configs
+# VMEM-resident path: whole board + loop carry + temps live in VMEM at once.
+_VRESIDENT_PLANES = 8
+
+
+def _vmem_resident_shape(h: int, wp: int) -> tuple[int, int] | None:
+    """The vertically-packed (H // 32, W) shape if the whole board can run
+    VMEM-resident, else None.  Gate matches the hardware-validated envelope:
+    H % 256 == 0 so the sublane count H/32 is a multiple of the (8, 128)
+    native tile, W on a lane boundary, full working set within budget
+    (512²…3072² boards)."""
+    w = wp * 32
+    if h % 256 or w % _LANES:
+        return None
+    if _VRESIDENT_PLANES * (h // 32) * w * 4 > _VMEM_BUDGET:
+        return None
+    return (h // 32, w)
+
+
+def is_vmem_resident(shape: tuple[int, int]) -> bool:
+    """Whether a packed (H, wp) board runs the whole-superstep-in-one-launch
+    VMEM-resident path (vs the temporally-blocked tiled path)."""
+    return _vmem_resident_shape(*shape) is not None
+
+
+def _tiled_supports(shape: tuple[int, int]) -> bool:
+    h, wp = shape
+    return wp % _LANES == 0 and h % 8 == 0 and h >= 8
 
 
 def supports(shape: tuple[int, int]) -> bool:
-    """Packed-board shapes this kernel can tile: (H, wp) with wp a lane
-    multiple and H divisible by some multiple-of-8 tile height."""
-    h, wp = shape
-    return wp % _LANES == 0 and h % 8 == 0 and h >= 8
+    """Packed-board shapes this kernel takes: tileable (wp a lane multiple,
+    H divisible by a multiple-of-8 tile height) or small enough to run
+    whole-board VMEM-resident in the vertical layout."""
+    return is_vmem_resident(shape) or _tiled_supports(shape)
 
 
 def _round8(x: int) -> int:
@@ -125,6 +159,50 @@ def _gen(a: jax.Array, rule: LifeRule) -> jax.Array:
     return apply_rule_planes(totals, a, rule)
 
 
+def _gen_vertical(a: jax.Array, rule: LifeRule) -> jax.Array:
+    """One generation on a whole VMEM-resident vertically-packed board —
+    both wraps are exact (global rotates), so this needs no halo and can run
+    any number of generations back to back."""
+    hw, w = a.shape
+    up = pltpu.roll(a, 1, 0)  # word row above, wrapping: carries for bit 0
+    dn = pltpu.roll(a, hw - 1, 0)
+    north = (a << 1) | (up >> 31)
+    south = (a >> 1) | (dn << 31)
+    v0 = a ^ north ^ south
+    v1 = _maj(a, north, south)
+
+    def hsum(v):
+        west = pltpu.roll(v, 1, 1)  # lanes are single cell columns here
+        east = pltpu.roll(v, w - 1, 1)
+        return v ^ west ^ east, _maj(v, west, east)
+
+    s0, c0 = hsum(v0)
+    s1, c1 = hsum(v1)
+    k = c0 & s1
+    totals = (s0, c0 ^ s1, c1 ^ k, c1 & k)
+    return apply_rule_planes(totals, a, rule)
+
+
+def _vmem_kernel(x_ref, o_ref, *, turns, rule):
+    o_ref[:] = jax.lax.fori_loop(
+        0, turns, lambda _, a: _gen_vertical(a, rule), x_ref[:]
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _build_vmem_resident(
+    vshape: tuple[int, int], rule: LifeRule, turns: int, interpret: bool
+):
+    """One pallas_call advancing a VMEM-resident vertically-packed board by
+    ``turns`` generations — the whole superstep in a single launch, zero
+    HBM traffic between generations."""
+    return pl.pallas_call(
+        partial(_vmem_kernel, turns=turns, rule=rule),
+        out_shape=jax.ShapeDtypeStruct(vshape, jnp.uint32),
+        interpret=interpret,
+    )
+
+
 def _kernel(x_hbm, o_ref, tile, sems, *, tile_h, pad, grid, turns, rule):
     i = pl.program_id(0)
     # Halo source offsets as tile_index * tile_h + k·8: provably 8-aligned.
@@ -165,10 +243,10 @@ def _build_launch(
     """A pallas_call advancing a packed (H, wp) board ``turns`` generations
     in one HBM pass (turns ≤ pad ≤ _MAX_T)."""
     h, wp = shape
-    if not supports(shape):
+    if not _tiled_supports(shape):
         raise ValueError(
-            f"pallas packed kernel needs wp % {_LANES} == 0 and H % 8 == 0; "
-            f"got packed shape {h}x{wp} (use supports())"
+            f"tiled pallas packed kernel needs wp % {_LANES} == 0 and "
+            f"H % 8 == 0; got packed shape {h}x{wp} (use supports())"
         )
     pad = _round8(turns)
     tile_h = _tile_for_pad(h, wp, pad)
@@ -206,26 +284,44 @@ def make_superstep(rule: LifeRule = CONWAY, interpret: bool | None = None):
             return board
         ip = _use_interpret() if interpret is None else interpret
         shape = board.shape
-        t = launch_turns(shape, turns)
-        full, rem = divmod(turns, t)
-        call = _build_launch(shape, rule, t, ip)
-        board = jax.lax.fori_loop(0, full, lambda _, b: call(b), board)
-        if rem:
-            board = _build_launch(shape, rule, rem, ip)(board)
-        return board
+        vshape = _vmem_resident_shape(*shape)
+        if vshape is not None:
+            # Small board: relayout to vertical packing (amortised over the
+            # whole superstep) and run every generation in one launch.
+            v = pack_vertical(unpack(board))
+            v = _build_vmem_resident(vshape, rule, turns, ip)(v)
+            return pack(unpack_vertical(v))
+        return _run_tiled(board, rule, turns, ip)
 
     return run
 
 
-def make_superstep_bytes(rule: LifeRule = CONWAY, interpret: bool | None = None):
-    """``(board_u8, turns) -> board_u8`` engine-layer drop-in: pack/unpack
-    inside the jit around the temporally-blocked kernel."""
-    from distributed_gol_tpu.ops.packed import pack, unpack
+def _run_tiled(board: jax.Array, rule: LifeRule, turns: int, ip: bool) -> jax.Array:
+    shape = board.shape
+    t = launch_turns(shape, turns)
+    full, rem = divmod(turns, t)
+    call = _build_launch(shape, rule, t, ip)
+    board = jax.lax.fori_loop(0, full, lambda _, b: call(b), board)
+    if rem:
+        board = _build_launch(shape, rule, rem, ip)(board)
+    return board
 
-    inner = make_superstep(rule, interpret)
+
+def make_superstep_bytes(rule: LifeRule = CONWAY, interpret: bool | None = None):
+    """``(board_u8, turns) -> board_u8`` engine-layer drop-in: one packing
+    pass each way around the kernel — VMEM-resident boards go straight to
+    the vertical layout (no intermediate horizontal round trip)."""
 
     @partial(jax.jit, static_argnames=("turns",))
     def run(board: jax.Array, turns: int) -> jax.Array:
-        return unpack(inner(pack(board), turns))
+        if turns == 0:
+            return board
+        ip = _use_interpret() if interpret is None else interpret
+        h, w = board.shape
+        vshape = _vmem_resident_shape(h, w // 32)
+        if vshape is not None:
+            v = _build_vmem_resident(vshape, rule, turns, ip)(pack_vertical(board))
+            return unpack_vertical(v)
+        return unpack(_run_tiled(pack(board), rule, turns, ip))
 
     return run

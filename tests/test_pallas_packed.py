@@ -67,3 +67,54 @@ class TestBitIdentity:
     def test_turn_boundaries(self, rng, turns):
         """Around the pad-rounding boundary (multiples of 8)."""
         run_both(rng, 64, 4096, turns=turns)
+
+
+class TestVerticalPacking:
+    @pytest.mark.parametrize("shape", [(32, 128), (64, 256), (96, 128)])
+    def test_roundtrip(self, rng, shape):
+        b = random_board(rng, *shape)
+        got = np.asarray(packed.unpack_vertical(packed.pack_vertical(jnp.asarray(b))))
+        np.testing.assert_array_equal(got, b)
+
+    def test_bit_order(self):
+        b = np.zeros((64, 128), dtype=np.uint8)
+        b[0, 5] = 255  # word row 0, bit 0
+        b[33, 7] = 255  # word row 1, bit 1
+        p = np.asarray(packed.pack_vertical(jnp.asarray(b)))
+        assert p[0, 5] == 1 and p[1, 7] == 2
+
+
+class TestVmemResident:
+    def test_512_board_is_vmem_resident(self):
+        assert pallas_packed._vmem_resident_shape(512, 16) == (16, 512)
+        assert pallas_packed.is_vmem_resident((512, 16))
+        assert pallas_packed.supports((512, 16))
+
+    def test_large_board_is_not(self):
+        assert not pallas_packed.is_vmem_resident((16384, 512))
+
+    def test_sublane_alignment_gate(self):
+        """H % 256 != 0 puts the sublane count below/off the (8, 128) native
+        tile — outside the hardware-validated envelope, so rejected."""
+        assert not pallas_packed.is_vmem_resident((128, 4))
+        assert not pallas_packed.supports((128, 4))
+
+    @pytest.mark.parametrize("shape,turns", [((512, 512), 30), ((256, 384), 75)])
+    def test_bit_identity(self, rng, shape, turns):
+        """Whole-superstep-in-one-launch path vs the XLA packed engine,
+        including wrap exactness over many generations."""
+        assert pallas_packed.is_vmem_resident((shape[0], shape[1] // 32))
+        run_both(rng, *shape, turns=turns)
+
+    def test_rule_zoo(self, rng):
+        run_both(rng, 256, 128, turns=16, rule=HIGHLIFE)
+
+    def test_bytes_driver(self, rng):
+        """make_superstep_bytes dispatches straight to the vertical layout."""
+        from tests.oracle import oracle_run as orun
+
+        b = random_board(rng, 256, 128)
+        got = pallas_packed.make_superstep_bytes(CONWAY, interpret=True)(
+            jnp.asarray(b), 9
+        )
+        np.testing.assert_array_equal(np.asarray(got), orun(b, 9))
