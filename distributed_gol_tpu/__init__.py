@@ -34,6 +34,7 @@ from distributed_gol_tpu.engine.events import (
     State,
     StateChange,
     TurnComplete,
+    TurnTiming,
 )
 from distributed_gol_tpu.engine.gol import run, start
 
@@ -49,6 +50,7 @@ __all__ = [
     "State",
     "StateChange",
     "TurnComplete",
+    "TurnTiming",
     "run",
     "start",
 ]

@@ -4,7 +4,8 @@ Flag parity (``main.go:17-46``): ``-t`` threads (default 8), ``-w`` width
 (512), ``-h`` height (512), ``-turns`` (default 10_000_000_000), ``-noVis``
 — note ``-h`` is board height as in the reference, so help is ``--help``.
 TPU-native extras: ``--rule``, ``--engine``, ``--superstep``, ``--mesh``,
-``--images-dir``, ``--out-dir``, ``--checkpoint-dir``, ``--ticker``.
+``--images-dir``, ``--out-dir``, ``--checkpoint-dir``, ``--ticker``,
+``--trace`` (JAX profiler → Perfetto), ``--timing`` (TurnTiming events).
 
 Process shape: the engine runs in a worker thread (the ``go gol.Run``
 analog, ``main.go:55``) while the main thread runs the viewer loop and the
@@ -57,6 +58,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="durable 'q'-detach checkpoints live here")
     ap.add_argument("--ticker", type=float, default=2.0,
                     help="AliveCellsCount period in seconds")
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="write a JAX profiler trace (Perfetto/TensorBoard) to DIR")
+    ap.add_argument("--timing", action="store_true",
+                    help="emit TurnTiming events (per-dispatch gens/sec)")
     return ap
 
 
@@ -77,6 +82,7 @@ def params_from_args(args) -> Params:
         images_dir=args.images_dir,
         out_dir=args.out_dir,
         ticker_period=args.ticker,
+        emit_timing=args.timing,
     )
 
 
@@ -97,20 +103,26 @@ def main(argv=None) -> int:
     stop = threading.Event()
     restore_tty = keyboard_listener(key_presses, stop)
 
-    engine_thread = start(params, events, key_presses, session)
-    try:
-        if params.no_vis:
+    import contextlib
+
+    from distributed_gol_tpu.utils.profiling import trace
+
+    tracer = trace(args.trace) if args.trace else contextlib.nullcontext()
+    with tracer:
+        engine_thread = start(params, events, key_presses, session)
+        try:
+            if params.no_vis:
+                final = run_headless(params, events)
+            else:
+                final = run_terminal(params, events)
+        except KeyboardInterrupt:
+            key_presses.put("q")  # graceful detach, checkpoint parked on session
             final = run_headless(params, events)
-        else:
-            final = run_terminal(params, events)
-    except KeyboardInterrupt:
-        key_presses.put("q")  # graceful detach, checkpoint parked on session
-        final = run_headless(params, events)
-    finally:
-        stop.set()
-        if restore_tty is not None:
-            restore_tty()
-    engine_thread.join(timeout=30)
+        finally:
+            stop.set()
+            if restore_tty is not None:
+                restore_tty()
+        engine_thread.join(timeout=30)
     if final is None:
         # The stream ended without a FinalTurnComplete: the engine died
         # (its traceback went to stderr).  Scripts must see the failure.

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -41,6 +42,7 @@ from distributed_gol_tpu.engine.events import (
     State,
     StateChange,
     TurnComplete,
+    TurnTiming,
 )
 from distributed_gol_tpu.engine.params import Params
 from distributed_gol_tpu.engine.session import Session, default_session
@@ -204,18 +206,24 @@ class Controller:
                 if self._outcome != "completed":
                     break
                 k = min(superstep, p.turns - turn)  # superstep is 1 for viewers
+                t0 = time.perf_counter() if p.emit_timing else 0.0
                 if viewer_wants_flips:
                     board, count, coords = self.backend.run_turn_with_flips(board)
                     turn += 1
                     state.set(turn, count)
                     self._emit_flips(turn, coords)
                     self._emit(TurnComplete(turn))
+                    k = 1
                 else:
                     board, counts = self.backend.run_turns(board, k)
                     for i in range(k):
                         self._emit(TurnComplete(turn + i + 1))
                     turn += k
                     state.set(turn, int(counts[-1]))
+                if p.emit_timing:
+                    # run_turns/run_turn_with_flips synchronise on the counts
+                    # transfer, so this is true dispatch wall-clock.
+                    self._emit(TurnTiming(turn, k, time.perf_counter() - t0))
         finally:
             ticker.stop()
             ticker.join()

@@ -112,6 +112,26 @@ class FinalTurnComplete(Event):
     alive: Sequence[Cell] = field(default_factory=tuple)
 
 
+@dataclass(frozen=True)
+class TurnTiming(Event):
+    """Per-dispatch timing telemetry (framework extension, off by default —
+    enable with ``Params.emit_timing``).  The TPU analog of the reference's
+    ``runtime/trace`` harness output (``trace_test.go:12-29``): one event per
+    device dispatch with wall-clock and derived throughput, so a long run's
+    progress is observable without attaching a profiler.  For kernel-level
+    traces use ``utils.profiling.trace`` (jax.profiler → Perfetto)."""
+
+    turns: int = 0  # generations in this dispatch
+    seconds: float = 0.0  # wall-clock for the dispatch (incl. host sync)
+
+    @property
+    def gens_per_sec(self) -> float:
+        return self.turns / self.seconds if self.seconds > 0 else 0.0
+
+    def __str__(self) -> str:
+        return f"{self.turns} turns in {self.seconds:.4f}s ({self.gens_per_sec:,.0f}/s)"
+
+
 AnyEvent = Union[
     AliveCellsCount,
     ImageOutputComplete,
@@ -120,4 +140,5 @@ AnyEvent = Union[
     CellsFlipped,
     TurnComplete,
     FinalTurnComplete,
+    TurnTiming,
 ]

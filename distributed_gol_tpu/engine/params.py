@@ -48,6 +48,11 @@ class Params:
     # AliveCellsCount cadence in seconds (reference: 2000 ms ticker,
     # gol/distributor.go:228); configurable so tests can run fast.
     ticker_period: float = 2.0
+    # Emit a TurnTiming event per device dispatch (wall-clock + gens/sec) —
+    # the in-stream half of the tracing story (reference analog:
+    # trace_test.go's runtime/trace harness); kernel traces via
+    # utils.profiling.trace.
+    emit_timing: bool = False
     # Device mesh shape (rows, cols) for sharded execution; (1, 1) = single
     # device.  Replaces the reference's hardcoded 4-worker fan-out
     # (broker/broker.go:192).

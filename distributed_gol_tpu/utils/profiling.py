@@ -1,0 +1,47 @@
+"""Tracing/profiling hooks — the TPU analog of the reference's trace harness.
+
+The reference wraps a run in Go's ``runtime/trace`` producing ``trace.out``
+for ``go tool trace`` (``trace_test.go:12-29``) and prescribes pprof in its
+report guidance.  The TPU equivalent is the XLA/JAX profiler: a trace
+captures device kernel timelines (every Pallas launch, DMA, and collective)
+viewable in Perfetto / TensorBoard.
+
+Usage::
+
+    from distributed_gol_tpu.utils.profiling import trace
+    with trace("/tmp/gol-trace"):
+        gol.run(params, events)
+    # inspect with: tensorboard --logdir /tmp/gol-trace   (or Perfetto)
+
+or from the CLI: ``python -m distributed_gol_tpu --trace /tmp/gol-trace``.
+
+Degrades to a no-op (with a warning) when the jax build has no profiler
+backend, so tracing never takes a run down.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+from pathlib import Path
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | Path):
+    """Context manager writing a JAX profiler trace to ``log_dir``."""
+    try:
+        import jax
+
+        ctx = jax.profiler.trace(str(log_dir))
+    except Exception as e:  # stripped build or unsupported backend
+        print(f"warning: profiler unavailable ({e}); run continues untraced",
+              file=sys.stderr)
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
+
+
+def has_trace_output(log_dir: str | Path) -> bool:
+    """Whether ``log_dir`` contains profiler output (for tests/tooling)."""
+    root = Path(log_dir)
+    return root.is_dir() and any(root.rglob("*.xplane.pb"))

@@ -1,0 +1,60 @@
+"""Tracing/profiling harness — the ``trace_test.go`` port.
+
+The reference's TestTrace (trace_test.go:12-29) is not an assertion but a
+harness: wrap a 64²x10 run in runtime/trace and produce trace.out.  The TPU
+analog wraps a run in the JAX profiler (``utils/profiling.trace``) and emits
+per-dispatch ``TurnTiming`` events; here we assert both hooks actually fire.
+"""
+
+import queue
+
+import pytest
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.utils.profiling import has_trace_output, trace
+
+
+def _run(params):
+    ev = queue.Queue()
+    gol.run(params, ev)
+    out = []
+    while (e := ev.get(timeout=60)) is not None:
+        out.append(e)
+    return out
+
+
+def _params(tmp_path, input_images, **kw):
+    return gol.Params(
+        turns=10,
+        image_width=64,
+        image_height=64,
+        images_dir=input_images,
+        out_dir=tmp_path,
+        **kw,
+    )
+
+
+def test_profiler_trace_produces_output(tmp_path, input_images):
+    """A traced run writes profiler artifacts (trace_test.go's trace.out
+    analog); skipped only if this jax build lacks a profiler backend."""
+    log_dir = tmp_path / "trace"
+    with trace(log_dir):
+        _run(_params(tmp_path, input_images))
+    if not has_trace_output(log_dir):
+        pytest.skip("jax profiler backend unavailable in this build")
+
+
+def test_turn_timing_events(tmp_path, input_images):
+    events = _run(_params(tmp_path, input_images, emit_timing=True, superstep=5))
+    timings = [e for e in events if isinstance(e, gol.TurnTiming)]
+    assert len(timings) == 2  # 10 turns / superstep 5
+    assert [t.turns for t in timings] == [5, 5]
+    assert [t.completed_turns for t in timings] == [5, 10]
+    assert all(t.seconds > 0 for t in timings)
+    assert all(t.gens_per_sec > 0 for t in timings)
+    assert "turns in" in str(timings[0])
+
+
+def test_no_timing_by_default(tmp_path, input_images):
+    events = _run(_params(tmp_path, input_images))
+    assert not [e for e in events if isinstance(e, gol.TurnTiming)]
