@@ -133,7 +133,7 @@ def pick_engine(requested: str, size: int) -> str:
                     from distributed_gol_tpu.ops import pallas_packed
                 except ImportError:
                     return "packed"  # stripped jax build
-                if jax.devices()[0].platform != "cpu" and pallas_packed.supports(
+                if jax.devices()[0].platform == "tpu" and pallas_packed.supports(
                     (size, size // 32)
                 ):
                     return "pallas-packed"
@@ -154,7 +154,7 @@ def pick_engine(requested: str, size: int) -> str:
     if requested == "auto":
         import jax
 
-        return "pallas" if jax.devices()[0].platform != "cpu" else "roll"
+        return "pallas" if jax.devices()[0].platform == "tpu" else "roll"
     return requested
 
 

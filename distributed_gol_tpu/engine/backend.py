@@ -118,10 +118,12 @@ class Backend:
             # roll stencil, so 'auto' avoids packed there.
             per_turn = params.runtime_superstep() == 1
             if packed.supports(shape) and not (params.engine == "auto" and per_turn):
-                # Explicit 'pallas-packed' is honoured on CPU too (interpret
-                # mode); 'auto' only upgrades on real accelerators.
+                # Explicit 'pallas-packed' is honoured off-TPU too (interpret
+                # mode); 'auto' only upgrades on TPU, where the pltpu
+                # primitives actually lower — on GPU the pure-XLA packed
+                # engine is the fast correct choice.
                 want_kernel = params.engine == "pallas-packed" or (
-                    params.engine == "auto" and jax.default_backend() != "cpu"
+                    params.engine == "auto" and jax.default_backend() == "tpu"
                 )
                 if want_kernel:
                     try:
@@ -141,7 +143,7 @@ class Backend:
             if pallas_stencil.supports(shape):
                 import jax
 
-                if params.engine == "pallas" or jax.default_backend() != "cpu":
+                if params.engine == "pallas" or jax.default_backend() == "tpu":
                     return "pallas"
         except ImportError:
             pass  # stripped jax build: roll still works

@@ -46,7 +46,7 @@ from distributed_gol_tpu.engine.events import (
 )
 from distributed_gol_tpu.engine.params import Params
 from distributed_gol_tpu.engine.session import Session, default_session
-from distributed_gol_tpu.utils.cell import Cell, alive_cells_from_board
+from distributed_gol_tpu.utils.cell import AliveCells, Cell
 
 
 class _TickerState:
@@ -213,7 +213,8 @@ class Controller:
                     state.set(turn, count)
                     self._emit_flips(turn, coords)
                     self._emit(TurnComplete(turn))
-                    k = 1
+                    # k is already 1 here: runtime_superstep() is 1 whenever
+                    # the viewer wants flips, so min() above produced 1.
                 else:
                     board, counts = self.backend.run_turns(board, k)
                     for i in range(k):
@@ -253,7 +254,7 @@ class Controller:
             final_np = self.backend.fetch(board)
             # FinalTurnComplete carries the true turn count (quirk Q1 fixed)
             # and the alive-cell list tests consume (gol_test.go:33-41).
-            self._emit(FinalTurnComplete(turn, tuple(alive_cells_from_board(final_np))))
+            self._emit(FinalTurnComplete(turn, AliveCells.from_board(final_np)))
             # Final PGM write, no ImageOutputComplete for it — matching the
             # reference (gol/distributor.go:246-253 emits no event).
             pgm.write_pgm(p.out_dir / f"{p.final_output_name}.pgm", final_np)

@@ -168,10 +168,26 @@ def step(a: jax.Array, rule: LifeRule = CONWAY) -> jax.Array:
     return apply_rule_planes(total_planes(a), a, rule)
 
 
+def _needs_wide_counts(ncells: int) -> bool:
+    """Boards whose alive population could exceed 2^31 (≥ 46341² dense)."""
+    return ncells >= 2**31
+
+
+def _count_dtype(ncells: int):
+    """Accumulator dtype for alive counts: int32 except where
+    ``_needs_wide_counts``, then int64 when available (the count drivers
+    enable x64 for the trace; without it this canonicalizes back to int32,
+    the best the platform offers)."""
+    if _needs_wide_counts(ncells):
+        return jax.dtypes.canonicalize_dtype(jnp.int64)
+    return jnp.int32
+
+
 def alive_count(a: jax.Array) -> jax.Array:
-    """Alive cells in a packed board (int32 scalar; exact below 2^31 alive —
-    every oracle and benchmark board is far below)."""
-    return jnp.sum(jax.lax.population_count(a), dtype=jnp.int32)
+    """Alive cells in a packed board (scalar; int32 below 2^31 cells, int64
+    above when the caller traced under x64 — the steps_with_counts drivers
+    do this automatically)."""
+    return jnp.sum(jax.lax.population_count(a), dtype=_count_dtype(a.size * WORD))
 
 
 # -- jitted drivers (packed in, packed out) -----------------------------------
@@ -184,14 +200,24 @@ def superstep(a: jax.Array, rule: LifeRule, turns: int) -> jax.Array:
 
 
 @partial(jax.jit, static_argnames=("rule", "turns"))
-def steps_with_counts(a: jax.Array, rule: LifeRule, turns: int):
-    """``turns`` generations → (packed board, int32[turns] per-turn counts)."""
-
+def _steps_with_counts(a: jax.Array, rule: LifeRule, turns: int):
     def body(b, _):
         nb = step(b, rule)
         return nb, alive_count(nb)
 
     return jax.lax.scan(body, a, None, length=turns)
+
+
+def steps_with_counts(a: jax.Array, rule: LifeRule, turns: int):
+    """``turns`` generations → (packed board, int[turns] per-turn counts).
+
+    Counts are int32 below 2^31 cells; boards at/above that (65536²…) are
+    traced under x64 so the telemetry accumulates in int64 instead of
+    silently overflowing."""
+    if _needs_wide_counts(a.size * WORD):
+        with jax.enable_x64(True):
+            return _steps_with_counts(a, rule, turns)
+    return _steps_with_counts(a, rule, turns)
 
 
 # -- byte-board drivers (engine-layer drop-ins) -------------------------------
@@ -213,11 +239,17 @@ def make_superstep(rule: LifeRule = CONWAY):
 
 
 def make_steps_with_counts(rule: LifeRule = CONWAY):
-    """``(board_u8, turns) -> (board_u8, int32[turns])``."""
+    """``(board_u8, turns) -> (board_u8, int[turns])``."""
 
     @partial(jax.jit, static_argnames=("turns",))
-    def run(board: jax.Array, turns: int):
-        final, counts = steps_with_counts(pack(board), rule, turns)
+    def _run(board: jax.Array, turns: int):
+        final, counts = _steps_with_counts(pack(board), rule, turns)
         return unpack(final), counts
+
+    def run(board: jax.Array, turns: int):
+        if _needs_wide_counts(board.size):
+            with jax.enable_x64(True):
+                return _run(board, turns)
+        return _run(board, turns)
 
     return run

@@ -88,7 +88,12 @@ def is_vmem_resident(shape: tuple[int, int]) -> bool:
 
 def _tiled_supports(shape: tuple[int, int]) -> bool:
     h, wp = shape
-    return wp % _LANES == 0 and h % 8 == 0 and h >= 8
+    if wp % _LANES or h % 8 or h < 8:
+        return False
+    # Alignment alone is not enough: very wide, short boards (wp large, h
+    # small) can have no VMEM-feasible tile even at the minimum pad, and
+    # launch_turns would raise at run time.  supports() must be the truth.
+    return _tile_for_pad(h, wp, 8) is not None
 
 
 def supports(shape: tuple[int, int]) -> bool:
@@ -233,7 +238,9 @@ def _kernel(x_hbm, o_ref, tile, sems, *, tile_h, pad, grid, turns, rule):
 
 
 def _use_interpret() -> bool:
-    return jax.default_backend() == "cpu"
+    # The kernel uses pltpu primitives (pltpu.roll, make_async_copy) that
+    # only lower on TPU; every other backend (cpu, gpu) runs interpret mode.
+    return jax.default_backend() != "tpu"
 
 
 @functools.lru_cache(maxsize=None)

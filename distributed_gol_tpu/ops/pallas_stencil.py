@@ -126,7 +126,8 @@ def _stencil_kernel(
 
 
 def _use_interpret() -> bool:
-    return jax.default_backend() == "cpu"
+    # pltpu primitives only lower on TPU; interpret everywhere else.
+    return jax.default_backend() != "tpu"
 
 
 @functools.lru_cache(maxsize=None)

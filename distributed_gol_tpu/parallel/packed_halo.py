@@ -73,10 +73,8 @@ def _local_step(local: jax.Array, rule: LifeRule) -> jax.Array:
     return apply_rule_planes(totals, centre, rule)
 
 
-def _local_count(local: jax.Array) -> jax.Array:
-    return lax.psum(
-        jnp.sum(lax.population_count(local), dtype=jnp.int32), ("y", "x")
-    )
+def _local_count(local: jax.Array, dtype=jnp.int32) -> jax.Array:
+    return lax.psum(jnp.sum(lax.population_count(local), dtype=dtype), ("y", "x"))
 
 
 def sharded_superstep(mesh: Mesh, rule: LifeRule):
@@ -93,25 +91,42 @@ def sharded_superstep(mesh: Mesh, rule: LifeRule):
     return run
 
 
+def _counting_scan(mesh: Mesh, rule: LifeRule, dtype, turns: int):
+    """The shard_map'd step+count scan shared by the packed and byte count
+    drivers: (packed board) -> (packed board, int[turns] global counts)."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=BOARD_SPEC,
+        out_specs=(BOARD_SPEC, P()),
+    )
+    def inner(local):
+        def body(b, _):
+            nb = _local_step(b, rule)
+            return nb, _local_count(nb, dtype)
+
+        return lax.scan(body, local, None, length=turns)
+
+    return inner
+
+
 def sharded_steps_with_counts(mesh: Mesh, rule: LifeRule):
-    """Jitted (packed, turns) -> (packed, int32[turns] global counts)."""
+    """(packed, turns) -> (packed, int[turns] global counts).  Counts are
+    int32 below 2^31 board cells; at/above (65536²…) the trace runs under
+    x64 so the psum accumulates in int64 instead of silently overflowing."""
+    from distributed_gol_tpu.ops.packed import WORD, _count_dtype, _needs_wide_counts
 
     @partial(jax.jit, static_argnames=("turns",))
+    def _run(board, turns: int):
+        dtype = _count_dtype(board.size * WORD)
+        return _counting_scan(mesh, rule, dtype, turns)(board)
+
     def run(board, turns: int):
-        @partial(
-            jax.shard_map,
-            mesh=mesh,
-            in_specs=BOARD_SPEC,
-            out_specs=(BOARD_SPEC, P()),
-        )
-        def inner(local):
-            def body(b, _):
-                nb = _local_step(b, rule)
-                return nb, _local_count(nb)
-
-            return lax.scan(body, local, None, length=turns)
-
-        return inner(board)
+        if _needs_wide_counts(board.size * WORD):
+            with jax.enable_x64(True):
+                return _run(board, turns)
+        return _run(board, turns)
 
     return run
 
@@ -143,14 +158,23 @@ def make_superstep_bytes(mesh: Mesh, rule: LifeRule):
 
 
 def make_steps_with_counts_bytes(mesh: Mesh, rule: LifeRule):
-    from distributed_gol_tpu.ops.packed import pack, unpack
-
-    inner = sharded_steps_with_counts(mesh, rule)
+    from distributed_gol_tpu.ops.packed import (
+        _count_dtype,
+        _needs_wide_counts,
+        pack,
+        unpack,
+    )
 
     @partial(jax.jit, static_argnames=("turns",))
-    def run(board, turns: int):
+    def _run(board, turns: int):
         p = jax.lax.with_sharding_constraint(pack(board), packed_sharding(mesh))
-        final, counts = inner(p, turns)
+        final, counts = _counting_scan(mesh, rule, _count_dtype(board.size), turns)(p)
         return unpack(final), counts
+
+    def run(board, turns: int):
+        if _needs_wide_counts(board.size):
+            with jax.enable_x64(True):
+                return _run(board, turns)
+        return _run(board, turns)
 
     return run

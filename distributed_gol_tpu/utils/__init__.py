@@ -1,7 +1,7 @@
 """Shared utilities (reference: ``util/`` — ``cell.go``, ``check.go``,
 ``visualise.go``)."""
 
-from distributed_gol_tpu.utils.cell import Cell
+from distributed_gol_tpu.utils.cell import AliveCells, Cell
 from distributed_gol_tpu.utils.visualise import alive_cells_to_string
 
-__all__ = ["Cell", "alive_cells_to_string"]
+__all__ = ["AliveCells", "Cell", "alive_cells_to_string"]
