@@ -43,61 +43,65 @@ class Backend:
         if params.engine == "pallas" and (ny, nx) != (1, 1):
             raise NotImplementedError(
                 "engine='pallas' is single-device for now; sharded meshes use "
-                "engine='packed' (word-granular halos) or 'roll'"
+                "engine='pallas-packed' (row meshes), 'packed', or 'roll'"
             )
         if (ny, nx) == (1, 1):
             self.mesh = None
             self._sharding = None
             self.engine_used = self._resolve_single(params, shape)
             if self.engine_used == "pallas-packed":
-                from distributed_gol_tpu.ops import packed, pallas_packed
+                from distributed_gol_tpu.ops import pallas_packed
 
-                # Supersteps through the temporally-blocked VMEM kernel;
-                # per-turn telemetry (counts) through the XLA packed engine —
-                # both bit-identical, each fastest at its access pattern.
                 self._superstep = pallas_packed.make_superstep_bytes(params.rule)
-                self._steps_with_counts = packed.make_steps_with_counts(params.rule)
             elif self.engine_used == "packed":
                 from distributed_gol_tpu.ops import packed
 
                 self._superstep = packed.make_superstep(params.rule)
-                self._steps_with_counts = packed.make_steps_with_counts(params.rule)
             elif self.engine_used == "pallas":
                 from distributed_gol_tpu.ops import pallas_stencil
 
                 self._superstep = pallas_stencil.make_superstep(params.rule)
-                self._steps_with_counts = pallas_stencil.make_steps_with_counts(
-                    params.rule
-                )
             else:
                 self._superstep = lambda b, k: stencil.superstep(b, self.table, k)
-                self._steps_with_counts = lambda b, k: stencil.steps_with_counts(
-                    b, self.table, k
-                )
         else:
             self.mesh = mesh_lib.make_mesh((ny, nx), devices)
             self._sharding = halo.board_sharding(self.mesh)
-            use_packed = params.engine in ("packed", "pallas-packed", "auto")
-            if params.engine == "auto" and params.runtime_superstep() == 1:
-                use_packed = False  # per-turn pack/unpack never amortises
-            if use_packed:
+            self.engine_used = self._resolve_sharded(params, shape, (ny, nx))
+            if self.engine_used == "pallas-packed":
+                from distributed_gol_tpu.parallel import pallas_halo
+
+                # T-deep halos: one ppermute exchange per launch buys T
+                # generations — the sharded form of temporal blocking.
+                self._superstep = pallas_halo.make_superstep_bytes(
+                    self.mesh, params.rule
+                )
+            elif self.engine_used == "packed":
                 from distributed_gol_tpu.parallel import packed_halo
 
-                use_packed = packed_halo.supports(shape, (ny, nx))
-            if use_packed:
-                self.engine_used = "packed"
                 self._superstep = packed_halo.make_superstep_bytes(
                     self.mesh, params.rule
                 )
-                self._steps_with_counts = packed_halo.make_steps_with_counts_bytes(
-                    self.mesh, params.rule
-                )
             else:
-                self.engine_used = "roll"
                 _superstep = halo.sharded_superstep(self.mesh)
-                _counts = halo.sharded_steps_with_counts(self.mesh)
                 self._superstep = lambda b, k: _superstep(b, self.table, k)
-                self._steps_with_counts = lambda b, k: _counts(b, self.table, k)
+
+    @staticmethod
+    def _packed_kernel_upgrade(params: Params, supports_fn) -> bool:
+        """Whether to upgrade the packed engine to its Pallas kernel form.
+        Explicit 'pallas-packed' is honoured off-TPU too (interpret mode);
+        'auto' only upgrades on TPU, where the pltpu primitives actually
+        lower — elsewhere the pure-XLA packed engine is the fast correct
+        choice.  ``supports_fn()`` is the kernel's capability gate, imported
+        lazily so stripped jax builds fall back to packed."""
+        want = params.engine == "pallas-packed" or (
+            params.engine == "auto" and jax.default_backend() == "tpu"
+        )
+        if not want:
+            return False
+        try:
+            return supports_fn()
+        except ImportError:
+            return False  # stripped jax build: packed still works
 
     @staticmethod
     def _resolve_single(params: Params, shape: tuple[int, int]) -> str:
@@ -108,8 +112,6 @@ class Backend:
         if params.engine == "roll":
             return "roll"
         if params.engine in ("packed", "pallas-packed", "auto"):
-            import jax
-
             from distributed_gol_tpu.ops import packed
 
             # The byte drivers pack+unpack inside every dispatch; that only
@@ -118,21 +120,14 @@ class Backend:
             # roll stencil, so 'auto' avoids packed there.
             per_turn = params.runtime_superstep() == 1
             if packed.supports(shape) and not (params.engine == "auto" and per_turn):
-                # Explicit 'pallas-packed' is honoured off-TPU too (interpret
-                # mode); 'auto' only upgrades on TPU, where the pltpu
-                # primitives actually lower — on GPU the pure-XLA packed
-                # engine is the fast correct choice.
-                want_kernel = params.engine == "pallas-packed" or (
-                    params.engine == "auto" and jax.default_backend() == "tpu"
-                )
-                if want_kernel:
-                    try:
-                        from distributed_gol_tpu.ops import pallas_packed
 
-                        if pallas_packed.supports((shape[0], shape[1] // 32)):
-                            return "pallas-packed"
-                    except ImportError:
-                        pass  # stripped jax build: packed still works
+                def kernel_ok():
+                    from distributed_gol_tpu.ops import pallas_packed
+
+                    return pallas_packed.supports((shape[0], shape[1] // 32))
+
+                if Backend._packed_kernel_upgrade(params, kernel_ok):
+                    return "pallas-packed"
                 return "packed"
             if params.engine in ("packed", "pallas-packed"):
                 return "roll"
@@ -141,13 +136,39 @@ class Backend:
             from distributed_gol_tpu.ops import pallas_stencil
 
             if pallas_stencil.supports(shape):
-                import jax
-
                 if params.engine == "pallas" or jax.default_backend() == "tpu":
                     return "pallas"
         except ImportError:
             pass  # stripped jax build: roll still works
         return "roll"
+
+    @staticmethod
+    def _resolve_sharded(
+        params: Params, shape: tuple[int, int], mesh_shape: tuple[int, int]
+    ) -> str:
+        """Requested engine -> the engine that runs on a mesh.  Preference
+        (for 'auto'): sharded temporally-blocked pallas kernel on TPU (row
+        meshes), then the per-turn packed word-halo engine, then roll —
+        every path bit-identical, fallbacks change speed only."""
+        if params.engine == "roll":
+            return "roll"
+        # Per-turn-visible runs (viewer => superstep 1): pack/unpack and
+        # temporal blocking never amortise; roll is fastest there.
+        if params.engine == "auto" and params.runtime_superstep() == 1:
+            return "roll"
+        from distributed_gol_tpu.parallel import packed_halo
+
+        if not packed_halo.supports(shape, mesh_shape):
+            return "roll"
+
+        def kernel_ok():
+            from distributed_gol_tpu.parallel import pallas_halo
+
+            return pallas_halo.supports((shape[0], shape[1] // 32), mesh_shape)
+
+        if Backend._packed_kernel_upgrade(params, kernel_ok):
+            return "pallas-packed"
+        return "packed"
 
     # -- board placement -------------------------------------------------------
     def put(self, board: np.ndarray) -> jax.Array:
@@ -160,12 +181,17 @@ class Backend:
         return np.asarray(jax.device_get(board))
 
     # -- compute ---------------------------------------------------------------
-    def run_turns(self, board: jax.Array, turns: int) -> tuple[jax.Array, np.ndarray]:
-        """Advance ``turns`` generations; returns (board, per-turn counts)."""
+    def run_turns(self, board: jax.Array, turns: int) -> tuple[jax.Array, int]:
+        """Advance ``turns`` generations through the engine superstep;
+        returns (board, alive count after the last turn).  The count is one
+        on-device reduction of the final board — per-turn count *vectors*
+        exist at the ops layer (``steps_with_counts``) for telemetry soaks,
+        but the controller only ever latches the superstep-boundary count,
+        so the hot path runs the fastest engine, not the counting scan."""
         if turns == 0:
-            return board, np.zeros(0, dtype=np.int32)
-        new_board, counts = self._steps_with_counts(board, turns)
-        return new_board, np.asarray(counts)
+            return board, self.count(board)
+        new_board = self._superstep(board, turns)
+        return new_board, self.count(new_board)
 
     def run_turn_with_flips(
         self, board: jax.Array
@@ -174,10 +200,10 @@ class Backend:
         arrays).  The diff happens on device (``stencil.flip_mask``); only the
         boolean mask crosses to the host — replaces the reference's O(N²)
         client-side diff loop (``gol/distributor.go:53-59``)."""
-        new_board, counts = self.run_turns(board, 1)
+        new_board, count = self.run_turns(board, 1)
         mask = self.fetch(stencil.flip_mask(board, new_board))
         ys, xs = np.nonzero(mask)
-        return new_board, int(counts[0]), np.stack([ys, xs], axis=1)
+        return new_board, count, np.stack([ys, xs], axis=1)
 
     def count(self, board: jax.Array) -> int:
         return int(stencil.alive_count(board))

@@ -216,11 +216,11 @@ class Controller:
                     # k is already 1 here: runtime_superstep() is 1 whenever
                     # the viewer wants flips, so min() above produced 1.
                 else:
-                    board, counts = self.backend.run_turns(board, k)
+                    board, count = self.backend.run_turns(board, k)
                     for i in range(k):
                         self._emit(TurnComplete(turn + i + 1))
                     turn += k
-                    state.set(turn, int(counts[-1]))
+                    state.set(turn, count)
                 if p.emit_timing:
                     # run_turns/run_turn_with_flips synchronise on the counts
                     # transfer, so this is true dispatch wall-clock.

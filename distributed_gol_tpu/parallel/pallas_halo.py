@@ -1,0 +1,186 @@
+"""Sharded temporal blocking: the pallas-packed engine on a device mesh.
+
+The single-device flagship kernel (``ops/pallas_packed.py``) advances a
+VMEM tile T generations per HBM pass.  Under sharding the same idea moves
+up one level: each device owns a contiguous row strip of the packed board
+(mesh ``(ny, 1)`` — the 2-D analog is unnecessary because the strip is
+already wp words wide on the lane axis), and **one halo exchange buys T
+generations**: ``lax.ppermute`` ships ``pad = round8(T)`` boundary rows
+each way over ICI, the kernel runs T generations on the halo-extended
+strip, and the pad absorbs the T-deep data dependency exactly as it does
+between VMEM tiles.  Communication per generation drops T× vs the per-turn
+halo engines (``parallel/packed_halo.py``) — the same trade the reference
+could never make because its broker re-broadcast the whole board every
+turn (``broker/broker.go:37-56``, ``:157-180``).
+
+Correctness structure:
+
+- Inside a device, the kernel tiles the *extended* strip; each grid step
+  DMAs one contiguous ``(tile_h + 2·pad, wp)`` window — no wrap arithmetic
+  anywhere in the kernel (the mesh-edge wrap is the cyclic ``ppermute``
+  permutation, which self-sends on a 1-sized axis, so ``ny = 1`` IS the
+  single-device torus).
+- Vertical in-tile rotates (``pltpu.roll``) wrap within the tile; that is
+  wrong at tile edges, and absorbed by the pad exactly as in the
+  single-device kernel (``ops/pallas_packed.py``).
+- Horizontal wrap is the exact global lane rotate because every strip
+  spans the full board width — the reason the mesh is (ny, 1).
+
+Bit-identity vs the XLA packed halo engine (itself gated against the
+golden oracles) is test-gated on virtual CPU meshes and on hardware via
+``bench.py --verify``.
+"""
+
+from __future__ import annotations
+
+import functools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh
+
+from distributed_gol_tpu.models.life import CONWAY, LifeRule
+from distributed_gol_tpu.ops.pallas_packed import (
+    _LANES,
+    _gen,
+    _round8,
+    _tile_for_pad,
+    _use_interpret,
+    launch_turns,
+)
+from distributed_gol_tpu.parallel.halo import BOARD_SPEC, _shift_perm
+
+
+def supports(pshape: tuple[int, int], mesh_shape: tuple[int, int]) -> bool:
+    """Whether the packed (H, wp) board runs the sharded temporally-blocked
+    kernel on an (ny, nx) mesh: row-sharded only (nx == 1), strips tall
+    enough to tile, lane-aligned width on real hardware (interpret mode has
+    no lane constraint, so hermetic CPU tests can exercise every shape)."""
+    h, wp = pshape
+    ny, nx = mesh_shape
+    if nx != 1 or h % ny:
+        return False
+    h_loc = h // ny
+    if h_loc % 8 or h_loc < 8:
+        return False
+    if not _use_interpret() and wp % _LANES:
+        return False
+    return _tile_for_pad(h_loc, wp, 8) is not None
+
+
+def _ext_kernel(x_hbm, o_ref, tile, sem, *, tile_h, pad, turns, rule):
+    """T generations of one (tile_h + 2·pad)-row window of the halo-extended
+    strip.  The window is contiguous in the extended input — tile i's halo
+    rows ARE its neighbours' boundary rows — so a single DMA loads it."""
+    i = pl.program_id(0)
+    copy = pltpu.make_async_copy(
+        x_hbm.at[pl.ds(i * tile_h, tile_h + 2 * pad), :], tile.at[:], sem
+    )
+    copy.start()
+    copy.wait()
+    out = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), tile[:])
+    o_ref[:] = out[pad : pad + tile_h, :]
+
+
+@functools.lru_cache(maxsize=None)
+def _build_ext_launch(
+    strip: tuple[int, int], rule: LifeRule, turns: int, interpret: bool
+):
+    """pallas_call advancing a halo-extended (h_loc + 2·pad, wp) strip by
+    ``turns`` ≤ pad generations, returning the (h_loc, wp) centre."""
+    h_loc, wp = strip
+    pad = _round8(turns)
+    tile_h = _tile_for_pad(h_loc, wp, pad)
+    if tile_h is None:
+        raise ValueError(f"no VMEM tiling for {turns} turns on strip {strip}")
+    grid = h_loc // tile_h
+    kernel = partial(_ext_kernel, tile_h=tile_h, pad=pad, turns=turns, rule=rule)
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((tile_h, wp), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((h_loc, wp), jnp.uint32),
+        scratch_shapes=[
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )
+
+
+def _extend_rows(local: jax.Array, pad: int) -> jax.Array:
+    """(h_loc, wp) strip -> (h_loc + 2·pad, wp) with pad boundary rows from
+    the ring neighbours (self-send on a 1-sized axis = the torus wrap)."""
+    ny = lax.axis_size("y")
+    from_north = lax.ppermute(local[-pad:, :], "y", _shift_perm(ny, forward=True))
+    from_south = lax.ppermute(local[:pad, :], "y", _shift_perm(ny, forward=False))
+    return jnp.concatenate([from_north, local, from_south], axis=0)
+
+
+def make_superstep(mesh: Mesh, rule: LifeRule = CONWAY, interpret: bool | None = None):
+    """``(packed, turns) -> packed`` on the mesh: turns split into launches
+    of T = ``launch_turns(strip, turns)`` generations; each launch is one
+    ppermute halo exchange + one pallas_call per device."""
+    ny = mesh.shape["y"]
+
+    @partial(jax.jit, static_argnames=("turns",))
+    def run(board: jax.Array, turns: int) -> jax.Array:
+        if turns == 0:
+            return board
+        ip = _use_interpret() if interpret is None else interpret
+        h, wp = board.shape
+        strip = (h // ny, wp)
+        t = launch_turns(strip, turns)  # clamps to _MAX_T internally
+        full, rem = divmod(turns, t)
+
+        def make_step(tt: int):
+            pad = _round8(tt)
+            call = _build_ext_launch(strip, rule, tt, ip)
+
+            # check_vma=False: pallas_call outputs carry no varying-mesh-axes
+            # annotation, which the vma checker (rightly) refuses to guess;
+            # the body is manifestly per-device (one kernel per strip).
+            @partial(
+                jax.shard_map,
+                mesh=mesh,
+                in_specs=BOARD_SPEC,
+                out_specs=BOARD_SPEC,
+                check_vma=False,
+            )
+            def step(local):
+                return call(_extend_rows(local, pad))
+
+            return step
+
+        step_t = make_step(t)
+        board = jax.lax.fori_loop(0, full, lambda _, b: step_t(b), board)
+        if rem:
+            board = make_step(rem)(board)
+        return board
+
+    return run
+
+
+def make_superstep_bytes(
+    mesh: Mesh, rule: LifeRule = CONWAY, interpret: bool | None = None
+):
+    """``(board_u8, turns) -> board_u8`` engine-layer drop-in: pack/unpack
+    inside the jit, pinned to the mesh sharding so packing stays local."""
+    from distributed_gol_tpu.ops.packed import pack, unpack
+    from distributed_gol_tpu.parallel.packed_halo import packed_sharding
+
+    inner = make_superstep(mesh, rule, interpret)
+
+    @partial(jax.jit, static_argnames=("turns",))
+    def run(board: jax.Array, turns: int) -> jax.Array:
+        if turns == 0:
+            return board
+        p = jax.lax.with_sharding_constraint(pack(board), packed_sharding(mesh))
+        return unpack(inner(p, turns))
+
+    return run
