@@ -109,6 +109,51 @@ def bench_config(size: int, kturns: int, engine: str, reps: int):
     return gps, gps * size * size
 
 
+def verify_engine(size: int, engine: str, turns: int = 64) -> bool:
+    """Hardware correctness record: run ``turns`` generations through the
+    benched engine AND an independent reference engine *on the same device*,
+    compare bit-for-bit.  Interpret-mode tests cannot stand in for this —
+    interpret compiles things hardware rejects (``ops/pallas_stencil.py``) —
+    so every BENCH_r*.json doubles as a hw-correctness artifact.
+
+    Reference engine: the roll stencil for ``packed`` (fully independent
+    formulation), the XLA packed engine for the Pallas kernels (itself
+    gated against roll + the golden oracles).
+    """
+    import jax.numpy as jnp
+
+    from distributed_gol_tpu.models.life import CONWAY
+    from distributed_gol_tpu.ops import packed
+    from distributed_gol_tpu.ops.stencil import superstep as roll_superstep
+
+    table = jnp.asarray(CONWAY.table)
+    board = jnp.asarray(make_board(size, seed=7))
+
+    if engine == "roll":
+        got = roll_superstep(board, table, turns)
+        want = packed.make_superstep(CONWAY)(board, turns)
+    elif engine == "packed":
+        got = packed.make_superstep(CONWAY)(board, turns)
+        want = roll_superstep(board, table, turns)
+    elif engine == "pallas":
+        from distributed_gol_tpu.ops import pallas_stencil
+
+        got = pallas_stencil.make_superstep(CONWAY)(board, turns)
+        want = packed.make_superstep(CONWAY)(board, turns)
+    elif engine == "pallas-packed":
+        from distributed_gol_tpu.ops import pallas_packed
+
+        got = pallas_packed.make_superstep_bytes(CONWAY)(board, turns)
+        want = packed.make_superstep(CONWAY)(board, turns)
+    else:
+        raise ValueError(f"unknown engine {engine!r}")
+
+    ok = bool(jnp.array_equal(got, want))
+    log(f"  verify {size}x{size} engine={engine} vs independent engine, "
+        f"{turns} gens: {'bit-identical' if ok else 'MISMATCH'}")
+    return ok
+
+
 def pick_engine(requested: str, size: int) -> str:
     """Resolve 'auto' and downgrade unsupported engines — the metric name
     must record the engine actually run.  'auto' prefers the bit-packed SWAR
@@ -199,6 +244,11 @@ def main():
     )
     ap.add_argument("--reps", type=int, default=4)
     ap.add_argument("--all", action="store_true", help="also bench 512/4096 configs")
+    ap.add_argument(
+        "--no-verify",
+        action="store_true",
+        help="skip the post-timing cross-engine bit-identity check",
+    )
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -224,17 +274,16 @@ def main():
 
     gps, cups = bench_config(size, args.kturns, engine, args.reps)
 
-    baseline = 1_000_000.0  # north-star gens/sec (BASELINE.md)
-    print(
-        json.dumps(
-            {
-                "metric": f"gol_gens_per_sec_{size}x{size}_{engine}_{dev.platform}",
-                "value": round(gps, 2),
-                "unit": "generations/sec",
-                "vs_baseline": round(gps / baseline, 4),
-            }
-        )
-    )
+    record = {
+        "metric": f"gol_gens_per_sec_{size}x{size}_{engine}_{dev.platform}",
+        "value": round(gps, 2),
+        "unit": "generations/sec",
+        # north-star gens/sec (BASELINE.md)
+        "vs_baseline": round(gps / 1_000_000.0, 4),
+    }
+    if not args.no_verify:
+        record["bit_identical"] = verify_engine(size, engine)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":
