@@ -28,8 +28,10 @@ from distributed_gol_tpu.engine.events import (
     AliveCellsCount,
     CellFlipped,
     CellsFlipped,
+    DispatchError,
     Event,
     FinalTurnComplete,
+    FrameReady,
     ImageOutputComplete,
     State,
     StateChange,
@@ -43,8 +45,10 @@ __all__ = [
     "Cell",
     "CellFlipped",
     "CellsFlipped",
+    "DispatchError",
     "Event",
     "FinalTurnComplete",
+    "FrameReady",
     "ImageOutputComplete",
     "Params",
     "State",

@@ -205,5 +205,16 @@ class Backend:
         ys, xs = np.nonzero(mask)
         return new_board, count, np.stack([ys, xs], axis=1)
 
+    def run_turn_with_frame(
+        self, board: jax.Array, fy: int, fx: int
+    ) -> tuple[jax.Array, int, np.ndarray]:
+        """One generation, returning (board, alive count, device-pooled
+        frame).  The max-pool runs on device (``stencil.frame_pool``) so the
+        host transfer is the pooled frame, not the board — the large-board
+        viewer path (SURVEY.md §7 hard part 4)."""
+        new_board, count = self.run_turns(board, 1)
+        frame = self.fetch(stencil.frame_pool(new_board, fy, fx))
+        return new_board, count, frame
+
     def count(self, board: jax.Array) -> int:
         return int(stencil.alive_count(board))

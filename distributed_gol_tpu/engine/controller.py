@@ -37,7 +37,9 @@ from distributed_gol_tpu.engine.events import (
     AliveCellsCount,
     CellFlipped,
     CellsFlipped,
+    DispatchError,
     FinalTurnComplete,
+    FrameReady,
     ImageOutputComplete,
     State,
     StateChange,
@@ -90,6 +92,10 @@ class _Ticker(threading.Thread):
 
 
 class Controller:
+    # Largest adaptive dispatch: bounds one dispatch's TurnComplete flood
+    # and the set of jit specialisations the growth path can request.
+    _ADAPT_CAP = 16384
+
     def __init__(
         self,
         params: Params,
@@ -135,6 +141,12 @@ class Controller:
         elif key == "p":
             self._paused = not self._paused
             self.session.pause(self._paused)
+            # Quirk Q9 (deliberate): the reference reports ``turn + 1`` here
+            # (gol/distributor.go:133-137) because its pause lands while a
+            # turn-RPC is mid-flight and THAT turn will still complete.  Our
+            # pause lands at a superstep boundary — no turn is in flight —
+            # so ``turn`` is the true completed count and +1 would be a lie.
+            # Same truth-over-parity policy as Q1 (README quirk table).
             self._emit(
                 StateChange(turn, State.PAUSED if self._paused else State.EXECUTING)
             )
@@ -169,6 +181,37 @@ class Controller:
             if not self._paused and self.key_presses.empty():
                 return
 
+    # -- failure surface -------------------------------------------------------
+    def _dispatch(self, step, board, turn: int):
+        """Run one device dispatch with the broker's retry semantics
+        (``broker/broker.go:67-73``: a failed worker RPC is re-queued once a
+        consumer exists).  Here: retry the superstep once from the last good
+        board; if the retry fails too, park that board as a paused
+        checkpoint on the session — the same resumable state a 'q' detach
+        leaves — emit a terminal DispatchError, and re-raise (``run()``
+        still guarantees the stream sentinel)."""
+        try:
+            return step()
+        except Exception as e:  # noqa: BLE001 — any device/runtime failure
+            self._emit(DispatchError(turn, error=str(e), will_retry=True))
+            try:
+                return step()
+            except Exception as e2:
+                checkpointed = False
+                try:
+                    self.session.pause(
+                        True, world=self.backend.fetch(board), turn=turn
+                    )
+                    checkpointed = True
+                except Exception:  # device wedged: board unfetchable
+                    pass
+                self._emit(
+                    DispatchError(
+                        turn, error=str(e2), checkpointed=checkpointed
+                    )
+                )
+                raise
+
     # -- the run (distributor, gol/distributor.go:194-262) ---------------------
     def run(self):
         """Drive the whole run; the event stream is always terminated with
@@ -186,7 +229,25 @@ class Controller:
         board_np, start_turn = self._initial_world()
 
         viewer_wants_flips = p.wants_flips()
+        viewer_wants_frames = p.wants_frames()
+        fy, fx = p.frame_factors()
         superstep = p.runtime_superstep()
+        # Adaptive dispatch (superstep=0, headless): grow the dispatch size
+        # until one dispatch takes ~max_dispatch_seconds, so deep temporal
+        # blocking amortises without unbounded keypress latency (VERDICT
+        # weak-6; SURVEY §7 hard part 3).  Powers of two bound the number
+        # of distinct jit specialisations; _ADAPT_CAP bounds the per-turn
+        # event flood of one dispatch.
+        adaptive = (
+            p.superstep == 0
+            and p.no_vis
+            and not viewer_wants_flips
+            and not viewer_wants_frames
+        )
+        # First dispatch at each size includes jit compilation; adapting on
+        # that wall-clock would halve/oscillate forever.  Only dispatches
+        # at an already-compiled size update the size.
+        warm_sizes: set[int] = set()
 
         # Initial flips: one per alive cell of the *actual* starting world
         # (the reference emits them from the freshly loaded PGM even when it
@@ -194,6 +255,13 @@ class Controller:
         if viewer_wants_flips:
             ys, xs = np.nonzero(board_np)
             self._emit_flips(start_turn, np.stack([ys, xs], axis=1))
+        elif viewer_wants_frames:
+            # Large-board viewer: the starting frame, through the same
+            # pooling op every later frame uses (one startup round-trip).
+            from distributed_gol_tpu.ops import stencil
+
+            pooled = np.asarray(stencil.frame_pool(np.asarray(board_np), fy, fx))
+            self._emit(FrameReady(start_turn, pooled, (fy, fx)))
 
         board = self.backend.put(board_np)
         turn = start_turn
@@ -206,25 +274,50 @@ class Controller:
                 if self._outcome != "completed":
                     break
                 k = min(superstep, p.turns - turn)  # superstep is 1 for viewers
-                t0 = time.perf_counter() if p.emit_timing else 0.0
+                t0 = time.perf_counter() if (p.emit_timing or adaptive) else 0.0
                 if viewer_wants_flips:
-                    board, count, coords = self.backend.run_turn_with_flips(board)
+                    board, count, coords = self._dispatch(
+                        lambda: self.backend.run_turn_with_flips(board),
+                        board,
+                        turn,
+                    )
                     turn += 1
                     state.set(turn, count)
                     self._emit_flips(turn, coords)
                     self._emit(TurnComplete(turn))
                     # k is already 1 here: runtime_superstep() is 1 whenever
                     # the viewer wants flips, so min() above produced 1.
+                elif viewer_wants_frames:
+                    board, count, frame = self._dispatch(
+                        lambda: self.backend.run_turn_with_frame(board, fy, fx),
+                        board,
+                        turn,
+                    )
+                    turn += 1
+                    state.set(turn, count)
+                    self._emit(FrameReady(turn, frame, (fy, fx)))
+                    self._emit(TurnComplete(turn))
                 else:
-                    board, count = self.backend.run_turns(board, k)
+                    board, count = self._dispatch(
+                        lambda: self.backend.run_turns(board, k), board, turn
+                    )
                     for i in range(k):
                         self._emit(TurnComplete(turn + i + 1))
                     turn += k
                     state.set(turn, count)
-                if p.emit_timing:
+                if p.emit_timing or adaptive:
                     # run_turns/run_turn_with_flips synchronise on the counts
                     # transfer, so this is true dispatch wall-clock.
-                    self._emit(TurnTiming(turn, k, time.perf_counter() - t0))
+                    dt = time.perf_counter() - t0
+                    if p.emit_timing:
+                        self._emit(TurnTiming(turn, k, dt))
+                    if adaptive and k == superstep:
+                        if k not in warm_sizes:
+                            warm_sizes.add(k)  # compile dispatch: don't adapt
+                        elif dt < p.max_dispatch_seconds / 2:
+                            superstep = min(superstep * 2, self._ADAPT_CAP)
+                        elif dt > p.max_dispatch_seconds * 1.5 and superstep > 1:
+                            superstep = max(1, superstep // 2)
         finally:
             ticker.stop()
             ticker.join()

@@ -95,6 +95,27 @@ class CellsFlipped(Event):
 
 
 @dataclass(frozen=True)
+class FrameReady(Event):
+    """A device-pooled viewer frame for one turn (framework extension).
+
+    Above ``Params._FLIP_VIEW_MAX_CELLS`` an "auto" viewer is fed these
+    instead of per-cell flips: the board is max-pooled on device to at most
+    ``Params.frame_max`` cells, so the per-turn host transfer is bounded
+    regardless of board size (SURVEY.md §7 hard part 4 — the reference
+    fetched and rendered every pixel every turn, ``sdl/window.go:56-64``).
+    ``frame`` is a uint8 (rows, cols) array; a nonzero entry means some cell
+    in that tile is alive.  Ordering matches flips: the frame for a turn is
+    delivered before that turn's TurnComplete."""
+
+    # np.ndarray; excluded from the generated __eq__/__hash__ (arrays are
+    # unhashable and their __eq__ is elementwise) — two FrameReady events
+    # compare by (turn, factors), like every other event compares by its
+    # scalar fields.
+    frame: object = field(default=None, compare=False)
+    factors: tuple = (1, 1)  # (fy, fx) pooling factors
+
+
+@dataclass(frozen=True)
 class TurnComplete(Event):
     """A full generation finished; a viewer may render (``gol/event.go:53-58``)."""
 
@@ -110,6 +131,32 @@ class FinalTurnComplete(Event):
     viewers exit, matching reference behaviour."""
 
     alive: Sequence[Cell] = field(default_factory=tuple)
+
+
+@dataclass(frozen=True)
+class DispatchError(Event):
+    """A device dispatch failed (framework extension).  The host-level
+    analog of the reference broker re-queuing a failed worker RPC
+    (``broker/broker.go:67-73``): the controller retries the superstep once
+    from the last good board; if the retry also fails it parks a checkpoint
+    on the session (resumable like a 'q' detach) and aborts the run — the
+    stream still ends with the sentinel either way.
+
+    ``will_retry``: this failure is about to be retried.
+    ``checkpointed``: terminal failure, last good board parked on the session.
+    """
+
+    error: str = ""
+    will_retry: bool = False
+    checkpointed: bool = False
+
+    def __str__(self) -> str:
+        action = (
+            "retrying"
+            if self.will_retry
+            else ("checkpointed" if self.checkpointed else "aborting")
+        )
+        return f"Dispatch error ({action}): {self.error}"
 
 
 @dataclass(frozen=True)
@@ -138,7 +185,9 @@ AnyEvent = Union[
     StateChange,
     CellFlipped,
     CellsFlipped,
+    FrameReady,
     TurnComplete,
     FinalTurnComplete,
+    DispatchError,
     TurnTiming,
 ]

@@ -31,9 +31,17 @@ class Params:
     rule: LifeRule = CONWAY
     # Generations per device dispatch when running headless.  1 => per-turn
     # host visibility (exact CellFlipped streams, as the SDL viewer needs);
-    # larger values amortise dispatch overhead; 0 => auto (1 with a viewer,
-    # a bandwidth-friendly default otherwise).
+    # larger values amortise dispatch overhead; 0 => auto (1 with a viewer;
+    # headless an *adaptive* dispatch size that grows until one dispatch
+    # takes ~max_dispatch_seconds — deep temporal blocking without
+    # unbounded keypress latency).
     superstep: int = 0
+    # Target wall-clock per device dispatch in adaptive (superstep=0)
+    # headless mode.  Bounds interactivity: s/p/q/k keypresses are polled
+    # between dispatches, so worst-case response is ~2x this value (one
+    # overshooting dispatch) plus queue latency.  Explicit superstep > 0
+    # opts out of the bound — the user chose their granularity.
+    max_dispatch_seconds: float = 0.25
     # "roll" (jnp.roll stencil, always correct) | "pallas" (tuned byte TPU
     # kernel) | "packed" (bit-packed SWAR, 32 cells/word) | "pallas-packed"
     # (packed + temporally-blocked Pallas kernel — fastest on TPU) | "auto"
@@ -45,6 +53,14 @@ class Params:
     # "batch" (one CellsFlipped per turn), "off".  Any flip mode forces
     # superstep 1 — exact per-turn diffs need per-turn host visibility.
     flip_events: str = "auto"
+    # Viewer feed policy: "auto" (exact per-cell flips up to
+    # _FLIP_VIEW_MAX_CELLS, device-pooled frames above), "flips" (always
+    # the exact reference contract), "frame" (always pooled frames).
+    # Frames cap the per-turn host transfer at ``frame_max`` uint8 cells
+    # regardless of board size (SURVEY.md §7 hard part 4).
+    view_mode: str = "auto"
+    # Max (rows, cols) of a device-pooled viewer frame.
+    frame_max: tuple[int, int] = (512, 512)
     # AliveCellsCount cadence in seconds (reference: 2000 ms ticker,
     # gol/distributor.go:228); configurable so tests can run fast.
     ticker_period: float = 2.0
@@ -71,11 +87,18 @@ class Params:
             raise ValueError(f"unknown engine {self.engine!r}")
         if self.flip_events not in ("auto", "cell", "batch", "off"):
             raise ValueError(f"unknown flip_events {self.flip_events!r}")
+        if self.view_mode not in ("auto", "flips", "frame"):
+            raise ValueError(f"unknown view_mode {self.view_mode!r}")
+        fh, fw = self.frame_max
+        if fh < 1 or fw < 1:
+            raise ValueError(f"frame_max must be positive, got {self.frame_max}")
         ny, nx = self.mesh_shape
         if ny < 1 or nx < 1:
             raise ValueError(f"mesh_shape must be positive, got {self.mesh_shape}")
         if self.ticker_period <= 0:
             raise ValueError("ticker_period must be positive")
+        if self.max_dispatch_seconds <= 0:
+            raise ValueError("max_dispatch_seconds must be positive")
         # Paths may arrive as strings from CLI/config files.
         object.__setattr__(self, "images_dir", Path(self.images_dir))
         object.__setattr__(self, "out_dir", Path(self.out_dir))
@@ -108,15 +131,48 @@ class Params:
         # hard part 3: interactivity is at superstep granularity).
         return min(self.turns, 50) if self.turns else 1
 
+    # Boards above this cell count switch an "auto" viewer from exact
+    # per-cell flips to device-pooled frames (a 2048² flip fetch is already
+    # a 4 MB mask/turn; frames cap it at frame_max cells).
+    _FLIP_VIEW_MAX_CELLS = 2**21
+
     def wants_flips(self) -> bool:
         """Whether this run emits per-turn CellFlipped/CellsFlipped events
         (which forces per-turn host visibility)."""
-        return self.flip_events in ("cell", "batch") or (
-            self.flip_events == "auto" and not self.no_vis
+        if self.flip_events in ("cell", "batch"):
+            return True
+        return (
+            self.flip_events == "auto"
+            and not self.no_vis
+            and not self.wants_frames()
+        )
+
+    def wants_frames(self) -> bool:
+        """Whether an attached viewer is fed device-pooled frames instead of
+        exact flips (large boards; SURVEY.md §7 hard part 4).  An explicit
+        ``flip_events`` of "cell"/"batch" is the exact reference contract
+        and always wins over frames."""
+        if self.no_vis or self.flip_events in ("cell", "batch"):
+            return False
+        if self.view_mode == "frame":
+            return True
+        return (
+            self.view_mode == "auto"
+            and self.image_width * self.image_height > self._FLIP_VIEW_MAX_CELLS
+        )
+
+    def frame_factors(self) -> tuple[int, int]:
+        """(fy, fx) pooling factors mapping the board into frame_max."""
+        fh, fw = self.frame_max
+        return (
+            max(1, -(-self.image_height // fh)),
+            max(1, -(-self.image_width // fw)),
         )
 
     def runtime_superstep(self) -> int:
         """Generations per device dispatch the controller will actually use —
         the single source of truth shared by the controller's run loop and
         the backend's engine auto-selection."""
-        return 1 if self.wants_flips() else self.effective_superstep(False)
+        if self.wants_flips() or self.wants_frames():
+            return 1
+        return self.effective_superstep(False)

@@ -124,26 +124,50 @@ def _east(a: jax.Array) -> jax.Array:
 
 
 def total_planes(a: jax.Array):
-    """The 9-cell (centre + 8 neighbours) sum as 4 bit planes, T ∈ [0, 9]."""
-    n = jnp.roll(a, 1, axis=0)
-    s = jnp.roll(a, -1, axis=0)
-    v0 = a ^ n ^ s  # column sums of the 3-row window, 2-bit
-    v1 = _maj(a, n, s)
-    s0 = v0 ^ _west(v0) ^ _east(v0)  # weight-1 plane of the horizontal sum
-    c0 = _maj(v0, _west(v0), _east(v0))  # weight 2
-    s1 = v1 ^ _west(v1) ^ _east(v1)  # weight 2
-    c1 = _maj(v1, _west(v1), _east(v1))  # weight 4
-    k = c0 & s1  # carry out of the weight-2 column
-    return s0, c0 ^ s1, c1 ^ k, c1 & k
+    """The 9-cell (centre + 8 neighbours) sum as 4 bit planes, T ∈ [0, 9].
+
+    Expensive-axis-first: the cross-word horizontal sum (shift + carry
+    splice, ~4 ops per shifted plane) runs on the *one* raw board plane;
+    only the cheap axis-0 rolls then run on the two partial-sum planes.
+    The reverse order would pay the cross-word splice on both planes —
+    measured ~20% more ops per generation for identical results."""
+    w = _west(a)
+    e = _east(a)
+    h0 = a ^ w ^ e  # row sums of the 3-column window, 2-bit
+    h1 = _maj(a, w, e)
+    n0 = jnp.roll(h0, 1, axis=0)
+    s0 = jnp.roll(h0, -1, axis=0)
+    n1 = jnp.roll(h1, 1, axis=0)
+    s1 = jnp.roll(h1, -1, axis=0)
+    t0 = h0 ^ n0 ^ s0  # weight-1 plane of the 9-cell total
+    c = _maj(h0, n0, s0)  # weight 2
+    p1 = h1 ^ n1 ^ s1  # weight 2
+    q = _maj(h1, n1, s1)  # weight 4
+    k = p1 & c  # carry out of the weight-2 column
+    return t0, p1 ^ c, q ^ k, q & k
+
+
+_MAX_TOTAL = 9  # centre + 8 neighbours
 
 
 def _match(planes, k: int) -> jax.Array:
-    """Plane that is all-ones where the 4-bit plane number equals ``k``."""
-    n0, n1, n2, n3 = planes
-    acc = n0 if k & 1 else ~n0
-    acc &= n1 if k & 2 else ~n1
-    acc &= n2 if k & 4 else ~n2
-    acc &= n3 if k & 8 else ~n3
+    """Plane that is all-ones where the 4-bit plane number equals ``k``,
+    given the number is ≤ ``_MAX_TOTAL``.
+
+    A zero bit ``i`` of ``k`` needs testing (``& ~n_i``) only if the alias
+    ``k + 2^i`` is a reachable total; every alias that sets any skipped bit
+    ``i`` has value ≥ k + 2^i > _MAX_TOTAL, so per-bit skipping is sound.
+    For Conway this removes the top plane from both rule terms — and with
+    no consumer left, the compiler dead-codes the plane's adder too."""
+    acc = None
+    for i, n in enumerate(planes):
+        if k & (1 << i):
+            term = n
+        elif k + (1 << i) <= _MAX_TOTAL:
+            term = ~n
+        else:
+            continue
+        acc = term if acc is None else acc & term
     return acc
 
 
@@ -153,14 +177,25 @@ def apply_rule_planes(totals, centre: jax.Array, rule: LifeRule) -> jax.Array:
     engine variant that produces total planes).
 
     No neighbour-count subtraction is needed: a dead cell has T == NC, a
-    live cell T == NC + 1, so birth terms match ``T == b`` and survive terms
-    ``T == s + 1`` — saving the 10-op ripple borrow per generation."""
-    out = jnp.zeros_like(centre)
-    for b in sorted(rule.birth):
-        out |= _match(totals, b) & ~centre
-    for s in sorted(rule.survive):
-        out |= _match(totals, s + 1) & centre
-    return out
+    live cell T == NC + 1, so birth terms match ``T == b`` and survive
+    terms ``T == s + 1``.  A total matched by both a birth and a survive
+    term is centre-independent (dead→born, live→survives), so the centre
+    mask cancels: Conway's B3/S23 compiles to ``(T==3) | (centre & (T==4))``
+    — two matches, no ``~centre`` term."""
+    birth = set(rule.birth)
+    survive = {s + 1 for s in rule.survive}
+    out = None
+
+    def _or(acc, term):
+        return term if acc is None else acc | term
+
+    for k in sorted(birth & survive):
+        out = _or(out, _match(totals, k))
+    for k in sorted(birth - survive):
+        out = _or(out, _match(totals, k) & ~centre)
+    for k in sorted(survive - birth):
+        out = _or(out, _match(totals, k) & centre)
+    return jnp.zeros_like(centre) if out is None else out
 
 
 def step(a: jax.Array, rule: LifeRule = CONWAY) -> jax.Array:

@@ -57,12 +57,24 @@ from distributed_gol_tpu.ops.packed import (
 )
 
 _LANES = 128
-_VMEM_BUDGET = 10 << 20
+# Tile-size budget for the temporally-blocked tiled path.  The default
+# Mosaic scoped-VMEM limit is 16 MB, but v5e has 128 MB of VMEM and
+# ``vmem_limit_bytes`` raises the ceiling per kernel; 50 MB admits a
+# 4096-row tile at 16384² (halo redundancy 1.6% vs 50% at the 16 MB
+# default) — measured 8,307 vs 4,706 gens/s on hardware.
+_VMEM_BUDGET = 50 << 20
 # Peak live bit-planes during one generation (tile + n/s or v/shifted pairs
 # + rule accumulator); Mosaic manages them, this budgets the tile size.
 _PLANES = 6
 _MAX_T = 128  # generations per HBM pass at the headline configs
+# Un-overlapped DMA + launch overhead per HBM pass, as a fraction of one
+# generation's compute (see launch_turns).
+_LAUNCH_COST = 1.5
 # VMEM-resident path: whole board + loop carry + temps live in VMEM at once.
+# Separate (conservative) budget: this envelope is hardware-validated at
+# 512²…3072² and, unlike the tiled path, has no redundancy to win back by
+# growing it.
+_VRESIDENT_BUDGET = 10 << 20
 _VRESIDENT_PLANES = 8
 
 
@@ -75,7 +87,7 @@ def _vmem_resident_shape(h: int, wp: int) -> tuple[int, int] | None:
     w = wp * 32
     if h % 256 or w % _LANES:
         return None
-    if _VRESIDENT_PLANES * (h // 32) * w * 4 > _VMEM_BUDGET:
+    if _VRESIDENT_PLANES * (h // 32) * w * 4 > _VRESIDENT_BUDGET:
         return None
     return (h // 32, w)
 
@@ -107,6 +119,17 @@ def _round8(x: int) -> int:
     return (x + 7) // 8 * 8
 
 
+def _compiler_params(tile_h: int, pad: int, wp: int) -> pltpu.CompilerParams:
+    """Raise Mosaic's scoped-VMEM ceiling (default 16 MB) to what the tile
+    actually needs: the budgeted working set plus slack for DMA double
+    buffering and the output window.  v5e has 128 MB of VMEM; the cap just
+    has to admit the plan ``_tile_for_pad`` already budgeted."""
+    ws = _PLANES * (tile_h + 2 * pad) * wp * 4
+    return pltpu.CompilerParams(
+        vmem_limit_bytes=min(120 << 20, int(ws * 1.3) + (8 << 20))
+    )
+
+
 def _tile_for_pad(h: int, wp: int, pad: int) -> int | None:
     """Largest multiple-of-8 divisor of h whose (tile + 2·pad)-row working
     set fits the VMEM budget, or None.  ``pad ≤ tile_h`` keeps the wrap-halo
@@ -122,45 +145,56 @@ def _tile_for_pad(h: int, wp: int, pad: int) -> int | None:
 
 @functools.lru_cache(maxsize=None)
 def launch_turns(shape: tuple[int, int], t_target: int) -> int:
-    """Deepest temporal blocking T ≤ t_target for ``shape``: the most
-    generations per HBM pass whose halo fits VMEM with compute redundancy
-    2·pad/tile_h ≤ 1; if no depth passes the redundancy bar, the deepest
-    feasible depth (tiny boards are latency- not compute-bound)."""
+    """Temporal-blocking depth T ≤ t_target minimising halo-recompute cost.
+
+    Cost per generation, in units of one redundancy-free generation:
+    ``(tile_h + 2·pad)/tile_h`` compute redundancy plus ``_LAUNCH_COST/T``
+    for the un-overlapped halo DMA + launch overhead each HBM pass pays
+    (the kernel waits on its tile DMA before computing; at T=32 the
+    exposure is ~4% of a launch, at T=8 it would be ~18%).  _LAUNCH_COST
+    is calibrated from the hardware sweep at 16384²: T=32/tile=4096
+    (8,307 gens/s) > T=128/tile=4096 (7,517) > T=64/tile=2048 (7,278) >
+    the old 16 MB-budget plan T=128/tile=512 (4,706)."""
     t_max = max(1, min(t_target, _MAX_T))
-    fallback = None
+    best = None  # (cost, -t)
+    best_t = None
     for t in range(t_max, 0, -1):
         pad = _round8(t)
         tile_h = _tile_for_pad(shape[0], shape[1], pad)
         if tile_h is None:
             continue
-        if tile_h >= 2 * pad:
-            return t
-        if fallback is None:
-            fallback = t
-    if fallback is None:
+        key = ((tile_h + 2 * pad) / tile_h + _LAUNCH_COST / t, -t)
+        if best is None or key < best:
+            best, best_t = key, t
+    if best_t is None:
         raise ValueError(f"no VMEM tiling for packed board {shape}")
-    return fallback
+    return best_t
 
 
 def _gen(a: jax.Array, rule: LifeRule) -> jax.Array:
     """One packed generation of a VMEM-resident tile (hh, wp).  Vertical
     wrap is the tile-local rotate (exact for the kept rows as long as the
-    halo is deeper than the generation index); horizontal wrap is exact."""
+    halo is deeper than the generation index); horizontal wrap is exact.
+
+    Expensive-axis-first: the cross-word shift + lane-rotate splice (the
+    costly direction in this layout) runs once on the raw plane; the cheap
+    sublane rotates then run on the two partial-sum planes — same op-count
+    argument as ``ops/packed.py::total_planes``."""
     hh, wp = a.shape
-    n = pltpu.roll(a, 1, 0)
-    s = pltpu.roll(a, hh - 1, 0)
-    v0 = a ^ n ^ s
-    v1 = _maj(a, n, s)
-
-    def hsum(v):
-        west = (v << 1) | (pltpu.roll(v, 1, 1) >> 31)
-        east = (v >> 1) | (pltpu.roll(v, wp - 1, 1) << 31)
-        return v ^ west ^ east, _maj(v, west, east)
-
-    s0, c0 = hsum(v0)
-    s1, c1 = hsum(v1)
-    k = c0 & s1
-    totals = (s0, c0 ^ s1, c1 ^ k, c1 & k)
+    w = (a << 1) | (pltpu.roll(a, 1, 1) >> 31)
+    e = (a >> 1) | (pltpu.roll(a, wp - 1, 1) << 31)
+    h0 = a ^ w ^ e  # 2-bit row sums of the 3-column window
+    h1 = _maj(a, w, e)
+    n0 = pltpu.roll(h0, 1, 0)
+    s0 = pltpu.roll(h0, hh - 1, 0)
+    n1 = pltpu.roll(h1, 1, 0)
+    s1 = pltpu.roll(h1, hh - 1, 0)
+    t0 = h0 ^ n0 ^ s0
+    c = _maj(h0, n0, s0)
+    p1 = h1 ^ n1 ^ s1
+    q = _maj(h1, n1, s1)
+    k = p1 & c
+    totals = (t0, p1 ^ c, q ^ k, q & k)
     return apply_rule_planes(totals, a, rule)
 
 
@@ -273,6 +307,7 @@ def _build_launch(
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
             pltpu.SemaphoreType.DMA((3,)),
         ],
+        compiler_params=_compiler_params(tile_h, pad, wp),
         interpret=interpret,
     )
 

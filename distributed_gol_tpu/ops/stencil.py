@@ -88,6 +88,22 @@ def steps_with_counts(
     return final, counts
 
 
+@partial(jax.jit, static_argnames=("fy", "fx"))
+def frame_pool(board: jax.Array, fy: int, fx: int) -> jax.Array:
+    """Max-pool a uint8 board by (fy, fx) ON DEVICE — a live cell anywhere
+    in a tile lights the tile.
+
+    SURVEY.md §7 hard part 4: at 16384² a per-turn full-board fetch for the
+    viewer is 268 MB/turn of host↔device traffic; the viewer only renders a
+    terminal-sized view anyway (``viewer/render.py``), so the pooling runs
+    on device and only the pooled frame (≤ a few hundred KB) crosses to the
+    host.  Exact crop to a multiple of the factor, matching the host-side
+    ``viewer.render.downsample`` so frames and shadow boards agree."""
+    h, w = board.shape
+    ch, cw = h // fy * fy, w // fx * fx
+    return board[:ch, :cw].reshape(ch // fy, fy, cw // fx, fx).max(axis=(1, 3))
+
+
 @jax.jit
 def flip_mask(prev: jax.Array, new: jax.Array) -> jax.Array:
     """Cells that changed between two boards, as a uint8 0/1 mask.

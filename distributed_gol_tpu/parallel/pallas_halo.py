@@ -46,6 +46,7 @@ from jax.sharding import Mesh
 from distributed_gol_tpu.models.life import CONWAY, LifeRule
 from distributed_gol_tpu.ops.pallas_packed import (
     _LANES,
+    _compiler_params,
     _gen,
     _round8,
     _tile_for_pad,
@@ -109,6 +110,7 @@ def _build_ext_launch(
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
             pltpu.SemaphoreType.DMA,
         ],
+        compiler_params=_compiler_params(tile_h, pad, wp),
         interpret=interpret,
     )
 

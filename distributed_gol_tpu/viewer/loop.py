@@ -23,6 +23,7 @@ from distributed_gol_tpu.engine.events import (
     CellFlipped,
     CellsFlipped,
     FinalTurnComplete,
+    FrameReady,
     TurnComplete,
 )
 from distributed_gol_tpu.engine.params import Params
@@ -55,7 +56,15 @@ def run_terminal(
     out=sys.stdout,
 ) -> FinalTurnComplete | None:
     """Live ANSI rendering fed purely by the event stream."""
-    shadow = np.zeros((params.image_height, params.image_width), dtype=np.uint8)
+    if params.wants_frames():
+        # Frame mode replaces the shadow wholesale with each FrameReady
+        # (the first arrives before any TurnComplete); never allocate a
+        # board-sized buffer for a mode that exists to avoid exactly that.
+        shadow = np.zeros(params.frame_max, dtype=np.uint8)
+    else:
+        shadow = np.zeros(
+            (params.image_height, params.image_width), dtype=np.uint8
+        )
     final = None
     min_dt = 1.0 / max_fps
     last_draw = 0.0
@@ -69,6 +78,10 @@ def run_terminal(
         elif isinstance(e, CellsFlipped):
             for c in e.cells:
                 shadow[c.y, c.x] ^= 255
+        elif isinstance(e, FrameReady):
+            # Large boards: the engine ships a device-pooled frame instead
+            # of per-cell flips; render it directly (it IS the view).
+            shadow = np.asarray(e.frame)
         elif isinstance(e, TurnComplete):
             now = time.monotonic()
             if now - last_draw >= min_dt:

@@ -1,0 +1,144 @@
+"""Device-side downsampling for the viewer (SURVEY.md §7 hard part 4).
+
+The reference renders every pixel every turn (``sdl/window.go:56-64``) —
+fine at 512², catastrophic at 16384² where the flip-mask fetch alone is
+268 MB/turn.  Above ``Params._FLIP_VIEW_MAX_CELLS`` the viewer is fed
+``FrameReady`` events instead: the board max-pools ON DEVICE to at most
+``frame_max`` cells, so the per-turn host transfer is bounded regardless
+of board size.
+"""
+
+import io
+import queue
+
+import numpy as np
+import pytest
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.engine.backend import Backend
+from distributed_gol_tpu.ops import stencil
+from distributed_gol_tpu.viewer.loop import run_terminal
+
+
+def make_params(tmp_path, images_dir, size, **kw):
+    defaults = dict(
+        turns=3,
+        image_width=size,
+        image_height=size,
+        images_dir=images_dir,
+        out_dir=tmp_path,
+        no_vis=False,
+        superstep=0,
+        engine="roll",
+    )
+    defaults.update(kw)
+    return gol.Params(**defaults)
+
+
+def write_soup(images_dir, size, density=0.1, seed=3):
+    rng = np.random.default_rng(seed)
+    board = np.where(rng.random((size, size)) < density, 255, 0).astype(np.uint8)
+    from distributed_gol_tpu.engine.pgm import write_pgm
+
+    write_pgm(images_dir / f"{size}x{size}.pgm", board)
+    return board
+
+
+def test_mode_selection():
+    small = gol.Params(image_width=512, image_height=512, no_vis=False)
+    big = gol.Params(image_width=4096, image_height=4096, no_vis=False)
+    assert small.wants_flips() and not small.wants_frames()
+    assert big.wants_frames() and not big.wants_flips()
+    # Explicit flip modes are the exact reference contract and always win.
+    exact = gol.Params(
+        image_width=4096, image_height=4096, no_vis=False, flip_events="batch"
+    )
+    assert exact.wants_flips() and not exact.wants_frames()
+    # Headless runs feed no viewer at all.
+    headless = gol.Params(image_width=4096, image_height=4096, no_vis=True)
+    assert not headless.wants_flips() and not headless.wants_frames()
+
+
+def test_4096_viewer_transfer_is_bounded(tmp_path):
+    """The per-turn host transfer for a 4096² viewer turn is the pooled
+    frame: ≤ frame_max cells (256 KB), not the 16 MB board."""
+    size = 4096
+    images = tmp_path / "images"
+    images.mkdir()
+    write_soup(images, size)
+    params = make_params(tmp_path, images, size, turns=2)
+    assert params.wants_frames()
+    fy, fx = params.frame_factors()
+    assert (fy, fx) == (8, 8)
+
+    backend = Backend(params)
+    from distributed_gol_tpu.engine.pgm import read_pgm
+
+    board = backend.put(read_pgm(params.input_path))
+    new_board, count, frame = backend.run_turn_with_frame(board, fy, fx)
+
+    assert frame.shape == (512, 512)
+    assert frame.nbytes <= 1 << 20  # ≤ 1 MB crosses to the host
+    # The frame is the true device-side max-pool of the advanced board.
+    want = np.asarray(
+        stencil.frame_pool(backend.fetch(new_board), fy, fx)
+    )
+    np.testing.assert_array_equal(frame, want)
+    assert frame.max() > 0
+
+
+def test_viewer_renders_from_frames(tmp_path):
+    """End-to-end: a big-board run emits FrameReady (no flips), and the
+    terminal viewer renders from them."""
+    size = 2048  # > _FLIP_VIEW_MAX_CELLS (2^21), small enough for CI
+    images = tmp_path / "images"
+    images.mkdir()
+    write_soup(images, size)
+    params = make_params(tmp_path, images, size, turns=3)
+    assert params.wants_frames()
+
+    events: queue.Queue = queue.Queue()
+    gol.start(params, events)
+
+    # Tee the stream so we can both inspect and render it.
+    seen = []
+    tee: queue.Queue = queue.Queue()
+
+    def pump():
+        while True:
+            e = events.get()
+            seen.append(e)
+            tee.put(e)
+            if e is None:
+                return
+
+    import threading
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    out = io.StringIO()
+    final = run_terminal(params, tee, max_fps=10_000, out=out)
+    t.join(timeout=30)
+
+    frames = [e for e in seen if isinstance(e, gol.FrameReady)]
+    flips = [
+        e for e in seen if isinstance(e, (gol.CellFlipped, gol.CellsFlipped))
+    ]
+    # Initial frame + one per turn; zero flip traffic.
+    assert len(frames) == params.turns + 1 and not flips
+    assert all(np.asarray(f.frame).nbytes <= 1 << 20 for f in frames)
+    # Frames precede their TurnComplete (the flip-ordering contract).
+    for turn in range(1, params.turns + 1):
+        idx_frame = next(
+            i
+            for i, e in enumerate(seen)
+            if isinstance(e, gol.FrameReady) and e.completed_turns == turn
+        )
+        idx_tc = next(
+            i
+            for i, e in enumerate(seen)
+            if isinstance(e, gol.TurnComplete) and e.completed_turns == turn
+        )
+        assert idx_frame < idx_tc
+    assert final is not None and final.completed_turns == params.turns
+    assert out.getvalue()  # something was actually drawn

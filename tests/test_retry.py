@@ -1,0 +1,146 @@
+"""Host-level dispatch retry — the broker re-queue analog.
+
+Reference: ``broker/broker.go:67-73`` re-queues a failed worker RPC back
+onto the publish channel (SURVEY.md §5 failure mechanism 2).  The TPU
+rebuild's equivalent: the controller retries a failed device superstep once
+from the last good board; a second failure parks that board as a paused
+checkpoint on the session (resumable exactly like a 'q' detach) and the
+stream still ends with the sentinel.
+"""
+
+import queue
+
+import numpy as np
+import pytest
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.engine.backend import Backend
+from distributed_gol_tpu.engine.events import DispatchError
+from distributed_gol_tpu.engine.session import Session
+
+
+class FlakyBackend(Backend):
+    """Injects ``fail`` consecutive dispatch failures, then works."""
+
+    def __init__(self, params, fail: int):
+        super().__init__(params)
+        self.failures_left = fail
+        self.dispatches = 0
+
+    def run_turns(self, board, turns):
+        self.dispatches += 1
+        if self.failures_left:
+            self.failures_left -= 1
+            raise RuntimeError("injected device failure")
+        return super().run_turns(board, turns)
+
+
+def make_params(tmp_path, input_images, **kw):
+    defaults = dict(
+        turns=20,
+        image_width=16,
+        image_height=16,
+        images_dir=input_images,
+        out_dir=tmp_path,
+        superstep=5,
+        engine="roll",
+    )
+    defaults.update(kw)
+    return gol.Params(**defaults)
+
+
+def drain(events):
+    out = []
+    while (e := events.get(timeout=30)) is not None:
+        out.append(e)
+    return out
+
+
+def reference_final(params, tmp_path, input_images):
+    """The same run through an unfaulted backend, for comparison."""
+    events: queue.Queue = queue.Queue()
+    gol.run(make_params(tmp_path / "ref", input_images), events)
+    final = [e for e in drain(events) if isinstance(e, gol.FinalTurnComplete)]
+    return final[0]
+
+
+def test_single_failure_is_retried_and_run_completes(tmp_path, input_images):
+    (tmp_path / "ref").mkdir()
+    params = make_params(tmp_path, input_images)
+    want = reference_final(params, tmp_path, input_images)
+
+    backend = FlakyBackend(params, fail=1)
+    session = Session()
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events, session=session, backend=backend)
+    stream = drain(events)
+
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert len(errors) == 1 and errors[0].will_retry
+    assert "injected device failure" in errors[0].error
+
+    final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)]
+    assert len(final) == 1
+    assert final[0].completed_turns == params.turns
+    # Retry restarted from the last good board: results identical.
+    assert sorted(final[0].alive) == sorted(want.alive)
+    # No checkpoint left behind — the run completed.
+    assert session.check_states(16, 16) is None
+
+
+def test_double_failure_checkpoints_and_aborts(tmp_path, input_images):
+    params = make_params(tmp_path, input_images, superstep=4)
+    backend = FlakyBackend(params, fail=2)
+    session = Session()
+    events: queue.Queue = queue.Queue()
+
+    with pytest.raises(RuntimeError, match="injected device failure"):
+        gol.run(params, events, session=session, backend=backend)
+
+    # Sentinel guaranteed even on the failure path.
+    stream = []
+    while (e := events.get(timeout=5)) is not None:
+        stream.append(e)
+
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert [e.will_retry for e in errors] == [True, False]
+    assert errors[1].checkpointed
+
+    # The parked checkpoint is the untouched initial board at turn 0,
+    # resumable by a fresh controller (the 'q'-detach contract).
+    ckpt = session.check_states(16, 16)
+    assert ckpt is not None and ckpt.turn == 0
+    from distributed_gol_tpu.engine.pgm import read_pgm
+
+    start = read_pgm(input_images / "16x16.pgm")
+    assert np.array_equal(ckpt.world, start)
+
+
+def test_failure_mid_run_checkpoints_last_good_turn(tmp_path, input_images):
+    """Failures after progress park the *latest* completed board."""
+    params = make_params(tmp_path, input_images, superstep=4, turns=20)
+
+    class FailAfter(FlakyBackend):
+        def run_turns(self, board, turns):
+            # Succeed twice (8 turns), then fail the rest of the run.
+            if self.dispatches >= 2:
+                self.failures_left = 2
+            return super().run_turns(board, turns)
+
+    backend = FailAfter(params, fail=0)
+    session = Session()
+    events: queue.Queue = queue.Queue()
+    with pytest.raises(RuntimeError):
+        gol.run(params, events, session=session, backend=backend)
+    while events.get(timeout=5) is not None:
+        pass
+
+    ckpt = session.check_states(16, 16)
+    assert ckpt is not None and ckpt.turn == 8
+
+    # And a fresh run resumes from it, finishing the remaining turns.
+    events2: queue.Queue = queue.Queue()
+    gol.run(make_params(tmp_path, input_images, turns=20), events2, session=session)
+    stream = [e for e in drain(events2)]
+    final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)][0]
+    assert final.completed_turns == 20

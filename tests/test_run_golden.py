@@ -120,3 +120,39 @@ def test_sharded_run_matches_golden(mesh_shape, tmp_path, input_images, golden_i
     assert written == golden
     final = [e for e in events if isinstance(e, gol.FinalTurnComplete)][0]
     assert final.completed_turns == 100
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 1), (8, 1), (2, 4)])
+def test_sharded_512_matches_golden(mesh_shape, tmp_path, input_images, golden_images):
+    """The reference's own benchmark size, sharded: 512²×100 over virtual
+    meshes, byte-identical final PGM (row meshes exercise the sharded
+    pallas-packed path in interpret mode; (2, 4) the 2-D word-halo path)."""
+    run_and_collect(
+        make_params(512, 100, tmp_path, input_images, mesh_shape=mesh_shape)
+    )
+    written = (tmp_path / "512x512x100.pgm").read_bytes()
+    golden = (golden_images / "512x512x100.pgm").read_bytes()
+    assert written == golden
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("threads", range(1, 17))
+@pytest.mark.parametrize("size", SIZES)
+@pytest.mark.parametrize("turns", TURNS)
+def test_full_reference_matrix(
+    threads, size, turns, tmp_path, input_images, golden_images
+):
+    """The reference's complete 144-subtest matrix (gol_test.go:29-31):
+    {16², 64², 512²} × {0, 1, 100} turns × threads 1..16.  The threads knob
+    is inert here by design (XLA owns intra-chip parallelism), so this is
+    an inertness proof at full reference granularity; the fast suite keeps
+    the 3-point sweep.  Run with ``pytest -m slow``."""
+    events = run_and_collect(
+        make_params(size, turns, tmp_path, input_images, threads=threads)
+    )
+    finals = [e for e in events if isinstance(e, gol.FinalTurnComplete)]
+    assert len(finals) == 1
+    golden = read_pgm(golden_images / f"{size}x{size}x{turns}.pgm")
+    assert_equal_board(finals[0].alive, golden, size)
+    written = (tmp_path / f"{size}x{size}x{turns}.pgm").read_bytes()
+    assert written == (golden_images / f"{size}x{size}x{turns}.pgm").read_bytes()

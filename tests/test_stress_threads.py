@@ -1,0 +1,129 @@
+"""Race-analog stress job (SURVEY.md §5).
+
+The reference is channel-first Go whose race story is ``go test -race``;
+the rebuild's host side is Python threads around a queue, so the analog is
+a stress test hammering the controller's thread-crossing surfaces —
+pause/resume toggles, snapshot requests, session checkpoint reads, and the
+alive-count ticker — from multiple threads at once, under ``faulthandler``
+so a deadlock dumps every stack instead of hanging CI silently.
+
+Invariants checked: the stream stays well-formed (one FinalTurnComplete,
+sentinel last), StateChange events strictly alternate Paused/Executing,
+every snapshot request produces exactly one ImageOutputComplete + file,
+and the run detaches cleanly with a resumable checkpoint.
+"""
+
+import faulthandler
+import queue
+import threading
+import time
+
+import pytest
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.engine.session import Session
+
+PAUSE_TOGGLES = 40  # even: ends unpaused
+SNAPSHOTS = 12
+
+
+@pytest.fixture(autouse=True)
+def watchdog():
+    # A wedged queue/lock interaction should dump all thread stacks and
+    # fail loudly, not hang the suite.
+    faulthandler.dump_traceback_later(120, exit=True)
+    yield
+    faulthandler.cancel_dump_traceback_later()
+
+
+def test_threaded_pause_snapshot_checkpoint_stress(tmp_path, input_images):
+    params = gol.Params(
+        turns=10**6,
+        image_width=64,
+        image_height=64,
+        images_dir=input_images,
+        out_dir=tmp_path,
+        superstep=2,
+        ticker_period=0.01,  # hammer the ticker thread too
+        engine="roll",
+    )
+    session = Session()
+    events: queue.Queue = queue.Queue()
+    keys: queue.Queue = queue.Queue()
+    run_thread = gol.start(params, events, keys, session)
+
+    stop = threading.Event()
+    seen: list = []
+    collector_done = threading.Event()
+
+    def collect():
+        while True:
+            e = events.get()
+            seen.append(e)
+            if e is None:
+                collector_done.set()
+                return
+
+    def toggle_pause():
+        for _ in range(PAUSE_TOGGLES):
+            keys.put("p")
+            time.sleep(0.005)
+
+    def snapshot():
+        for _ in range(SNAPSHOTS):
+            keys.put("s")
+            time.sleep(0.02)
+
+    def read_checkpoints():
+        # The resume-negotiation path racing the pause writes; it must
+        # never throw or corrupt state (any result is legal mid-run).
+        while not stop.is_set():
+            session.check_states(64, 64)
+            time.sleep(0.002)
+
+    threads = [
+        threading.Thread(target=collect, daemon=True),
+        threading.Thread(target=toggle_pause, daemon=True),
+        threading.Thread(target=snapshot, daemon=True),
+        threading.Thread(target=read_checkpoints, daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads[1:3]:
+        t.join(timeout=60)
+        assert not t.is_alive(), "stress thread wedged"
+    stop.set()
+    threads[3].join(timeout=10)
+
+    keys.put("q")  # detach: parks a checkpoint, ends the stream
+    assert collector_done.wait(timeout=60), "event stream never ended"
+    run_thread.join(timeout=10)
+    assert not run_thread.is_alive()
+
+    # Stream shape: sentinel last, exactly one final event.
+    assert seen[-1] is None
+    finals = [e for e in seen if isinstance(e, gol.FinalTurnComplete)]
+    assert len(finals) == 1
+
+    # StateChange alternation: paused/executing strictly interleave until
+    # the quitting transition (single-threaded controller discipline held).
+    changes = [
+        e.new_state
+        for e in seen
+        if isinstance(e, gol.StateChange) and e.new_state != gol.State.QUITTING
+    ]
+    assert len(changes) == PAUSE_TOGGLES
+    for i, s in enumerate(changes):
+        want = gol.State.PAUSED if i % 2 == 0 else gol.State.EXECUTING
+        assert s == want, f"StateChange[{i}] = {s}, want {want}"
+
+    # Every snapshot produced its event and its file (distinct names).
+    snaps = [e for e in seen if isinstance(e, gol.ImageOutputComplete)]
+    assert len(snaps) == SNAPSHOTS
+    for e in snaps:
+        assert (tmp_path / f"{e.filename}.pgm").exists()
+
+    # The detach parked a resumable checkpoint at the final turn.
+    ckpt = session.check_states(64, 64)
+    assert ckpt is not None
+    assert ckpt.turn == finals[0].completed_turns

@@ -1,0 +1,184 @@
+"""Measured VPU/HBM roofline for the packed Life kernels (BASELINE.md §roofline).
+
+The north-star question — what generations/sec is *attainable* at 16384² on
+one v5e chip — reduces to three measured numbers:
+
+1. peak bitwise word-op throughput of the VPU (ops on uint32 vregs),
+2. the cost of the cross-lane / cross-sublane rotates the stencil needs,
+3. HBM stream bandwidth (to confirm temporal blocking removed it as a bound).
+
+This tool measures all three with minimal Pallas kernels and prints the
+derived attainable gens/s for the measured ops/word/generation of the
+production kernel.  Run on the real chip (interpret mode measures nothing).
+
+Usage: python tools/roofline.py [--iters N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _sync(x):
+    return np.asarray(jax.device_get(x.ravel()[0]))
+
+
+# One chain iteration = 6 bitwise vector ops (xor, and, or, xor, shift,
+# or).  The constants are runtime values, so nothing folds.  A single
+# loop-carried chain is LATENCY-bound (measured ~1 op/cycle — it
+# underestimates peak by >2×, which the production kernel itself proves by
+# exceeding it), so the peak probe runs ``chains`` independent chains per
+# iteration: the VPU can overlap them, exposing the true issue rate.
+_CHAIN_OPS = 6
+
+
+def _chain_kernel(c1_ref, c2_ref, *rest, iters, chains):
+    x_refs, o_refs = rest[:chains], rest[chains:]
+    c1, c2 = c1_ref[:], c2_ref[:]
+
+    def body(_, xs):
+        return tuple(((x ^ c1) & c2) | ((x ^ c2) << 1) | c1 for x in xs)
+
+    outs = jax.lax.fori_loop(0, iters, body, tuple(x[:] for x in x_refs))
+    for o, v in zip(o_refs, outs):
+        o[:] = v
+
+
+def measure_vpu_peak(
+    iters: int, rows: int = 256, cols: int = 1024, chains: int = 4
+) -> float:
+    """Peak sustained bitwise word-ops/sec on uint32 vregs."""
+    shape = (rows, cols)
+    rng = np.random.default_rng(0)
+
+    def mk():
+        return jnp.asarray(rng.integers(0, 2**32, size=shape, dtype=np.uint32))
+
+    c1, c2 = mk(), mk()
+    xs = [mk() for _ in range(chains)]
+
+    call = pl.pallas_call(
+        partial(_chain_kernel, iters=iters, chains=chains),
+        out_shape=[jax.ShapeDtypeStruct(shape, jnp.uint32)] * chains,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+    )
+    run = jax.jit(lambda *a: call(*a))
+    _sync(run(c1, c2, *xs)[0])  # compile + warm
+    t0 = time.perf_counter()
+    out = run(c1, c2, *xs)
+    _sync(out[0])
+    dt = time.perf_counter() - t0
+    ops = _CHAIN_OPS * chains * iters * rows * cols
+    log(f"  vpu {chains}-chain: {ops:.3e} word-ops in {dt * 1e3:.2f} ms "
+        f"-> {ops / dt:.3e} word-ops/s ({ops / dt * 32:.3e} bit-cell-ops/s)")
+    return ops / dt
+
+
+def _roll_kernel(x_ref, o_ref, *, iters, axis):
+    hh, ww = x_ref.shape
+    amount = 1 if axis == 0 else ww - 1
+
+    def body(_, x):
+        return pltpu.roll(x, amount, axis)
+
+    o_ref[:] = jax.lax.fori_loop(0, iters, body, x_ref[:])
+
+
+def measure_roll(iters: int, axis: int, rows: int = 256, cols: int = 1024) -> float:
+    """Sustained pltpu.roll ops/sec (per word) on the given axis."""
+    shape = (rows, cols)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.integers(0, 2**32, size=shape, dtype=np.uint32))
+    call = pl.pallas_call(
+        partial(_roll_kernel, iters=iters, axis=axis),
+        out_shape=jax.ShapeDtypeStruct(shape, jnp.uint32),
+    )
+    run = jax.jit(call)
+    _sync(run(x))
+    t0 = time.perf_counter()
+    out = run(x)
+    _sync(out)
+    dt = time.perf_counter() - t0
+    ops = iters * rows * cols
+    name = "sublane" if axis == 0 else "lane"
+    log(f"  {name} roll: {ops:.3e} word-rolls in {dt * 1e3:.2f} ms "
+        f"-> {ops / dt:.3e} word-rolls/s")
+    return ops / dt
+
+
+def measure_hbm(copies: int = 64, mb: int = 256) -> float:
+    """HBM stream bandwidth via an on-device bump loop (read + write each
+    iteration), bytes/sec.  The loop runs inside ONE dispatch so the
+    tunnel's per-dispatch latency (~20 ms on axon) is amortised away."""
+    n = mb * (1 << 20) // 4
+    x = jnp.arange(n, dtype=jnp.uint32)
+    bump = jax.jit(
+        lambda v: jax.lax.fori_loop(0, copies, lambda i, a: a + jnp.uint32(1), v)
+    )
+    x = bump(x)
+    _sync(x)
+    t0 = time.perf_counter()
+    x = bump(x)
+    _sync(x)
+    dt = time.perf_counter() - t0
+    bw = copies * 2 * n * 4 / dt
+    log(f"  hbm stream: {copies} x {mb} MiB r+w in {dt * 1e3:.1f} ms "
+        f"-> {bw / 1e9:.0f} GB/s")
+    return bw
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=131072)
+    args = ap.parse_args()
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} platform={dev.platform}")
+    if dev.platform != "tpu":
+        log("WARNING: not a TPU — numbers are meaningless for the roofline")
+
+    peak = measure_vpu_peak(args.iters)
+    roll_sub = measure_roll(args.iters // 4, axis=0)
+    roll_lane = measure_roll(args.iters // 4, axis=1)
+    hbm = measure_hbm()
+
+    # Production kernel op budget (ops/word/generation), counted from
+    # ops/pallas_packed.py::_gen after the expensive-axis-first +
+    # merged-rule rewrite; see BASELINE.md.
+    kernel_ops = 36
+    kernel_rolls = 6
+    words = 16384 * 16384 // 32
+    t_ops = kernel_ops * words / peak
+    t_rolls_s = 4 * words / roll_sub
+    t_rolls_l = 2 * words / roll_lane
+    attainable = 1.0 / (t_ops + t_rolls_s + t_rolls_l)
+    log(f"attainable @16384^2 (zero redundancy, {kernel_ops} ops + "
+        f"{kernel_rolls} rolls/word/gen): {attainable:,.0f} gens/s")
+    print(
+        {
+            "vpu_word_ops_per_s": peak,
+            "roll_sublane_per_s": roll_sub,
+            "roll_lane_per_s": roll_lane,
+            "hbm_bytes_per_s": hbm,
+            "attainable_gens_per_s_16384": attainable,
+        }
+    )
+
+
+if __name__ == "__main__":
+    main()
