@@ -46,9 +46,22 @@ def _sync(board):
     return np.asarray(jax.device_get(board[0, 0]))
 
 
-def bench_config(size: int, kturns: int, engine: str, reps: int):
+def bench_config(
+    size: int,
+    kturns: int,
+    engine: str,
+    reps: int,
+    calibrate: bool = True,
+    target_seconds: float = 0.7,
+):
     """Time `reps` supersteps of `kturns` generations each; returns
-    (gens_per_sec, cell_updates_per_sec)."""
+    (gens_per_sec, cell_updates_per_sec).
+
+    With ``calibrate`` (default), the dispatch depth is grown until one
+    dispatch takes ~``target_seconds``: the axon tunnel costs ~20 ms per
+    dispatch, so a fast engine on a small board measured at a fixed shallow
+    depth reports the tunnel, not the device (512² VMEM-resident: 139k
+    gens/s at 8k-gen dispatches vs >1M at calibrated depth)."""
     import jax
     import jax.numpy as jnp
 
@@ -64,14 +77,14 @@ def bench_config(size: int, kturns: int, engine: str, reps: int):
             sys.exit("error: engine='pallas' kernel not available in this build")
 
         superstep = pallas_stencil.make_superstep(CONWAY)
-        run = lambda b: superstep(b, kturns)
+        make_run = lambda kt: lambda b: superstep(b, kt)
     elif engine == "packed":
         # Board lives bit-packed on device (32 cells/uint32); pack/unpack are
         # outside the timed loop, as a real long run would hold packed state.
         from distributed_gol_tpu.ops import packed
 
         board = packed.pack(board)
-        run = lambda b: packed.superstep(b, CONWAY, kturns)
+        make_run = lambda kt: lambda b: packed.superstep(b, CONWAY, kt)
     elif engine == "pallas-packed":
         from distributed_gol_tpu.ops import packed, pallas_packed
 
@@ -84,16 +97,34 @@ def bench_config(size: int, kturns: int, engine: str, reps: int):
                 "  temporal blocking: "
                 f"T={pallas_packed.launch_turns(board.shape, kturns)}"
             )
-        run = lambda b: superstep(b, kturns)
+        make_run = lambda kt: lambda b: superstep(b, kt)
     else:
         from distributed_gol_tpu.ops.stencil import superstep
 
-        run = lambda b: superstep(b, table, kturns)
+        make_run = lambda kt: lambda b: superstep(b, table, kt)
 
+    run = make_run(kturns)
     t0 = time.perf_counter()
     board = run(board)  # compile + warm up
     _sync(board)
     log(f"  compile+first superstep: {time.perf_counter() - t0:.2f}s")
+
+    if calibrate:
+        # Grow the dispatch until it dwarfs the per-dispatch overhead
+        # (2 growth rounds suffice: each round multiplies by the measured
+        # shortfall).  Each new depth costs one recompile, excluded below.
+        for _ in range(3):
+            t0 = time.perf_counter()
+            board = run(board)
+            _sync(board)
+            dt = time.perf_counter() - t0
+            if dt >= target_seconds / 2:
+                break
+            kturns = min(int(kturns * target_seconds / max(dt, 1e-3)), 1 << 20)
+            log(f"  calibrate: dispatch {dt * 1e3:.0f} ms -> kturns {kturns}")
+            run = make_run(kturns)
+            board = run(board)  # compile + warm the new depth
+            _sync(board)
 
     t0 = time.perf_counter()
     for _ in range(reps):

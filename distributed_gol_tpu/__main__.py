@@ -62,6 +62,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write a JAX profiler trace (Perfetto/TensorBoard) to DIR")
     ap.add_argument("--timing", action="store_true",
                     help="emit TurnTiming events (per-dispatch gens/sec)")
+    ap.add_argument("--view-mode", default="auto",
+                    choices=["auto", "flips", "frame"],
+                    help="viewer feed: exact per-cell flips or device-pooled "
+                         "frames (auto switches on board size)")
+    ap.add_argument("--frame-max", default="512x512", metavar="HxW",
+                    help="max size of a device-pooled viewer frame")
+    ap.add_argument("--max-dispatch-seconds", type=float, default=0.25,
+                    help="adaptive-superstep target per dispatch; bounds "
+                         "keypress latency at ~2x this value")
     return ap
 
 
@@ -69,6 +78,9 @@ def params_from_args(args) -> Params:
     ny, _, nx = args.mesh.partition("x")
     if not (ny.isdigit() and nx.isdigit()):
         raise ValueError(f"--mesh wants NYxNX (e.g. 2x4), got {args.mesh!r}")
+    fh, _, fw = args.frame_max.partition("x")
+    if not (fh.isdigit() and fw.isdigit()):
+        raise ValueError(f"--frame-max wants HxW (e.g. 512x512), got {args.frame_max!r}")
     return Params(
         turns=args.turns,
         threads=args.t,
@@ -83,6 +95,9 @@ def params_from_args(args) -> Params:
         out_dir=args.out_dir,
         ticker_period=args.ticker,
         emit_timing=args.timing,
+        view_mode=args.view_mode,
+        frame_max=(int(fh), int(fw)),
+        max_dispatch_seconds=args.max_dispatch_seconds,
     )
 
 

@@ -301,14 +301,21 @@ class Controller:
                     board, count = self._dispatch(
                         lambda: self.backend.run_turns(board, k), board, turn
                     )
+                    # Dispatch wall-clock ends here: run_turns synchronised
+                    # on the counts transfer.  The TurnComplete emit loop
+                    # below is host time and must not pollute the adaptive
+                    # measurement (16384 queue.puts can take tens of ms).
+                    dispatch_dt = time.perf_counter() - t0
                     for i in range(k):
                         self._emit(TurnComplete(turn + i + 1))
                     turn += k
                     state.set(turn, count)
                 if p.emit_timing or adaptive:
-                    # run_turns/run_turn_with_flips synchronise on the counts
-                    # transfer, so this is true dispatch wall-clock.
-                    dt = time.perf_counter() - t0
+                    dt = (
+                        dispatch_dt
+                        if not (viewer_wants_flips or viewer_wants_frames)
+                        else time.perf_counter() - t0
+                    )
                     if p.emit_timing:
                         self._emit(TurnTiming(turn, k, dt))
                     if adaptive and k == superstep:

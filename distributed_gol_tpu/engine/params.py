@@ -151,8 +151,9 @@ class Params:
         """Whether an attached viewer is fed device-pooled frames instead of
         exact flips (large boards; SURVEY.md §7 hard part 4).  An explicit
         ``flip_events`` of "cell"/"batch" is the exact reference contract
-        and always wins over frames."""
-        if self.no_vis or self.flip_events in ("cell", "batch"):
+        and always wins over frames; ``flip_events="off"`` asked for no
+        per-turn viewer traffic at all, so it suppresses frames too."""
+        if self.no_vis or self.flip_events in ("cell", "batch", "off"):
             return False
         if self.view_mode == "frame":
             return True

@@ -1,0 +1,60 @@
+"""Engine × size comparison table — the per-round benchmark artifact.
+
+Runs every engine that supports each size and emits a markdown table
+(stdout) ready to paste into BASELINE.md / commit as BENCH_TABLE_r{N}.md,
+so each round leaves a complete measured record, not just the headline
+metric (`bench.py` stays the driver's single-JSON-line contract).
+
+Usage: python tools/bench_table.py [--sizes 512,4096,16384] [--reps 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from bench import bench_config, log, pick_engine, verify_engine  # noqa: E402
+
+ENGINES = ["roll", "packed", "pallas-packed"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="512,4096,16384")
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--kturns", type=int, default=0, help="0 = auto per size")
+    args = ap.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} platform={dev.platform}")
+    sizes = [int(s) for s in args.sizes.split(",")]
+
+    rows = []
+    for size in sizes:
+        for engine in ENGINES:
+            resolved = pick_engine(engine, size)
+            if resolved != engine:
+                log(f"  {size} {engine}: unsupported (resolves to {resolved}); skipped")
+                continue
+            # bench_config auto-calibrates the dispatch depth, so the
+            # starting kturns only seeds the calibration.
+            gps, cups = bench_config(size, args.kturns or 256, engine, args.reps)
+            ok = verify_engine(size, engine)
+            rows.append((size, engine, gps, cups, ok))
+
+    print("| Board | Engine | gens/s | cell-updates/s | bit-identical |")
+    print("|---|---|---|---|---|")
+    for size, engine, gps, cups, ok in rows:
+        print(
+            f"| {size}² | `{engine}` | {gps:,.0f} | {cups:.3e} | "
+            f"{'n/a' if ok is None else ok} |"
+        )
+
+
+if __name__ == "__main__":
+    main()
