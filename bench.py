@@ -53,6 +53,8 @@ def bench_config(
     reps: int,
     calibrate: bool = True,
     target_seconds: float = 0.7,
+    skip_stable: bool = False,
+    burnin: int = 0,
 ):
     """Time `reps` supersteps of `kturns` generations each; returns
     (gens_per_sec, cell_updates_per_sec).
@@ -89,9 +91,26 @@ def bench_config(
         from distributed_gol_tpu.ops import packed, pallas_packed
 
         board = packed.pack(board)
-        superstep = pallas_packed.make_superstep(CONWAY)
-        if pallas_packed.is_vmem_resident(board.shape):
+        if skip_stable and not pallas_packed.skip_stable_effective(board.shape):
+            # The adaptive path lives in the tiled kernel; pretending it
+            # ran would mislabel the published record.
+            log("  --skip-stable has no adaptive path for this shape "
+                "(VMEM-resident board); running the plain kernel")
+            skip_stable = False
+        superstep = pallas_packed.make_superstep(CONWAY, skip_stable=skip_stable)
+        if skip_stable:
+            log("  activity-adaptive: period-6-stable tiles skip their launch")
+        if pallas_packed.is_vmem_resident(board.shape) and not skip_stable:
             log("  VMEM-resident: whole superstep in one launch")
+        elif skip_stable:
+            # Log the plan the adaptive run actually uses: capped tiles,
+            # T rounded down to a multiple of the skip period.
+            t = pallas_packed.launch_turns(
+                board.shape, kturns, pallas_packed._SKIP_TILE_CAP
+            )
+            if t > pallas_packed._SKIP_PERIOD:
+                t -= t % pallas_packed._SKIP_PERIOD
+            log(f"  temporal blocking (adaptive plan): T={t}")
         else:
             log(
                 "  temporal blocking: "
@@ -126,6 +145,19 @@ def bench_config(
             board = run(board)  # compile + warm the new depth
             _sync(board)
 
+    if burnin:
+        # Steady-state measurement: evolve the soup toward ash before
+        # timing (same engine, excluded from the timed loop) — AFTER
+        # calibration so the burn-in rides deep dispatches, not ~20 ms
+        # tunnel round-trips per shallow one.
+        t0 = time.perf_counter()
+        done = 0
+        while done < burnin:
+            board = run(board)
+            done += kturns
+        _sync(board)
+        log(f"  burn-in: {done} gens in {time.perf_counter() - t0:.1f}s")
+
     t0 = time.perf_counter()
     for _ in range(reps):
         board = run(board)
@@ -140,7 +172,9 @@ def bench_config(
     return gps, gps * size * size
 
 
-def verify_engine(size: int, engine: str, turns: int = 64) -> bool | None:
+def verify_engine(
+    size: int, engine: str, turns: int = 64, skip_stable: bool = False
+) -> bool | None:
     """Hardware correctness record: run ``turns`` generations through the
     benched engine AND an independent reference engine *on the same device*,
     compare bit-for-bit.  Interpret-mode tests cannot stand in for this —
@@ -164,7 +198,30 @@ def verify_engine(size: int, engine: str, turns: int = 64) -> bool | None:
         return None
 
     table = jnp.asarray(CONWAY.table)
-    board = jnp.asarray(make_board(size, seed=7))
+    board_np = make_board(size, seed=7)
+    if skip_stable:
+        # The skip branch only fires on settled regions — a fresh soup
+        # would verify the active branch only.  Blank the lower 3/4 and
+        # furnish it with ash (blocks, blinkers, pulsars) so the record
+        # covers BOTH sides of the adaptive kernel's cond.
+        q = size // 4
+        board_np[q:, :] = 0
+        rng = np.random.default_rng(11)
+        seg = [2, 3, 4, 8, 9, 10]
+        for _ in range(max(4, size // 512)):
+            y = int(rng.integers(q + 16, size - 16))
+            x = int(rng.integers(0, size - 16))
+            kind = int(rng.integers(0, 3))
+            if kind == 0:
+                board_np[y : y + 2, x : x + 2] = 255  # block
+            elif kind == 1:
+                board_np[y, x : x + 3] = 255  # blinker
+            else:  # pulsar
+                for c in seg:
+                    for r in (0, 5, 7, 12):
+                        board_np[y + r, x + c] = 255
+                        board_np[y + c, x + r] = 255
+    board = jnp.asarray(board_np)
 
     if engine == "roll":
         got = roll_superstep(board, table, turns)
@@ -180,7 +237,9 @@ def verify_engine(size: int, engine: str, turns: int = 64) -> bool | None:
     elif engine == "pallas-packed":
         from distributed_gol_tpu.ops import pallas_packed
 
-        got = pallas_packed.make_superstep_bytes(CONWAY)(board, turns)
+        got = pallas_packed.make_superstep_bytes(CONWAY, skip_stable=skip_stable)(
+            board, turns
+        )
         want = packed.make_superstep(CONWAY)(board, turns)
     else:
         raise ValueError(f"unknown engine {engine!r}")
@@ -286,6 +345,19 @@ def main():
         action="store_true",
         help="skip the post-timing cross-engine bit-identity check",
     )
+    ap.add_argument(
+        "--skip-stable",
+        action="store_true",
+        help="activity-adaptive pallas-packed kernel (exact; period-6-"
+        "stable tiles cost 6 gens + a compare per launch instead of T)",
+    )
+    ap.add_argument(
+        "--burnin",
+        type=int,
+        default=0,
+        help="evolve the soup N generations before timing (steady-state "
+        "benchmarks; pair with --skip-stable)",
+    )
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -309,17 +381,32 @@ def main():
             if s <= size:
                 bench_config(s, args.kturns, pick_engine(args.engine, s), args.reps)
 
-    gps, cups = bench_config(size, args.kturns, engine, args.reps)
+    skip_eff = args.skip_stable and engine == "pallas-packed"
+    if skip_eff:
+        from distributed_gol_tpu.ops import pallas_packed
 
+        skip_eff = pallas_packed.skip_stable_effective((size, size // 32))
+
+    gps, cups = bench_config(
+        size,
+        args.kturns,
+        engine,
+        args.reps,
+        skip_stable=skip_eff,
+        burnin=args.burnin,
+    )
+
+    variant = "-skip" if skip_eff else ""
+    burn = f"_burnin{args.burnin}" if args.burnin else ""
     record = {
-        "metric": f"gol_gens_per_sec_{size}x{size}_{engine}_{dev.platform}",
+        "metric": f"gol_gens_per_sec_{size}x{size}_{engine}{variant}{burn}_{dev.platform}",
         "value": round(gps, 2),
         "unit": "generations/sec",
         # north-star gens/sec (BASELINE.md)
         "vs_baseline": round(gps / 1_000_000.0, 4),
     }
     if not args.no_verify:
-        ok = verify_engine(size, engine)
+        ok = verify_engine(size, engine, skip_stable=skip_eff)
         if ok is not None:
             record["bit_identical"] = ok
     print(json.dumps(record))

@@ -92,6 +92,14 @@ def _vmem_resident_shape(h: int, wp: int) -> tuple[int, int] | None:
     return (h // 32, w)
 
 
+def skip_stable_effective(shape: tuple[int, int]) -> bool:
+    """Whether ``skip_stable`` actually engages for this packed shape.
+    The adaptive path lives in the tiled kernel; shapes only the
+    VMEM-resident path takes (wp not a lane multiple) silently keep their
+    plain fast path — callers labelling benchmark records must know."""
+    return _tiled_supports(shape)
+
+
 def is_vmem_resident(shape: tuple[int, int]) -> bool:
     """Whether a packed (H, wp) board runs the whole-superstep-in-one-launch
     VMEM-resident path (vs the temporally-blocked tiled path)."""
@@ -100,7 +108,7 @@ def is_vmem_resident(shape: tuple[int, int]) -> bool:
 
 def _tiled_supports(shape: tuple[int, int]) -> bool:
     h, wp = shape
-    if wp % _LANES or h % 8 or h < 8:
+    if wp <= 0 or wp % _LANES or h % 8 or h < 8:
         return False
     # Alignment alone is not enough: very wide, short boards (wp large, h
     # small) can have no VMEM-feasible tile even at the minimum pad, and
@@ -111,7 +119,11 @@ def _tiled_supports(shape: tuple[int, int]) -> bool:
 def supports(shape: tuple[int, int]) -> bool:
     """Packed-board shapes this kernel takes: tileable (wp a lane multiple,
     H divisible by a multiple-of-8 tile height) or small enough to run
-    whole-board VMEM-resident in the vertical layout."""
+    whole-board VMEM-resident in the vertical layout.  Degenerate boards
+    (no packed words — width < 32) are nobody's: the byte engines own
+    them, and wp == 0 must not satisfy ``wp % _LANES == 0``."""
+    if shape[1] <= 0:
+        return False
     return is_vmem_resident(shape) or _tiled_supports(shape)
 
 
@@ -119,32 +131,52 @@ def _round8(x: int) -> int:
     return (x + 7) // 8 * 8
 
 
-def _compiler_params(tile_h: int, pad: int, wp: int) -> pltpu.CompilerParams:
+def _compiler_params(
+    tile_h: int, pad: int, wp: int, skip_stable: bool = False
+) -> pltpu.CompilerParams:
     """Raise Mosaic's scoped-VMEM ceiling (default 16 MB) to what the tile
     actually needs: the budgeted working set plus slack for DMA double
     buffering and the output window.  v5e has 128 MB of VMEM; the cap just
-    has to admit the plan ``_tile_for_pad`` already budgeted."""
+    has to admit the plan ``_tile_for_pad`` already budgeted.  The
+    adaptive kernel keeps the gen-0 tile, the gen-p probe tile, and both
+    cond branches live — measured ~1.5× the plain kernel's stack — so it
+    gets a larger factor over the same launch plan."""
     ws = _PLANES * (tile_h + 2 * pad) * wp * 4
+    factor = 2.0 if skip_stable else 1.3
     return pltpu.CompilerParams(
-        vmem_limit_bytes=min(120 << 20, int(ws * 1.3) + (8 << 20))
+        vmem_limit_bytes=min(120 << 20, int(ws * factor) + (8 << 20))
     )
 
 
-def _tile_for_pad(h: int, wp: int, pad: int) -> int | None:
+def _tile_for_pad(h: int, wp: int, pad: int, tile_cap: int | None = None) -> int | None:
     """Largest multiple-of-8 divisor of h whose (tile + 2·pad)-row working
-    set fits the VMEM budget, or None.  ``pad ≤ tile_h`` keeps the wrap-halo
-    DMA offsets inside one neighbouring tile."""
+    set fits the VMEM budget (and ``tile_cap`` when given), or None.
+    ``pad ≤ tile_h`` keeps the wrap-halo DMA offsets inside one
+    neighbouring tile.  The adaptive engine caps the tile: stability is
+    decided per tile, so smaller tiles skip at finer granularity — worth
+    a few % extra halo redundancy on mostly-stable boards."""
     best = None
     for tile_h in range(8, h + 1, 8):
-        if h % tile_h:
+        if h % tile_h or (tile_cap is not None and tile_h > tile_cap):
             continue
         if pad <= tile_h and _PLANES * (tile_h + 2 * pad) * wp * 4 <= _VMEM_BUDGET:
             best = tile_h
     return best
 
 
+# Tile-height cap for the adaptive (skip_stable) plan: 16384² gets 16
+# stripes instead of 4, so a roaming glider only un-skips 1/16 of the
+# board; costs ~9% halo redundancy vs ~3% for the plain plan.
+_SKIP_TILE_CAP = 1024
+# Stability period the adaptive kernel proves per launch: 6 = lcm(2, 3)
+# covers still lifes + period-2 oscillators + pulsars (see _kernel).
+_SKIP_PERIOD = 6
+
+
 @functools.lru_cache(maxsize=None)
-def launch_turns(shape: tuple[int, int], t_target: int) -> int:
+def launch_turns(
+    shape: tuple[int, int], t_target: int, tile_cap: int | None = None
+) -> int:
     """Temporal-blocking depth T ≤ t_target minimising halo-recompute cost.
 
     Cost per generation, in units of one redundancy-free generation:
@@ -160,7 +192,7 @@ def launch_turns(shape: tuple[int, int], t_target: int) -> int:
     best_t = None
     for t in range(t_max, 0, -1):
         pad = _round8(t)
-        tile_h = _tile_for_pad(shape[0], shape[1], pad)
+        tile_h = _tile_for_pad(shape[0], shape[1], pad, tile_cap)
         if tile_h is None:
             continue
         key = ((tile_h + 2 * pad) / tile_h + _LAUNCH_COST / t, -t)
@@ -242,7 +274,9 @@ def _build_vmem_resident(
     )
 
 
-def _kernel(x_hbm, o_ref, tile, sems, *, tile_h, pad, grid, turns, rule):
+def _kernel(
+    x_hbm, o_ref, tile, sems, *, tile_h, pad, grid, turns, rule, skip_stable
+):
     i = pl.program_id(0)
     # Halo source offsets as tile_index * tile_h + k·8: provably 8-aligned.
     top = jax.lax.rem(i + grid - 1, grid) * tile_h + (tile_h - pad)
@@ -267,7 +301,39 @@ def _kernel(x_hbm, o_ref, tile, sems, *, tile_h, pad, grid, turns, rule):
     for c in copies:
         c.wait()
 
-    out = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), tile[:])
+    if not skip_stable:
+        out = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), tile[:])
+        o_ref[:] = out[pad : pad + tile_h, :]
+        return
+
+    # Activity-adaptive path (exact): advance the extended window p =
+    # _SKIP_PERIOD generations; rows [p, H_ext-p) are valid at gen p.  If
+    # they equal gen 0 there, then by induction on p-generation steps the
+    # true state at every multiple of p ≤ pad equals gen 0 on the window
+    # shrunk by that many rows — in particular the centre tile at gen
+    # ``turns`` (a multiple of p, ≤ pad) is EXACTLY the input tile, and
+    # the remaining turns-p generations are skipped.
+    #
+    # p = 6 = lcm(2, 3) covers real ash: still lifes, blinkers-and-kin
+    # (period 2) AND pulsars (period 3 — measured to dominate residual
+    # activity in settled soups: with p = 2, 0/16 stripes of a 400k-gen
+    # 16384² board are stable; with p = 6, 14/16 are).  Anything truly
+    # active (gliders, growth) fails the compare and pays ~p/T extra.
+    t0 = tile[:]
+    tp = jax.lax.fori_loop(0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), t0)
+    # Compare on rows [p, H_ext-p) via an iota mask — Mosaic has no
+    # unaligned-slice lowering, and the mask is launch-overhead only.
+    h_ext = tile_h + 2 * pad
+    rows = jax.lax.broadcasted_iota(jnp.int32, (h_ext, t0.shape[1]), 0)
+    inner = (rows >= _SKIP_PERIOD) & (rows < h_ext - _SKIP_PERIOD)
+    stable = jnp.all(jnp.where(inner, tp ^ t0, jnp.uint32(0)) == 0)
+    out = jax.lax.cond(
+        stable,
+        lambda: t0,
+        lambda: jax.lax.fori_loop(
+            _SKIP_PERIOD, turns, lambda _, a: _gen(a, rule), tp
+        ),
+    )
     o_ref[:] = out[pad : pad + tile_h, :]
 
 
@@ -279,7 +345,11 @@ def _use_interpret() -> bool:
 
 @functools.lru_cache(maxsize=None)
 def _build_launch(
-    shape: tuple[int, int], rule: LifeRule, turns: int, interpret: bool
+    shape: tuple[int, int],
+    rule: LifeRule,
+    turns: int,
+    interpret: bool,
+    skip_stable: bool = False,
 ):
     """A pallas_call advancing a packed (H, wp) board ``turns`` generations
     in one HBM pass (turns ≤ pad ≤ _MAX_T)."""
@@ -289,13 +359,24 @@ def _build_launch(
             f"tiled pallas packed kernel needs wp % {_LANES} == 0 and "
             f"H % 8 == 0; got packed shape {h}x{wp} (use supports())"
         )
+    if skip_stable and (turns % _SKIP_PERIOD or turns < _SKIP_PERIOD):
+        raise ValueError(
+            f"skip_stable launches need turns to be a positive multiple "
+            f"of the skip period ({_SKIP_PERIOD})"
+        )
     pad = _round8(turns)
-    tile_h = _tile_for_pad(h, wp, pad)
+    tile_h = _tile_for_pad(h, wp, pad, _SKIP_TILE_CAP if skip_stable else None)
     if tile_h is None:
         raise ValueError(f"no VMEM tiling for {turns} turns on {h}x{wp}")
     grid = h // tile_h
     kernel = partial(
-        _kernel, tile_h=tile_h, pad=pad, grid=grid, turns=turns, rule=rule
+        _kernel,
+        tile_h=tile_h,
+        pad=pad,
+        grid=grid,
+        turns=turns,
+        rule=rule,
+        skip_stable=skip_stable,
     )
     return pl.pallas_call(
         kernel,
@@ -307,17 +388,28 @@ def _build_launch(
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
             pltpu.SemaphoreType.DMA((3,)),
         ],
-        compiler_params=_compiler_params(tile_h, pad, wp),
+        compiler_params=_compiler_params(tile_h, pad, wp, skip_stable),
         interpret=interpret,
     )
 
 
-def make_superstep(rule: LifeRule = CONWAY, interpret: bool | None = None):
+def make_superstep(
+    rule: LifeRule = CONWAY,
+    interpret: bool | None = None,
+    skip_stable: bool = False,
+):
     """``(packed, turns) -> packed``: temporally-blocked supersteps.
 
     ``turns`` is split into launches of T = ``launch_turns(shape, turns)``
     generations plus one remainder launch; every launch is one pallas_call
     with all T generations computed in VMEM.
+
+    ``skip_stable`` enables the activity-adaptive kernel: tiles whose
+    halo-extended window has period dividing ``_SKIP_PERIOD`` (6 — ash:
+    still lifes, blinkers, pulsars) cost 6 generations + a compare
+    instead of T.  Bit-exact for every board (the skip criterion is a
+    proof, not a heuristic); pays off once a long run has settled into
+    mostly-stable regions and costs a few % while everything is active.
     """
 
     @partial(jax.jit, static_argnames=("turns",))
@@ -327,29 +419,47 @@ def make_superstep(rule: LifeRule = CONWAY, interpret: bool | None = None):
         ip = _use_interpret() if interpret is None else interpret
         shape = board.shape
         vshape = _vmem_resident_shape(*shape)
-        if vshape is not None:
+        # skip_stable lives in the tiled kernel; boards only the resident
+        # path takes (wp not a lane multiple) keep their normal fast path.
+        if vshape is not None and not (skip_stable and _tiled_supports(shape)):
             # Small board: relayout to vertical packing (amortised over the
             # whole superstep) and run every generation in one launch.
             v = pack_vertical(unpack(board))
             v = _build_vmem_resident(vshape, rule, turns, ip)(v)
             return pack(unpack_vertical(v))
-        return _run_tiled(board, rule, turns, ip)
+        return _run_tiled(board, rule, turns, ip, skip_stable)
 
     return run
 
 
-def _run_tiled(board: jax.Array, rule: LifeRule, turns: int, ip: bool) -> jax.Array:
+def _run_tiled(
+    board: jax.Array,
+    rule: LifeRule,
+    turns: int,
+    ip: bool,
+    skip_stable: bool = False,
+) -> jax.Array:
     shape = board.shape
-    t = launch_turns(shape, turns)
+    t = launch_turns(shape, turns, _SKIP_TILE_CAP if skip_stable else None)
+    if skip_stable and t > _SKIP_PERIOD:
+        t -= t % _SKIP_PERIOD  # the skip proof needs period-multiple launches
+    adaptive = skip_stable and t >= _SKIP_PERIOD and t % _SKIP_PERIOD == 0
     full, rem = divmod(turns, t)
-    call = _build_launch(shape, rule, t, ip)
+    call = _build_launch(shape, rule, t, ip, adaptive)
     board = jax.lax.fori_loop(0, full, lambda _, b: call(b), board)
     if rem:
-        board = _build_launch(shape, rule, rem, ip)(board)
+        rem_adaptive = (
+            skip_stable and rem >= _SKIP_PERIOD and rem % _SKIP_PERIOD == 0
+        )
+        board = _build_launch(shape, rule, rem, ip, rem_adaptive)(board)
     return board
 
 
-def make_superstep_bytes(rule: LifeRule = CONWAY, interpret: bool | None = None):
+def make_superstep_bytes(
+    rule: LifeRule = CONWAY,
+    interpret: bool | None = None,
+    skip_stable: bool = False,
+):
     """``(board_u8, turns) -> board_u8`` engine-layer drop-in: one packing
     pass each way around the kernel — VMEM-resident boards go straight to
     the vertical layout (no intermediate horizontal round trip)."""
@@ -361,9 +471,11 @@ def make_superstep_bytes(rule: LifeRule = CONWAY, interpret: bool | None = None)
         ip = _use_interpret() if interpret is None else interpret
         h, w = board.shape
         vshape = _vmem_resident_shape(h, w // 32)
-        if vshape is not None:
+        if vshape is not None and not (
+            skip_stable and _tiled_supports((h, w // 32))
+        ):
             v = _build_vmem_resident(vshape, rule, turns, ip)(pack_vertical(board))
             return unpack_vertical(v)
-        return unpack(_run_tiled(pack(board), rule, turns, ip))
+        return unpack(_run_tiled(pack(board), rule, turns, ip, skip_stable))
 
     return run

@@ -1,0 +1,88 @@
+"""Multi-host (multi-process) execution — the DCN tier of the backend.
+
+The reference scales across machines with a broker dialling worker servers
+over TCP (``broker/broker.go:86-108``); its data plane re-broadcasts the
+whole board to every worker every turn.  The TPU-native equivalent is a
+**process-spanning `jax.sharding.Mesh`**: each host owns a contiguous row
+band of the board (its local devices subdivide the band), and the SAME
+`shard_map` halo-exchange programs used within a chip mesh
+(``parallel/halo.py``, ``parallel/packed_halo.py``, ``parallel/pallas_halo.py``)
+run unchanged — XLA routes the `ppermute` edge exchanges over ICI between
+local devices and over DCN (gloo/grpc on CPU test rigs) between hosts.
+Only the two band-boundary rows per neighbouring host pair cross the
+network per exchange, vs the reference's full board per worker per turn.
+
+Control plane: process 0 is the controller (events, keypresses, PGM IO);
+other processes run the same SPMD data plane and block in the collectives.
+This module only owns the mesh/runtime plumbing — the engine programs are
+deliberately unaware they span hosts.
+
+Hermetic proof: ``tests/test_multihost.py`` launches two OS processes with
+four virtual CPU devices each, builds the (8, 1) global mesh, and checks
+the sharded run is bit-identical to the single-process engine — the same
+oracle discipline as every other tier.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from distributed_gol_tpu.parallel import mesh as mesh_lib
+
+
+def initialize(
+    coordinator: str, num_processes: int, process_id: int
+) -> None:
+    """Join the process-spanning JAX runtime.
+
+    On CPU rigs the cross-host collective transport is gloo; on TPU pods
+    the TPU runtime owns transport and this reduces to
+    ``jax.distributed.initialize()`` with cluster-provided defaults.
+    """
+    # Decide the CPU transport WITHOUT touching the backend:
+    # jax.default_backend() would initialise XLA, which must not happen
+    # before jax.distributed.initialize().
+    import os
+
+    platforms = getattr(jax.config, "jax_platforms", None) or ""
+    platform = (platforms or os.environ.get("JAX_PLATFORMS", "")).split(",")[0]
+    if platform == "cpu":
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # older jax spells it differently; non-fatal
+            pass
+    jax.distributed.initialize(
+        coordinator, num_processes=num_processes, process_id=process_id
+    )
+
+
+def global_row_mesh() -> jax.sharding.Mesh:
+    """A (n_global_devices, 1) mesh spanning every process.
+
+    ``jax.devices()`` orders devices process-contiguously, so each host
+    owns a contiguous row band — host boundaries cross DCN exactly once
+    per halo exchange, interior boundaries stay on-host.
+    """
+    return mesh_lib.make_mesh((len(jax.devices()), 1))
+
+
+def put_global(board: np.ndarray, sharding) -> jax.Array:
+    """Place a host-replicated board onto a process-spanning sharding.
+
+    Every process passes the same full board (read from the shared
+    filesystem, the standard multi-host pattern); each extracts and
+    uploads only its addressable shards.
+    """
+    return jax.make_array_from_callback(
+        board.shape, sharding, lambda idx: board[idx]
+    )
+
+
+def fetch_global(arr: jax.Array) -> np.ndarray:
+    """Gather a process-spanning array to a full host copy on EVERY
+    process (the final-board / snapshot path)."""
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))

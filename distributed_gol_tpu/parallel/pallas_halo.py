@@ -63,7 +63,7 @@ def supports(pshape: tuple[int, int], mesh_shape: tuple[int, int]) -> bool:
     no lane constraint, so hermetic CPU tests can exercise every shape)."""
     h, wp = pshape
     ny, nx = mesh_shape
-    if nx != 1 or h % ny:
+    if wp <= 0 or nx != 1 or h % ny:
         return False
     h_loc = h // ny
     if h_loc % 8 or h_loc < 8:

@@ -1,0 +1,80 @@
+"""Worker process for the multi-host proof (see test_multihost.py).
+
+Each OS process joins the distributed runtime with 4 virtual CPU devices,
+builds the process-spanning (8, 1) row mesh, runs the packed word-halo
+engine 100 turns at 64² over it, and (process 0) checks bit-identity
+against the single-device engine plus the psum'd per-turn counts.
+
+Run: python tests/multihost_worker.py <coordinator> <nprocs> <pid> <okfile>
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Drop any inherited device-count flag (the pytest parent sets 8) before
+# pinning this process to 4 — flag parsers don't reliably take the last.
+_flags = [
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if not f.startswith("--xla_force_host_platform_device_count")
+]
+os.environ["XLA_FLAGS"] = " ".join(
+    _flags + ["--xla_force_host_platform_device_count=4"]
+)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    coordinator, nprocs, pid, okfile = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4],
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_gol_tpu.models.life import CONWAY
+    from distributed_gol_tpu.ops import packed
+    from distributed_gol_tpu.parallel import multihost, packed_halo
+
+    multihost.initialize(coordinator, nprocs, pid)
+    assert len(jax.devices()) == 4 * nprocs, jax.devices()
+    assert len(jax.local_devices()) == 4
+
+    mesh = multihost.global_row_mesh()
+    rng = np.random.default_rng(42)  # same seed everywhere: shared "PGM"
+    board = np.where(rng.random((64, 64)) < 0.3, 255, 0).astype(np.uint8)
+    turns = 100
+
+    pboard_np = np.asarray(packed.pack(jnp.asarray(board)))
+    pb = multihost.put_global(pboard_np, packed_halo.packed_sharding(mesh))
+    final, counts = packed_halo.sharded_steps_with_counts(mesh, CONWAY)(pb, turns)
+    jax.block_until_ready(final)
+
+    final_np = multihost.fetch_global(final)
+    counts_np = multihost.fetch_global(counts)[:turns]  # replicated
+
+    # Single-process oracle (local device 0 only).
+    want_final, want_counts = packed._steps_with_counts(
+        jnp.asarray(pboard_np), CONWAY, turns
+    )
+    if not np.array_equal(final_np, np.asarray(want_final)):
+        print(f"[{pid}] FINAL MISMATCH", flush=True)
+        sys.exit(1)
+    if not np.array_equal(counts_np, np.asarray(want_counts)):
+        print(f"[{pid}] COUNTS MISMATCH", flush=True)
+        sys.exit(1)
+    with open(okfile, "w") as f:
+        f.write("ok")
+    print(f"[{pid}] multihost 64x64x{turns} bit-identical over "
+          f"{nprocs}-process (8,1) mesh", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""Activity-adaptive tiled kernel: exact stability skipping.
+
+The skip criterion is a proof, not a heuristic (see ``_kernel`` in
+``ops/pallas_packed.py``): a tile whose halo-extended window repeats after
+p = 6 generations provably returns to its initial state at every multiple
+of p up to pad, so a launch of T (a multiple of p) generations may return
+the input tile unchanged.
+These tests pin bit-exactness of the adaptive engine against the XLA
+packed engine on boards spanning the interesting regimes: all-dead,
+still-life ash, period-2 oscillators, a moving glider over ash, and a
+random soup (nothing stable).  Interpret mode — hardware evidence comes
+from ``bench.py --engine pallas-packed --skip-stable --verify``.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_gol_tpu.models.life import CONWAY
+from distributed_gol_tpu.ops import packed, pallas_packed
+
+H, W = 64, 4096  # tiled-path shape (wp = 128 lanes), multiple tiles
+
+
+def run_both(board_np: np.ndarray, turns: int):
+    p = packed.pack(jnp.asarray(board_np))
+    got = pallas_packed.make_superstep(CONWAY, interpret=True, skip_stable=True)(
+        p, turns
+    )
+    want = packed.superstep(p, CONWAY, turns)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def blank() -> np.ndarray:
+    return np.zeros((H, W), dtype=np.uint8)
+
+
+def test_all_dead_board_skips_to_itself():
+    run_both(blank(), 24)
+
+
+def test_still_life_ash():
+    b = blank()
+    for y, x in [(10, 100), (30, 2000), (50, 4000)]:  # blocks
+        b[y : y + 2, x : x + 2] = 255
+    run_both(b, 24)
+
+
+def test_period_2_oscillators():
+    b = blank()
+    for y, x in [(8, 64), (40, 1024), (20, 3000)]:  # blinkers
+        b[y, x : x + 3] = 255
+    run_both(b, 24)
+    run_both(b, 26)  # non-multiple remainder handling: launches + rem
+
+
+def test_period_3_pulsar():
+    """Pulsars dominate residual ash activity in settled soups (measured:
+    period-2 skipping stabilises 0/16 stripes of a 400k-gen board, period-6
+    stabilises 14/16) — the reason _SKIP_PERIOD is 6."""
+    b = blank()
+    # Pulsar: quadrant-symmetric period-3 oscillator in a 13x13 box.
+    seg = [2, 3, 4, 8, 9, 10]
+    for y, x in [(20, 200), (40, 2000)]:
+        for c in seg:
+            for r in (0, 5, 7, 12):
+                b[y + r, x + c] = 255
+                b[y + c, x + r] = 255
+    run_both(b, 24)
+    run_both(b, 30)
+
+
+def test_glider_over_ash():
+    b = blank()
+    # glider (active region) ...
+    g = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], dtype=np.uint8) * 255
+    b[4:7, 4:7] = g
+    # ... plus stable furniture far away
+    b[50:52, 3000:3002] = 255
+    b[30, 2000:2003] = 255
+    for turns in (8, 22, 40):
+        run_both(b, turns)
+
+
+def test_random_soup_never_stable():
+    rng = np.random.default_rng(9)
+    b = np.where(rng.random((H, W)) < 0.3, 255, 0).astype(np.uint8)
+    run_both(b, 30)
+
+
+def test_wrap_activity_crosses_tile_seam():
+    """Activity at the torus seam: the top tile's halo sees the bottom
+    rows; a skip decision there must account for it."""
+    b = blank()
+    g = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], dtype=np.uint8) * 255
+    b[H - 3 :, 100:103] = g  # glider about to wrap
+    run_both(b, 16)
+
+
+def test_odd_turns_and_tiny_remainders():
+    b = blank()
+    b[8, 64:67] = 255  # blinker
+    for turns in (1, 3, 7, 9, 25):
+        run_both(b, turns)
+
+
+@pytest.mark.parametrize("bad_turns", [1, 3, 4, 8])
+def test_non_period_multiple_launch_rejected(bad_turns):
+    with pytest.raises(ValueError, match="multiple of the skip period"):
+        pallas_packed._build_launch((H, W // 32), CONWAY, bad_turns, True, True)

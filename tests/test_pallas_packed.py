@@ -123,3 +123,14 @@ class TestVmemResident:
             jnp.asarray(b), 9
         )
         np.testing.assert_array_equal(np.asarray(got), orun(b, 9))
+
+
+def test_degenerate_width_rejected():
+    """Boards narrower than one packed word (wp == 0) are the byte
+    engines' business; supports() must not claim them (wp=0 satisfies
+    wp % 128 == 0 and once crashed the capability probe at 16x16)."""
+    assert not pallas_packed.supports((16, 0))
+    assert not pallas_packed.supports((256, 0))
+    from distributed_gol_tpu.parallel import pallas_halo
+
+    assert not pallas_halo.supports((16, 0), (1, 1))

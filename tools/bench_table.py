@@ -16,7 +16,13 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from bench import bench_config, log, pick_engine, verify_engine  # noqa: E402
+from bench import (  # noqa: E402
+    bench_config,
+    ensure_live_backend,
+    log,
+    pick_engine,
+    verify_engine,
+)
 
 ENGINES = ["roll", "packed", "pallas-packed"]
 
@@ -27,6 +33,8 @@ def main():
     ap.add_argument("--reps", type=int, default=2)
     ap.add_argument("--kturns", type=int, default=0, help="0 = auto per size")
     args = ap.parse_args()
+
+    ensure_live_backend()
 
     import jax
 

@@ -1,0 +1,106 @@
+"""Hardware oracle soak: the reference's 10,000-turn count series on real TPU.
+
+The hermetic suite runs this soak on CPU (tests/test_golden_kernel.py); this
+tool is the *hardware* record: it drives the XLA packed engine's per-turn
+count scan AND the pallas-packed kernel on the device, checks 10k turns of
+alive counts against the reference's check/alive CSVs plus cross-engine
+bit-identity of the final board, and writes SOAK_r{N}.json.
+
+Usage: python tools/hw_soak.py [--round N] [--sizes 16,64,512]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+REF = Path("/root/reference")
+TURNS = 10_000
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def golden_counts(size: int) -> list[int]:
+    with open(REF / "check" / "alive" / f"{size}x{size}.csv") as f:
+        rows = list(csv.reader(f))
+    return [int(r[1]) for r in rows[1:]]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=2)
+    ap.add_argument("--sizes", default="16,64,512")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_gol_tpu.engine.pgm import read_pgm
+    from distributed_gol_tpu.models.life import CONWAY
+    from distributed_gol_tpu.ops import packed, pallas_packed, stencil
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} platform={dev.platform}")
+    results = []
+    for size in [int(s) for s in args.sizes.split(",")]:
+        board = read_pgm(REF / "images" / f"{size}x{size}.pgm")
+        want = golden_counts(size)[:TURNS]
+
+        t0 = time.perf_counter()
+        if packed.supports(board.shape):
+            pb = packed.pack(jnp.asarray(board))
+            final, counts = packed.steps_with_counts(pb, CONWAY, TURNS)
+            final_u8 = packed.unpack(final)
+        else:  # 16x16: width < one word; roll stencil carries the soak
+            table = jnp.asarray(CONWAY.table)
+            final_u8, counts = stencil.steps_with_counts(
+                jnp.asarray(board), table, TURNS
+            )
+        got = [int(c) for c in np.asarray(counts)]
+        counts_ok = got == want
+        dt = time.perf_counter() - t0
+
+        kernel_ok = None
+        if pallas_packed.supports((board.shape[0], board.shape[1] // 32)):
+            kfinal = pallas_packed.make_superstep_bytes(CONWAY)(
+                jnp.asarray(board), TURNS
+            )
+            kernel_ok = bool(jnp.array_equal(kfinal, final_u8))
+        log(
+            f"  {size}x{size}: counts {'OK' if counts_ok else 'MISMATCH'} "
+            f"({len(got)} turns, {dt:.1f}s), pallas-packed final "
+            f"{'bit-identical' if kernel_ok else kernel_ok}"
+        )
+        results.append(
+            {
+                "size": size,
+                "turns": TURNS,
+                "counts_match_reference_csv": counts_ok,
+                "pallas_packed_final_bit_identical": kernel_ok,
+                "platform": dev.platform,
+            }
+        )
+
+    out = Path(__file__).resolve().parent.parent / f"SOAK_r{args.round:02d}.json"
+    out.write_text(json.dumps(results, indent=1) + "\n")
+    print(json.dumps(results))
+    if not all(
+        r["counts_match_reference_csv"]
+        and r["pallas_packed_final_bit_identical"] in (True, None)
+        for r in results
+    ):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -7,9 +7,12 @@ one v5e chip — reduces to three measured numbers:
 2. the cost of the cross-lane / cross-sublane rotates the stencil needs,
 3. HBM stream bandwidth (to confirm temporal blocking removed it as a bound).
 
-This tool measures all three with minimal Pallas kernels and prints the
-derived attainable gens/s for the measured ops/word/generation of the
-production kernel.  Run on the real chip (interpret mode measures nothing).
+This tool measures all three with minimal Pallas kernels.  The chain/roll
+probes stream through VMEM, so they are LOWER bounds on the VPU (the
+production kernel, register-resident, out-runs them ~3.6×) — the tool
+reports them plus the per-generation HBM-pass cap; the derived-ceiling
+analysis lives in BASELINE.md §roofline.  Run on the real chip (interpret
+mode measures nothing).
 
 Usage: python tools/roofline.py [--iters N]
 """
