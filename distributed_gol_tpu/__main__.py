@@ -71,6 +71,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--max-dispatch-seconds", type=float, default=0.25,
                     help="adaptive-superstep target per dispatch; bounds "
                          "keypress latency at ~2x this value")
+    ap.add_argument("--skip-stable", action="store_true",
+                    help="activity-adaptive pallas-packed kernel: period-6-"
+                         "stable tiles (ash) skip their generations, exactly")
     return ap
 
 
@@ -98,6 +101,7 @@ def params_from_args(args) -> Params:
         view_mode=args.view_mode,
         frame_max=(int(fh), int(fw)),
         max_dispatch_seconds=args.max_dispatch_seconds,
+        skip_stable=args.skip_stable,
     )
 
 

@@ -52,7 +52,9 @@ class Backend:
             if self.engine_used == "pallas-packed":
                 from distributed_gol_tpu.ops import pallas_packed
 
-                self._superstep = pallas_packed.make_superstep_bytes(params.rule)
+                self._superstep = pallas_packed.make_superstep_bytes(
+                    params.rule, skip_stable=params.skip_stable
+                )
             elif self.engine_used == "packed":
                 from distributed_gol_tpu.ops import packed
 
@@ -73,7 +75,7 @@ class Backend:
                 # T-deep halos: one ppermute exchange per launch buys T
                 # generations — the sharded form of temporal blocking.
                 self._superstep = pallas_halo.make_superstep_bytes(
-                    self.mesh, params.rule
+                    self.mesh, params.rule, skip_stable=params.skip_stable
                 )
             elif self.engine_used == "packed":
                 from distributed_gol_tpu.parallel import packed_halo

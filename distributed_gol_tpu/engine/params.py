@@ -48,6 +48,11 @@ class Params:
     # (best available for the board/mesh/platform).  All engines are
     # bit-identical; unsupported shapes fall back (see Backend.engine_used).
     engine: str = "auto"
+    # Activity-adaptive kernel for the pallas-packed engine (exact, see
+    # ops/pallas_packed.py): tiles proving their window period-6 stable
+    # (ash) skip their generations.  Worthwhile for long runs that settle;
+    # costs a few % while everything is active.  Ignored by other engines.
+    skip_stable: bool = False
     # CellFlipped emission policy: "auto" (per-cell when a viewer is attached
     # i.e. not no_vis, off headless), "cell" (always, reference contract),
     # "batch" (one CellsFlipped per turn), "off".  Any flip mode forces

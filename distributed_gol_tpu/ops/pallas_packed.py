@@ -274,6 +274,57 @@ def _build_vmem_resident(
     )
 
 
+def _adaptive_eligible(turns: int) -> bool:
+    """Whether a launch of ``turns`` generations may use the skip proof."""
+    return turns >= _SKIP_PERIOD and turns % _SKIP_PERIOD == 0
+
+
+def skip_plan(t: int) -> tuple[int, bool]:
+    """Round a launch depth to the adaptive contract: the skip proof needs
+    period-multiple launches.  Returns (rounded t, adaptive?)."""
+    if t > _SKIP_PERIOD:
+        t -= t % _SKIP_PERIOD
+    return t, _adaptive_eligible(t)
+
+
+def _advance_window(tile0, tile_h: int, pad: int, turns: int, rule, skip_stable):
+    """``turns`` generations of a halo-extended (tile_h + 2·pad, wp) window
+    held in VMEM — THE shared body of the single-device and sharded tiled
+    kernels, including the activity-adaptive skip proof (one home, so the
+    two kernels cannot drift apart).
+
+    Adaptive path (exact): advance the window p = ``_SKIP_PERIOD``
+    generations; rows [p, H_ext-p) are valid at gen p.  If they equal gen 0
+    there, then by induction on p-generation steps the true state at every
+    multiple of p ≤ pad equals gen 0 on the window shrunk by that many
+    rows — in particular the centre tile at gen ``turns`` (a multiple of
+    p, ≤ pad) is EXACTLY the input tile, and the remaining turns-p
+    generations are skipped.
+
+    p = 6 = lcm(2, 3) covers real ash: still lifes, blinkers-and-kin
+    (period 2) AND pulsars (period 3 — measured to dominate residual
+    activity in settled soups: with p = 2, 0/16 stripes of a 400k-gen
+    16384² board are stable; with p = 6, 14/16 are).  Anything truly
+    active (gliders, growth) fails the compare and pays ~p/T extra.
+    """
+    if not skip_stable:
+        return jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), tile0)
+    tp = jax.lax.fori_loop(0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), tile0)
+    # Compare on rows [p, H_ext-p) via an iota mask — Mosaic has no
+    # unaligned-slice lowering, and the mask is launch-overhead only.
+    h_ext = tile_h + 2 * pad
+    rows = jax.lax.broadcasted_iota(jnp.int32, (h_ext, tile0.shape[1]), 0)
+    inner = (rows >= _SKIP_PERIOD) & (rows < h_ext - _SKIP_PERIOD)
+    stable = jnp.all(jnp.where(inner, tp ^ tile0, jnp.uint32(0)) == 0)
+    return jax.lax.cond(
+        stable,
+        lambda: tile0,
+        lambda: jax.lax.fori_loop(
+            _SKIP_PERIOD, turns, lambda _, a: _gen(a, rule), tp
+        ),
+    )
+
+
 def _kernel(
     x_hbm, o_ref, tile, sems, *, tile_h, pad, grid, turns, rule, skip_stable
 ):
@@ -301,39 +352,7 @@ def _kernel(
     for c in copies:
         c.wait()
 
-    if not skip_stable:
-        out = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), tile[:])
-        o_ref[:] = out[pad : pad + tile_h, :]
-        return
-
-    # Activity-adaptive path (exact): advance the extended window p =
-    # _SKIP_PERIOD generations; rows [p, H_ext-p) are valid at gen p.  If
-    # they equal gen 0 there, then by induction on p-generation steps the
-    # true state at every multiple of p ≤ pad equals gen 0 on the window
-    # shrunk by that many rows — in particular the centre tile at gen
-    # ``turns`` (a multiple of p, ≤ pad) is EXACTLY the input tile, and
-    # the remaining turns-p generations are skipped.
-    #
-    # p = 6 = lcm(2, 3) covers real ash: still lifes, blinkers-and-kin
-    # (period 2) AND pulsars (period 3 — measured to dominate residual
-    # activity in settled soups: with p = 2, 0/16 stripes of a 400k-gen
-    # 16384² board are stable; with p = 6, 14/16 are).  Anything truly
-    # active (gliders, growth) fails the compare and pays ~p/T extra.
-    t0 = tile[:]
-    tp = jax.lax.fori_loop(0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), t0)
-    # Compare on rows [p, H_ext-p) via an iota mask — Mosaic has no
-    # unaligned-slice lowering, and the mask is launch-overhead only.
-    h_ext = tile_h + 2 * pad
-    rows = jax.lax.broadcasted_iota(jnp.int32, (h_ext, t0.shape[1]), 0)
-    inner = (rows >= _SKIP_PERIOD) & (rows < h_ext - _SKIP_PERIOD)
-    stable = jnp.all(jnp.where(inner, tp ^ t0, jnp.uint32(0)) == 0)
-    out = jax.lax.cond(
-        stable,
-        lambda: t0,
-        lambda: jax.lax.fori_loop(
-            _SKIP_PERIOD, turns, lambda _, a: _gen(a, rule), tp
-        ),
-    )
+    out = _advance_window(tile[:], tile_h, pad, turns, rule, skip_stable)
     o_ref[:] = out[pad : pad + tile_h, :]
 
 
@@ -359,7 +378,7 @@ def _build_launch(
             f"tiled pallas packed kernel needs wp % {_LANES} == 0 and "
             f"H % 8 == 0; got packed shape {h}x{wp} (use supports())"
         )
-    if skip_stable and (turns % _SKIP_PERIOD or turns < _SKIP_PERIOD):
+    if skip_stable and not _adaptive_eligible(turns):
         raise ValueError(
             f"skip_stable launches need turns to be a positive multiple "
             f"of the skip period ({_SKIP_PERIOD})"
@@ -441,17 +460,16 @@ def _run_tiled(
 ) -> jax.Array:
     shape = board.shape
     t = launch_turns(shape, turns, _SKIP_TILE_CAP if skip_stable else None)
-    if skip_stable and t > _SKIP_PERIOD:
-        t -= t % _SKIP_PERIOD  # the skip proof needs period-multiple launches
-    adaptive = skip_stable and t >= _SKIP_PERIOD and t % _SKIP_PERIOD == 0
+    adaptive = False
+    if skip_stable:
+        t, adaptive = skip_plan(t)
     full, rem = divmod(turns, t)
     call = _build_launch(shape, rule, t, ip, adaptive)
     board = jax.lax.fori_loop(0, full, lambda _, b: call(b), board)
     if rem:
-        rem_adaptive = (
-            skip_stable and rem >= _SKIP_PERIOD and rem % _SKIP_PERIOD == 0
-        )
-        board = _build_launch(shape, rule, rem, ip, rem_adaptive)(board)
+        board = _build_launch(
+            shape, rule, rem, ip, skip_stable and _adaptive_eligible(rem)
+        )(board)
     return board
 
 

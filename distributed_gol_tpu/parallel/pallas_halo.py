@@ -46,12 +46,16 @@ from jax.sharding import Mesh
 from distributed_gol_tpu.models.life import CONWAY, LifeRule
 from distributed_gol_tpu.ops.pallas_packed import (
     _LANES,
+    _SKIP_PERIOD,
+    _SKIP_TILE_CAP,
+    _adaptive_eligible,
+    _advance_window,
     _compiler_params,
-    _gen,
     _round8,
     _tile_for_pad,
     _use_interpret,
     launch_turns,
+    skip_plan,
 )
 from distributed_gol_tpu.parallel.halo import BOARD_SPEC, _shift_perm
 
@@ -73,7 +77,9 @@ def supports(pshape: tuple[int, int], mesh_shape: tuple[int, int]) -> bool:
     return _tile_for_pad(h_loc, wp, 8) is not None
 
 
-def _ext_kernel(x_hbm, o_ref, tile, sem, *, tile_h, pad, turns, rule):
+def _ext_kernel(
+    x_hbm, o_ref, tile, sem, *, tile_h, pad, turns, rule, skip_stable
+):
     """T generations of one (tile_h + 2·pad)-row window of the halo-extended
     strip.  The window is contiguous in the extended input — tile i's halo
     rows ARE its neighbours' boundary rows — so a single DMA loads it."""
@@ -83,23 +89,44 @@ def _ext_kernel(x_hbm, o_ref, tile, sem, *, tile_h, pad, turns, rule):
     )
     copy.start()
     copy.wait()
-    out = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), tile[:])
+    # Shared window body incl. the exact period-6 skip proof — the sharded
+    # form is identical because the extended window already carries the
+    # neighbour strips' boundary rows (ops/pallas_packed.py).
+    out = _advance_window(tile[:], tile_h, pad, turns, rule, skip_stable)
     o_ref[:] = out[pad : pad + tile_h, :]
 
 
 @functools.lru_cache(maxsize=None)
 def _build_ext_launch(
-    strip: tuple[int, int], rule: LifeRule, turns: int, interpret: bool
+    strip: tuple[int, int],
+    rule: LifeRule,
+    turns: int,
+    interpret: bool,
+    skip_stable: bool = False,
 ):
     """pallas_call advancing a halo-extended (h_loc + 2·pad, wp) strip by
     ``turns`` ≤ pad generations, returning the (h_loc, wp) centre."""
     h_loc, wp = strip
+    if skip_stable and not _adaptive_eligible(turns):
+        raise ValueError(
+            f"skip_stable launches need turns to be a positive multiple "
+            f"of the skip period ({_SKIP_PERIOD})"
+        )
     pad = _round8(turns)
-    tile_h = _tile_for_pad(h_loc, wp, pad)
+    tile_h = _tile_for_pad(
+        h_loc, wp, pad, _SKIP_TILE_CAP if skip_stable else None
+    )
     if tile_h is None:
         raise ValueError(f"no VMEM tiling for {turns} turns on strip {strip}")
     grid = h_loc // tile_h
-    kernel = partial(_ext_kernel, tile_h=tile_h, pad=pad, turns=turns, rule=rule)
+    kernel = partial(
+        _ext_kernel,
+        tile_h=tile_h,
+        pad=pad,
+        turns=turns,
+        rule=rule,
+        skip_stable=skip_stable,
+    )
     return pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -110,7 +137,7 @@ def _build_ext_launch(
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
             pltpu.SemaphoreType.DMA,
         ],
-        compiler_params=_compiler_params(tile_h, pad, wp),
+        compiler_params=_compiler_params(tile_h, pad, wp, skip_stable),
         interpret=interpret,
     )
 
@@ -124,10 +151,18 @@ def _extend_rows(local: jax.Array, pad: int) -> jax.Array:
     return jnp.concatenate([from_north, local, from_south], axis=0)
 
 
-def make_superstep(mesh: Mesh, rule: LifeRule = CONWAY, interpret: bool | None = None):
+def make_superstep(
+    mesh: Mesh,
+    rule: LifeRule = CONWAY,
+    interpret: bool | None = None,
+    skip_stable: bool = False,
+):
     """``(packed, turns) -> packed`` on the mesh: turns split into launches
     of T = ``launch_turns(strip, turns)`` generations; each launch is one
-    ppermute halo exchange + one pallas_call per device."""
+    ppermute halo exchange + one pallas_call per device.
+
+    ``skip_stable``: the exact period-6 activity skip of the single-device
+    kernel, per strip tile (see ``ops/pallas_packed.py``)."""
     ny = mesh.shape["y"]
 
     @partial(jax.jit, static_argnames=("turns",))
@@ -137,12 +172,17 @@ def make_superstep(mesh: Mesh, rule: LifeRule = CONWAY, interpret: bool | None =
         ip = _use_interpret() if interpret is None else interpret
         h, wp = board.shape
         strip = (h // ny, wp)
-        t = launch_turns(strip, turns)  # clamps to _MAX_T internally
+        t = launch_turns(
+            strip, turns, _SKIP_TILE_CAP if skip_stable else None
+        )  # clamps to _MAX_T internally
+        if skip_stable:
+            t, _ = skip_plan(t)
         full, rem = divmod(turns, t)
 
         def make_step(tt: int):
+            adaptive = skip_stable and _adaptive_eligible(tt)
             pad = _round8(tt)
-            call = _build_ext_launch(strip, rule, tt, ip)
+            call = _build_ext_launch(strip, rule, tt, ip, adaptive)
 
             # check_vma=False: pallas_call outputs carry no varying-mesh-axes
             # annotation, which the vma checker (rightly) refuses to guess;
@@ -169,14 +209,17 @@ def make_superstep(mesh: Mesh, rule: LifeRule = CONWAY, interpret: bool | None =
 
 
 def make_superstep_bytes(
-    mesh: Mesh, rule: LifeRule = CONWAY, interpret: bool | None = None
+    mesh: Mesh,
+    rule: LifeRule = CONWAY,
+    interpret: bool | None = None,
+    skip_stable: bool = False,
 ):
     """``(board_u8, turns) -> board_u8`` engine-layer drop-in: pack/unpack
     inside the jit, pinned to the mesh sharding so packing stays local."""
     from distributed_gol_tpu.ops.packed import pack, unpack
     from distributed_gol_tpu.parallel.packed_halo import packed_sharding
 
-    inner = make_superstep(mesh, rule, interpret)
+    inner = make_superstep(mesh, rule, interpret, skip_stable)
 
     @partial(jax.jit, static_argnames=("turns",))
     def run(board: jax.Array, turns: int) -> jax.Array:

@@ -109,3 +109,48 @@ def test_odd_turns_and_tiny_remainders():
 def test_non_period_multiple_launch_rejected(bad_turns):
     with pytest.raises(ValueError, match="multiple of the skip period"):
         pallas_packed._build_launch((H, W // 32), CONWAY, bad_turns, True, True)
+
+
+def test_sharded_adaptive_bit_identity():
+    """The sharded form (pallas_halo + skip_stable) on a virtual row mesh:
+    T-deep ppermute halos feed the same per-tile skip proof."""
+    import jax
+
+    from distributed_gol_tpu.parallel import packed_halo, pallas_halo
+    from distributed_gol_tpu.parallel.mesh import make_mesh
+
+    b = blank()
+    g = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], dtype=np.uint8) * 255
+    b[4:7, 4:7] = g  # active
+    b[50:52, 3000:3002] = 255  # ash
+    b[30, 2000:2003] = 255
+    p = packed.pack(jnp.asarray(b))
+    want = np.asarray(packed.superstep(p, CONWAY, 24))
+    for ny in (2, 4):
+        mesh = make_mesh((ny, 1))
+        pb = jax.device_put(np.asarray(p), packed_halo.packed_sharding(mesh))
+        got = pallas_halo.make_superstep(
+            mesh, CONWAY, interpret=True, skip_stable=True
+        )(pb, 24)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_backend_level_skip_stable():
+    """Params.skip_stable reaches the kernel through the Backend and
+    changes nothing about results (run vs the roll backend)."""
+    from distributed_gol_tpu.engine.backend import Backend
+    from distributed_gol_tpu.engine.params import Params
+
+    common = dict(image_width=W, image_height=H, turns=20, superstep=20)
+    b = blank()
+    b[8, 64:67] = 255
+    b[20:23, 300:303] = (
+        np.array([[0, 255, 0], [0, 0, 255], [255, 255, 255]], dtype=np.uint8)
+    )
+    skip = Backend(Params(engine="pallas-packed", skip_stable=True, **common))
+    assert skip.engine_used == "pallas-packed"
+    roll = Backend(Params(engine="roll", **common))
+    got, count = skip.run_turns(skip.put(b), 20)
+    want, want_count = roll.run_turns(roll.put(b), 20)
+    assert count == want_count
+    np.testing.assert_array_equal(skip.fetch(got), roll.fetch(want))
