@@ -52,6 +52,24 @@ class Backend:
             if self.engine_used == "pallas-packed":
                 from distributed_gol_tpu.ops import pallas_packed
 
+                pshape = (shape[0], shape[1] // 32)
+                if (
+                    params.skip_stable
+                    and pallas_packed.is_vmem_resident(pshape)
+                    and pallas_packed.skip_stable_effective(pshape)
+                ):
+                    # Dual-eligible board: honouring skip_stable means the
+                    # tiled kernel, abandoning the (much faster when
+                    # active) VMEM-resident path.  The user asked; warn so
+                    # the trade is visible.
+                    import warnings
+
+                    warnings.warn(
+                        "skip_stable forces the tiled kernel on a board "
+                        "eligible for the VMEM-resident fast path; unless "
+                        "the board is mostly ash this is slower",
+                        stacklevel=2,
+                    )
                 self._superstep = pallas_packed.make_superstep_bytes(
                     params.rule, skip_stable=params.skip_stable
                 )

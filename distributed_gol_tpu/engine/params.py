@@ -51,7 +51,12 @@ class Params:
     # Activity-adaptive kernel for the pallas-packed engine (exact, see
     # ops/pallas_packed.py): tiles proving their window period-6 stable
     # (ash) skip their generations.  Worthwhile for long runs that settle;
-    # costs a few % while everything is active.  Ignored by other engines.
+    # costs a few % while everything is active ON TILED BOARDS (W % 4096
+    # == 0).  Boards eligible for the VMEM-resident fast path (≲3072²)
+    # lose it when they are also tileable — the adaptive kernel is tiled —
+    # which can cost far more than skipping recovers unless the board is
+    # mostly ash; the Backend warns when that trade is being made.
+    # Ignored by engines without an adaptive form.
     skip_stable: bool = False
     # CellFlipped emission policy: "auto" (per-cell when a viewer is attached
     # i.e. not no_vis, off headless), "cell" (always, reference contract),

@@ -279,6 +279,15 @@ def _adaptive_eligible(turns: int) -> bool:
     return turns >= _SKIP_PERIOD and turns % _SKIP_PERIOD == 0
 
 
+def _require_adaptive_eligible(turns: int) -> None:
+    """The launch-depth contract both tiled kernels enforce — one home."""
+    if not _adaptive_eligible(turns):
+        raise ValueError(
+            f"skip_stable launches need turns to be a positive multiple "
+            f"of the skip period ({_SKIP_PERIOD})"
+        )
+
+
 def skip_plan(t: int) -> tuple[int, bool]:
     """Round a launch depth to the adaptive contract: the skip proof needs
     period-multiple launches.  Returns (rounded t, adaptive?)."""
@@ -378,11 +387,8 @@ def _build_launch(
             f"tiled pallas packed kernel needs wp % {_LANES} == 0 and "
             f"H % 8 == 0; got packed shape {h}x{wp} (use supports())"
         )
-    if skip_stable and not _adaptive_eligible(turns):
-        raise ValueError(
-            f"skip_stable launches need turns to be a positive multiple "
-            f"of the skip period ({_SKIP_PERIOD})"
-        )
+    if skip_stable:
+        _require_adaptive_eligible(turns)
     pad = _round8(turns)
     tile_h = _tile_for_pad(h, wp, pad, _SKIP_TILE_CAP if skip_stable else None)
     if tile_h is None:

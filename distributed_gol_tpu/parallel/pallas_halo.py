@@ -46,11 +46,11 @@ from jax.sharding import Mesh
 from distributed_gol_tpu.models.life import CONWAY, LifeRule
 from distributed_gol_tpu.ops.pallas_packed import (
     _LANES,
-    _SKIP_PERIOD,
     _SKIP_TILE_CAP,
     _adaptive_eligible,
     _advance_window,
     _compiler_params,
+    _require_adaptive_eligible,
     _round8,
     _tile_for_pad,
     _use_interpret,
@@ -107,11 +107,8 @@ def _build_ext_launch(
     """pallas_call advancing a halo-extended (h_loc + 2·pad, wp) strip by
     ``turns`` ≤ pad generations, returning the (h_loc, wp) centre."""
     h_loc, wp = strip
-    if skip_stable and not _adaptive_eligible(turns):
-        raise ValueError(
-            f"skip_stable launches need turns to be a positive multiple "
-            f"of the skip period ({_SKIP_PERIOD})"
-        )
+    if skip_stable:
+        _require_adaptive_eligible(turns)
     pad = _round8(turns)
     tile_h = _tile_for_pad(
         h_loc, wp, pad, _SKIP_TILE_CAP if skip_stable else None

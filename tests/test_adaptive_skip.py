@@ -154,3 +154,23 @@ def test_backend_level_skip_stable():
     want, want_count = roll.run_turns(roll.put(b), 20)
     assert count == want_count
     np.testing.assert_array_equal(skip.fetch(got), roll.fetch(want))
+
+
+def test_gosper_gun_unbounded_growth():
+    """A glider gun (unbounded growth) — the adversarial case for any
+    skipping scheme: the active region expands every generation and newly
+    reached tiles must never be treated as stable."""
+    b = blank()
+    gun = [
+        (5, 1), (5, 2), (6, 1), (6, 2),
+        (5, 11), (6, 11), (7, 11), (4, 12), (8, 12), (3, 13), (9, 13),
+        (3, 14), (9, 14), (6, 15), (4, 16), (8, 16), (5, 17), (6, 17),
+        (7, 17), (6, 18),
+        (3, 21), (4, 21), (5, 21), (3, 22), (4, 22), (5, 22), (2, 23),
+        (6, 23), (1, 25), (2, 25), (6, 25), (7, 25),
+        (3, 35), (4, 35), (3, 36), (4, 36),
+    ]
+    for y, x in gun:
+        b[y + 8, x + 60] = 255
+    for turns in (30, 62):
+        run_both(b, turns)

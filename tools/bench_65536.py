@@ -1,0 +1,95 @@
+"""The 65536² board (BASELINE config 4's size) on ONE v5e chip.
+
+Config 4 prescribes 65536² sharded over a v5e-4 mesh; multi-chip hardware
+isn't available to this rig, but the board itself fits a single chip's HBM
+when bit-packed (65536 × 2048 uint32 words = 512 MB), so this tool runs the
+real thing single-chip: generate the soup directly in packed form ON DEVICE
+(a host-side uint8 board would be 4.3 GB), time the temporally-blocked
+kernel, and record cross-engine bit-identity.  The sharded execution path
+for this size is dryrun-proven in ``__graft_entry__.dryrun_multichip``
+(65536-row slice + static launch plan on a (4,1) mesh).
+
+Usage: python tools/bench_65536.py [--kturns N] [--reps R]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kturns", type=int, default=512)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_gol_tpu.models.life import CONWAY
+    from distributed_gol_tpu.ops import packed, pallas_packed
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} platform={dev.platform}")
+
+    H, WP = 65536, 65536 // 32
+
+    def _sync(x):
+        return np.asarray(jax.device_get(x.ravel()[0]))
+
+    # ~50%-density soup, generated packed on device (random word bits).
+    key = jax.random.key(0)
+    board = jax.random.bits(key, (H, WP), dtype=jnp.uint32)
+    _sync(board)
+
+    superstep = pallas_packed.make_superstep(CONWAY)
+    t = pallas_packed.launch_turns(board.shape, args.kturns)
+    log(f"  temporal blocking: T={t}")
+    t0 = time.perf_counter()
+    board = superstep(board, args.kturns)
+    _sync(board)
+    log(f"  compile+first superstep: {time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    b = board
+    for _ in range(args.reps):
+        b = superstep(b, args.kturns)
+    _sync(b)
+    dt = (time.perf_counter() - t0) / args.reps
+    gps = args.kturns / dt
+    log(f"  65536x65536: {args.kturns} gens in {dt:.3f}s -> {gps:,.0f} gens/s, "
+        f"{gps * H * H:.3e} cell-updates/s")
+
+    # Bit-identity vs the XLA packed engine, 16 gens on the evolved board.
+    want = packed.superstep(b, CONWAY, 16)
+    got = superstep(b, 16)
+    ok = bool(jnp.array_equal(got, want))
+    log(f"  verify vs XLA packed, 16 gens: {'bit-identical' if ok else 'MISMATCH'}")
+
+    print(
+        json.dumps(
+            {
+                "metric": f"gol_gens_per_sec_65536x65536_pallas-packed_{dev.platform}",
+                "value": round(gps, 2),
+                "unit": "generations/sec",
+                "cell_updates_per_sec": gps * H * H,
+                "bit_identical": ok,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
