@@ -130,9 +130,17 @@ class Controller:
                 self._emit(CellFlipped(turn, Cell(int(x), int(y))))
 
     # -- keypresses (gol/distributor.go:105-151) -------------------------------
+    def _write_pgm(self, path, board_np):
+        """File-output seam: multi-host runs override this so only the
+        controller process touches the filesystem (the fetch that feeds it
+        is collective and runs everywhere)."""
+        pgm.write_pgm(path, board_np)
+
     def _snapshot(self, board, turn: int):
         name = self.params.snapshot_name(turn)
-        pgm.write_pgm(self.params.out_dir / f"{name}.pgm", self.backend.fetch(board))
+        self._write_pgm(
+            self.params.out_dir / f"{name}.pgm", self.backend.fetch(board)
+        )
         self._emit(ImageOutputComplete(turn, name))
 
     def _handle_key(self, key: str, board, turn: int):
@@ -340,13 +348,19 @@ class Controller:
             ckpt = self.session.check_states(p.image_width, p.image_height)
             if ckpt is not None:
                 return ckpt.world, ckpt.turn
+        return self._load_input(), 0
+
+    def _load_input(self) -> np.ndarray:
+        """Read + validate the input PGM (multi-host controllers negotiate
+        resume separately and call this directly)."""
+        p = self.params
         board_np = pgm.read_pgm(p.input_path)
         if board_np.shape != (p.image_height, p.image_width):
             raise ValueError(
                 f"{p.input_path} is {board_np.shape[1]}x{board_np.shape[0]}, "
                 f"params want {p.image_width}x{p.image_height}"
             )  # gol/io.go:105-112 panics on mismatch
-        return board_np, 0
+        return board_np
 
     def _finalize(self, board, turn: int):
         p = self.params
@@ -357,7 +371,7 @@ class Controller:
             self._emit(FinalTurnComplete(turn, AliveCells.from_board(final_np)))
             # Final PGM write, no ImageOutputComplete for it — matching the
             # reference (gol/distributor.go:246-253 emits no event).
-            pgm.write_pgm(p.out_dir / f"{p.final_output_name}.pgm", final_np)
+            self._write_pgm(p.out_dir / f"{p.final_output_name}.pgm", final_np)
             self._emit(StateChange(turn, State.QUITTING))
         else:
             # Detach/kill paths still emit a FinalTurnComplete with an empty

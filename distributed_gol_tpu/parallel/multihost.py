@@ -86,3 +86,166 @@ def fetch_global(arr: jax.Array) -> np.ndarray:
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
+# -- full controller runs across processes ------------------------------------
+#
+# The data plane above is enough for library users; ``run_distributed`` runs
+# the ENTIRE reference controller contract (events, s/p/q/k keys, snapshots,
+# checkpoints, final PGM) across processes.  The invariant that makes it
+# work: every process executes the identical dispatch schedule, so every
+# collective (superstep, count psum, snapshot allgather) lines up.  That
+# requires (a) an explicit fixed superstep — the adaptive wall-clock sizing
+# would diverge between hosts — and (b) identical control decisions, which
+# ``_BroadcastKeys`` provides by broadcasting process 0's keypress stream to
+# everyone at each poll (one tiny collective per poll; polls happen at
+# superstep boundaries).  Process 0 is the controller (reference analog: the
+# one machine running ``main.go``); followers feed a throwaway event queue
+# and skip file writes.
+
+
+class _BroadcastKeys:
+    """Queue facade making every process see process 0's keypresses.
+
+    Each ``get``/``empty`` call is one scalar broadcast, so ALL processes
+    must call them in the same order — guaranteed because the controller's
+    control flow is a pure function of what these calls return."""
+
+    def __init__(self, inner):
+        import queue as _queue
+
+        self._inner = inner  # the real queue on process 0, else None
+        self._queue_mod = _queue
+
+    def _bcast(self, value: int) -> int:
+        from jax.experimental import multihost_utils
+
+        return int(multihost_utils.broadcast_one_to_all(np.int32(value)))
+
+    def get(self, block=False, timeout=None):
+        code = 0
+        if self._inner is not None:
+            try:
+                code = ord(self._inner.get(block=block, timeout=timeout))
+            except self._queue_mod.Empty:
+                code = 0
+        code = self._bcast(code)
+        if code == 0:
+            raise self._queue_mod.Empty
+        return chr(code)
+
+    def empty(self) -> bool:
+        mine = 1 if self._inner is None or self._inner.empty() else 0
+        return bool(self._bcast(mine))
+
+
+def make_backend(params):
+    """A Backend whose board spans every process's devices (row bands) and
+    whose host transfers are collective allgathers."""
+    from distributed_gol_tpu.engine.backend import Backend
+
+    ny = len(jax.devices())
+    if params.mesh_shape not in ((1, 1), (ny, 1)):
+        raise ValueError(
+            f"multi-host runs shard rows over all {ny} global devices; "
+            f"mesh_shape must be ({ny}, 1) (or left at (1, 1) to default)"
+        )
+    from dataclasses import replace
+
+    params = replace(params, mesh_shape=(ny, 1))
+
+    class MultihostBackend(Backend):
+        def put(self, board):
+            board = np.ascontiguousarray(board, dtype=np.uint8)
+            return put_global(board, self._sharding)
+
+        def fetch(self, board):
+            return fetch_global(board)
+
+    return MultihostBackend(params, devices=jax.devices())
+
+
+def run_distributed(params, events=None, key_presses=None, session=None):
+    """The full controller contract over a process-spanning mesh.
+
+    Call on EVERY process after :func:`initialize`.  Process 0 drives:
+    its ``events`` queue receives the stream, its ``key_presses`` queue is
+    broadcast to all processes, its filesystem gets the PGMs, and its
+    ``session`` holds checkpoints.  Followers pass None everywhere: they
+    get throwaway in-memory sessions (a 'q' detach must persist exactly
+    one checkpoint, from process 0 — ``Session`` has no cross-process
+    write locking), and the resume decision is negotiated by process 0
+    and broadcast, because ``check_states`` is consume-once: letting every
+    process ask would hand the checkpoint to whichever asked first and
+    start the rest from turn 0, diverging the SPMD schedule.
+    ``params.superstep`` must be explicit (> 0): all processes must agree
+    on the dispatch schedule without exchanging wall-clock.
+    """
+    from jax.experimental import multihost_utils
+
+    from distributed_gol_tpu.engine.controller import Controller
+    from distributed_gol_tpu.engine.session import Session, default_session
+
+    if params.superstep <= 0:
+        raise ValueError(
+            "multi-host runs need an explicit superstep: the adaptive "
+            "dispatch sizing is wall-clock-driven and would diverge "
+            "between processes"
+        )
+    if not params.no_vis or params.wants_flips() or params.wants_frames():
+        raise ValueError("multi-host runs are headless (no_vis=True)")
+
+    main = jax.process_index() == 0
+    backend = make_backend(params)
+    session = (session if session is not None else default_session()) if main else Session()
+
+    # Resume negotiation: process 0 consumes the checkpoint (if any) and
+    # broadcasts the outcome, so every process starts from the same world
+    # and turn.  (With turns == 0 the reference skips negotiation.)
+    negotiated = None
+    if params.turns > 0:
+        ckpt = (
+            session.check_states(params.image_width, params.image_height)
+            if main
+            else None
+        )
+        found = int(
+            multihost_utils.broadcast_one_to_all(
+                np.int32(0 if ckpt is None else 1)
+            )
+        )
+        if found:
+            shape = (params.image_height, params.image_width)
+            world = np.asarray(
+                multihost_utils.broadcast_one_to_all(
+                    ckpt.world if main else np.zeros(shape, np.uint8)
+                )
+            )
+            turn = int(
+                multihost_utils.broadcast_one_to_all(
+                    np.int32(ckpt.turn if main else 0)
+                )
+            )
+            negotiated = (world, turn)
+
+    class _DevNull:
+        """Follower event sink: the stream only exists on process 0, and a
+        real queue would grow unboundedly over a long run."""
+
+        def put(self, _):
+            pass
+
+    ev = events if (main and events is not None) else _DevNull()
+    keys = _BroadcastKeys(key_presses if main else None)
+
+    class MultihostController(Controller):
+        def _write_pgm(self, path, board_np):
+            if main:
+                super()._write_pgm(path, board_np)
+
+        def _initial_world(self):
+            if negotiated is not None:
+                return negotiated
+            return self._load_input(), 0
+
+    MultihostController(params, ev, keys, session, backend).run()

@@ -33,6 +33,9 @@ def main():
         int(sys.argv[3]),
         sys.argv[4],
     )
+    mode = sys.argv[5] if len(sys.argv) > 5 else "dataplane"
+    if mode == "controller":
+        return controller_main(coordinator, nprocs, pid, okfile, sys.argv[6])
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -74,6 +77,133 @@ def main():
         f.write("ok")
     print(f"[{pid}] multihost 64x64x{turns} bit-identical over "
           f"{nprocs}-process (8,1) mesh", flush=True)
+
+
+def controller_main(coordinator, nprocs, pid, okfile, out_dir):
+    """Full ``run_distributed`` contract: 64²×100 with a snapshot keypress,
+    process 0 checks the stream + files against the reference goldens."""
+    import queue
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import distributed_gol_tpu as gol
+    from distributed_gol_tpu.parallel import multihost
+
+    multihost.initialize(coordinator, nprocs, pid)
+    # Per-process out dirs prove the file-write discipline: only process
+    # 0's directory may gain files.
+    my_out = os.path.join(out_dir, f"p{pid}")
+    os.makedirs(my_out, exist_ok=True)
+    params = gol.Params(
+        turns=100,
+        image_width=64,
+        image_height=64,
+        images_dir="/root/reference/images",
+        out_dir=my_out,
+        superstep=10,
+        ticker_period=60.0,
+    )
+    if pid == 0:
+        events: queue.Queue = queue.Queue()
+        keys: queue.Queue = queue.Queue()
+        keys.put("s")  # snapshot via the broadcast keypress path
+        seen = []
+
+        def pump():
+            while (e := events.get(timeout=120)) is not None:
+                seen.append(e)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        multihost.run_distributed(params, events, keys)
+        t.join(timeout=30)
+
+        finals = [e for e in seen if isinstance(e, gol.FinalTurnComplete)]
+        assert len(finals) == 1 and finals[0].completed_turns == 100, finals
+        snaps = [e for e in seen if isinstance(e, gol.ImageOutputComplete)]
+        assert snaps, "snapshot keypress never produced a file event"
+        assert os.path.exists(f"{my_out}/{snaps[0].filename}.pgm")
+        got = open(f"{my_out}/64x64x100.pgm", "rb").read()
+        want = open(
+            "/root/reference/check/images/64x64x100.pgm", "rb"
+        ).read()
+        assert got == want, "multi-host final PGM differs from golden"
+        tcs = [
+            e.completed_turns for e in seen if isinstance(e, gol.TurnComplete)
+        ]
+        assert tcs == list(range(1, 101))
+    else:
+        multihost.run_distributed(params)
+        assert not os.listdir(my_out), "follower wrote files"
+
+    # Phase 2+3: 'q'-detach mid-run (broadcast key), checkpoint on process
+    # 0's session only, then a fresh multi-host run resumes from the
+    # negotiated checkpoint and still lands exactly on the golden board.
+    from dataclasses import replace
+
+    from distributed_gol_tpu.engine.session import Session
+
+    long_params = replace(params, turns=10**6)
+    if pid == 0:
+        ses = Session(os.path.join(out_dir, "ckpt"))
+        events2: queue.Queue = queue.Queue()
+        keys2: queue.Queue = queue.Queue()
+        seen2 = []
+
+        def pump2():
+            sent = False
+            while (e := events2.get(timeout=120)) is not None:
+                seen2.append(e)
+                if (
+                    not sent
+                    and isinstance(e, gol.TurnComplete)
+                    and e.completed_turns >= 20
+                ):
+                    keys2.put("q")
+                    sent = True
+
+        t2 = threading.Thread(target=pump2, daemon=True)
+        t2.start()
+        multihost.run_distributed(long_params, events2, keys2, ses)
+        t2.join(timeout=30)
+        detach_turn = [
+            e for e in seen2 if isinstance(e, gol.FinalTurnComplete)
+        ][0].completed_turns
+        assert 20 <= detach_turn < 100, detach_turn
+
+        events3: queue.Queue = queue.Queue()
+        seen3 = []
+
+        def pump3():
+            while (e := events3.get(timeout=120)) is not None:
+                seen3.append(e)
+
+        t3 = threading.Thread(target=pump3, daemon=True)
+        t3.start()
+        multihost.run_distributed(replace(params, turns=100), events3, session=ses)
+        t3.join(timeout=30)
+        final3 = [e for e in seen3 if isinstance(e, gol.FinalTurnComplete)][0]
+        assert final3.completed_turns == 100
+        got = open(f"{my_out}/64x64x100.pgm", "rb").read()
+        assert got == want, "resumed multi-host final PGM differs from golden"
+        # Resume really started mid-run: TurnComplete events pick up at
+        # the turn right after the detach point.
+        first_tc = [
+            e.completed_turns for e in seen3 if isinstance(e, gol.TurnComplete)
+        ][0]
+        assert first_tc == detach_turn + 1, (first_tc, detach_turn)
+    else:
+        multihost.run_distributed(long_params)
+        multihost.run_distributed(replace(params, turns=100))
+
+    with open(okfile, "w") as f:
+        f.write("ok")
+    print(f"[{pid}] controller-mode multihost run ok (incl. detach+resume)",
+          flush=True)
 
 
 if __name__ == "__main__":

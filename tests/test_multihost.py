@@ -25,14 +25,14 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_mesh_bit_identical(tmp_path):
+def _launch_workers(tmp_path, mode, extra=()):
     nprocs = 2
     coordinator = f"127.0.0.1:{free_port()}"
     okfiles = [tmp_path / f"ok{i}" for i in range(nprocs)]
     procs = [
         subprocess.Popen(
             [sys.executable, str(WORKER), coordinator, str(nprocs), str(i),
-             str(okfiles[i])],
+             str(okfiles[i]), mode, *extra],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -51,3 +51,16 @@ def test_two_process_mesh_bit_identical(tmp_path):
     for i, p in enumerate(procs):
         assert p.returncode == 0, f"worker {i} failed:\n{outs[i][-3000:]}"
         assert okfiles[i].exists(), f"worker {i} produced no ok-file"
+
+
+def test_two_process_mesh_bit_identical(tmp_path):
+    _launch_workers(tmp_path, "dataplane")
+
+
+def test_two_process_full_controller_run(tmp_path):
+    """The whole reference contract across processes: run_distributed on a
+    2-process mesh — event stream, broadcast snapshot keypress, file-write
+    discipline, golden final PGM (see multihost_worker.controller_main)."""
+    out = tmp_path / "out"
+    out.mkdir()
+    _launch_workers(tmp_path, "controller", extra=(str(out),))
