@@ -74,6 +74,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--skip-stable", action="store_true",
                     help="activity-adaptive pallas-packed kernel: period-6-"
                          "stable tiles (ash) skip their generations, exactly")
+    # Multi-host: launch the same command on every host (the reference's
+    # hand-launched broker/worker fleet, broker/broker.go:191-205); process
+    # 0 is the controller, the rest are followers.
+    ap.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                    help="multi-host run: distributed coordinator address")
+    ap.add_argument("--num-processes", type=int, default=1)
+    ap.add_argument("--process-id", type=int, default=0)
     return ap
 
 
@@ -117,6 +124,20 @@ def main(argv=None) -> int:
         Session(args.checkpoint_dir) if args.checkpoint_dir else default_session()
     )
 
+    if args.coordinator is not None:
+        return run_multihost(args, params, session)
+
+    return _drive(
+        args,
+        params,
+        lambda events, keys: start(params, events, keys, session),
+    )
+
+
+def _drive(args, params, start_engine) -> int:
+    """The controller-process tail shared by single-host and multi-host
+    entries: keyboard listener, viewer/drain loop, Ctrl-C → graceful 'q'
+    detach, optional profiler trace, final print + exit code."""
     events: queue.Queue = queue.Queue()
     key_presses: queue.Queue = queue.Queue()
     stop = threading.Event()
@@ -128,7 +149,7 @@ def main(argv=None) -> int:
 
     tracer = trace(args.trace) if args.trace else contextlib.nullcontext()
     with tracer:
-        engine_thread = start(params, events, key_presses, session)
+        engine_thread = start_engine(events, key_presses)
         try:
             if params.no_vis:
                 final = run_headless(params, events)
@@ -149,6 +170,38 @@ def main(argv=None) -> int:
         return 1
     print(f"Final turn {final.completed_turns}: {len(final.alive)} alive")
     return 0
+
+
+def run_multihost(args, params, session) -> int:
+    """Multi-host entry: same CLI on every host, ``--process-id`` 0 drives.
+
+    Headless with an explicit --superstep (run_distributed's contract);
+    process 0 keeps the interactive keyboard (s/p/q/k broadcast to all)."""
+    from distributed_gol_tpu.parallel import multihost
+
+    if not params.no_vis:
+        print("error: multi-host runs are headless; pass -noVis",
+              file=sys.stderr)
+        return 2
+    if params.superstep <= 0:
+        print("error: multi-host runs need an explicit --superstep",
+              file=sys.stderr)
+        return 2
+    multihost.initialize(args.coordinator, args.num_processes, args.process_id)
+    if args.process_id != 0:
+        multihost.run_distributed(params)
+        return 0
+
+    def start_engine(events, keys):
+        t = threading.Thread(
+            target=multihost.run_distributed,
+            args=(params, events, keys, session),
+            daemon=True,
+        )
+        t.start()
+        return t
+
+    return _drive(args, params, start_engine)
 
 
 if __name__ == "__main__":

@@ -181,11 +181,6 @@ def run_distributed(params, events=None, key_presses=None, session=None):
     ``params.superstep`` must be explicit (> 0): all processes must agree
     on the dispatch schedule without exchanging wall-clock.
     """
-    from jax.experimental import multihost_utils
-
-    from distributed_gol_tpu.engine.controller import Controller
-    from distributed_gol_tpu.engine.session import Session, default_session
-
     if params.superstep <= 0:
         raise ValueError(
             "multi-host runs need an explicit superstep: the adaptive "
@@ -194,6 +189,23 @@ def run_distributed(params, events=None, key_presses=None, session=None):
         )
     if not params.no_vis or params.wants_flips() or params.wants_frames():
         raise ValueError("multi-host runs are headless (no_vis=True)")
+
+    try:
+        return _run_distributed(params, events, key_presses, session)
+    except BaseException:
+        # The controller guarantees the stream sentinel for failures inside
+        # its run; failures BEFORE it starts (backend construction, resume
+        # negotiation) must not leave a listener blocked forever.
+        if events is not None:
+            events.put(None)
+        raise
+
+
+def _run_distributed(params, events, key_presses, session):
+    from jax.experimental import multihost_utils
+
+    from distributed_gol_tpu.engine.controller import Controller
+    from distributed_gol_tpu.engine.session import Session, default_session
 
     main = jax.process_index() == 0
     backend = make_backend(params)

@@ -64,3 +64,48 @@ def test_two_process_full_controller_run(tmp_path):
     out = tmp_path / "out"
     out.mkdir()
     _launch_workers(tmp_path, "controller", extra=(str(out),))
+
+
+def test_cli_multihost_run(tmp_path):
+    """The CLI's multi-host mode: the same command on two 'hosts'
+    (--process-id 0/1), golden-checked output from process 0."""
+    coordinator = f"127.0.0.1:{free_port()}"
+    outs = [tmp_path / f"out{i}" for i in range(2)]
+    for o in outs:
+        o.mkdir()
+    env = dict(__import__("os").environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "distributed_gol_tpu",
+             "-w", "64", "-h", "64", "-turns", "100", "-noVis",
+             "--superstep", "10",
+             "--images-dir", "/root/reference/images",
+             "--out-dir", str(outs[i]),
+             "--coordinator", coordinator,
+             "--num-processes", "2", "--process-id", str(i)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd="/root/repo",
+        )
+        for i in range(2)
+    ]
+    outs_txt = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("CLI multihost run timed out")
+        outs_txt.append(out)
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, f"process {i} failed:\n{outs_txt[i][-3000:]}"
+    assert "Final turn 100" in outs_txt[0]
+    got = (outs[0] / "64x64x100.pgm").read_bytes()
+    want = open("/root/reference/check/images/64x64x100.pgm", "rb").read()
+    assert got == want
+    assert not list(outs[1].iterdir()), "follower wrote files"
