@@ -18,9 +18,12 @@ This module only owns the mesh/runtime plumbing — the engine programs are
 deliberately unaware they span hosts.
 
 Hermetic proof: ``tests/test_multihost.py`` launches two OS processes with
-four virtual CPU devices each, builds the (8, 1) global mesh, and checks
-the sharded run is bit-identical to the single-process engine — the same
-oracle discipline as every other tier.
+four virtual CPU devices each and checks (1) the data plane is
+bit-identical to the single-process engine over the (8, 1) global mesh,
+(2) a full ``run_distributed`` controller run — broadcast snapshot
+keypress, file-write discipline, mid-run detach + negotiated resume —
+lands exactly on the reference's golden board, and (3) the CLI multi-host
+mode does the same.  The same oracle discipline as every other tier.
 """
 
 from __future__ import annotations
