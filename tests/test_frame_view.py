@@ -142,3 +142,37 @@ def test_viewer_renders_from_frames(tmp_path):
         assert idx_frame < idx_tc
     assert final is not None and final.completed_turns == params.turns
     assert out.getvalue()  # something was actually drawn
+
+
+def test_sharded_frame_view(tmp_path):
+    """Frames × sharding: the device-pooled viewer path over a mesh (the
+    pooling reduction compiles across shards; the fetched frame is the
+    same one a single-device run produces)."""
+    size = 2048
+    images = tmp_path / "images"
+    images.mkdir()
+    write_soup(images, size)
+    params = make_params(
+        tmp_path, images, size, turns=2, mesh_shape=(2, 4)
+    )
+    assert params.wants_frames()
+
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events)
+    seen = []
+    while (e := events.get(timeout=60)) is not None:
+        seen.append(e)
+    frames = [e for e in seen if isinstance(e, gol.FrameReady)]
+    assert len(frames) == params.turns + 1
+
+    single = make_params(tmp_path / "s", images, size, turns=2)
+    (tmp_path / "s").mkdir(exist_ok=True)
+    ev2: queue.Queue = queue.Queue()
+    gol.run(single, ev2)
+    seen2 = []
+    while (e := ev2.get(timeout=60)) is not None:
+        seen2.append(e)
+    frames2 = [e for e in seen2 if isinstance(e, gol.FrameReady)]
+    assert len(frames2) == len(frames)
+    for a, b in zip(frames, frames2):
+        np.testing.assert_array_equal(np.asarray(a.frame), np.asarray(b.frame))
