@@ -31,8 +31,9 @@ def log(*a):
 
 
 def make_board(size: int, seed: int = 0) -> np.ndarray:
-    rng = np.random.default_rng(seed)
-    return np.where(rng.random((size, size)) < 0.3, 255, 0).astype(np.uint8)
+    from distributed_gol_tpu.utils.soup import random_soup
+
+    return random_soup(size, size, 0.3, seed)
 
 
 def _sync(board):

@@ -74,6 +74,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--skip-stable", action="store_true",
                     help="activity-adaptive pallas-packed kernel: period-6-"
                          "stable tiles (ash) skip their generations, exactly")
+    ap.add_argument("--soup", type=float, default=None, metavar="DENSITY",
+                    help="start from a seeded random soup of this density "
+                         "instead of images/WxH.pgm (huge boards need no "
+                         "input file)")
+    ap.add_argument("--soup-seed", type=int, default=0)
     # Multi-host: launch the same command on every host (the reference's
     # hand-launched broker/worker fleet, broker/broker.go:191-205); process
     # 0 is the controller, the rest are followers.
@@ -109,6 +114,8 @@ def params_from_args(args) -> Params:
         frame_max=(int(fh), int(fw)),
         max_dispatch_seconds=args.max_dispatch_seconds,
         skip_stable=args.skip_stable,
+        soup_density=args.soup,
+        soup_seed=args.soup_seed,
     )
 
 

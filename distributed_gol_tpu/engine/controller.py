@@ -351,9 +351,17 @@ class Controller:
         return self._load_input(), 0
 
     def _load_input(self) -> np.ndarray:
-        """Read + validate the input PGM (multi-host controllers negotiate
-        resume separately and call this directly)."""
+        """Read + validate the input PGM — or generate a random soup when
+        ``Params.soup_density`` is set (multi-host controllers negotiate
+        resume separately and call this directly; the seeded generator
+        makes every process produce the identical board)."""
         p = self.params
+        if p.soup_density is not None:
+            from distributed_gol_tpu.utils.soup import random_soup
+
+            return random_soup(
+                p.image_height, p.image_width, p.soup_density, p.soup_seed
+            )
         board_np = pgm.read_pgm(p.input_path)
         if board_np.shape != (p.image_height, p.image_width):
             raise ValueError(

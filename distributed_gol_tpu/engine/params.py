@@ -84,6 +84,13 @@ class Params:
     # (broker/broker.go:192).
     mesh_shape: tuple[int, int] = (1, 1)
 
+    # Input-source override: a random soup of this density instead of the
+    # ``images/WxH.pgm`` file (framework extension — the reference ships
+    # pre-made soups as PGMs, which stops being practical at 16384²+ where
+    # the input file alone is hundreds of MB).  None = read the PGM.
+    soup_density: float | None = None
+    soup_seed: int = 0
+
     # --- filesystem conventions (gol/io.go:46,96: images/ in, out/ out) ---
     images_dir: Path = field(default=Path("images"))
     out_dir: Path = field(default=Path("out"))
@@ -109,6 +116,8 @@ class Params:
             raise ValueError("ticker_period must be positive")
         if self.max_dispatch_seconds <= 0:
             raise ValueError("max_dispatch_seconds must be positive")
+        if self.soup_density is not None and not 0.0 < self.soup_density < 1.0:
+            raise ValueError("soup_density must be in (0, 1)")
         # Paths may arrive as strings from CLI/config files.
         object.__setattr__(self, "images_dir", Path(self.images_dir))
         object.__setattr__(self, "out_dir", Path(self.out_dir))
