@@ -78,7 +78,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="start from a seeded random soup of this density "
                          "instead of images/WxH.pgm (huge boards need no "
                          "input file)")
-    ap.add_argument("--soup-seed", type=int, default=0)
+    ap.add_argument("--soup-seed", type=int, default=0,
+                    help="RNG seed for --soup (multi-host runs must pass "
+                         "the same seed on every process)")
     # Multi-host: launch the same command on every host (the reference's
     # hand-launched broker/worker fleet, broker/broker.go:191-205); process
     # 0 is the controller, the rest are followers.

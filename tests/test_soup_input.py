@@ -16,14 +16,13 @@ import distributed_gol_tpu as gol
 
 
 def run_final(tmp_path, **kw):
+    defaults = dict(image_width=64, image_height=64, engine="roll")
+    defaults.update(kw)
     params = gol.Params(
         turns=30,
-        image_width=64,
-        image_height=64,
         out_dir=tmp_path,
         images_dir=tmp_path / "no-images-dir-needed",
-        engine="roll",
-        **kw,
+        **defaults,
     )
     events: queue.Queue = queue.Queue()
     gol.run(params, events)
@@ -77,3 +76,21 @@ def test_soup_generator_chunking_is_transparent():
     np.testing.assert_array_equal(full, chunked)
     density = np.count_nonzero(full) / full.size
     assert 0.25 < density < 0.35
+
+
+def test_rectangular_board_cross_engine(tmp_path):
+    """Non-square boards through the full run path: engines agree (the
+    oracle set is square-only, so this is the cross-engine gate)."""
+    finals = {}
+    for engine in ("roll", "packed"):
+        f = run_final(
+            tmp_path,
+            soup_density=0.3,
+            soup_seed=11,
+            image_width=96,
+            image_height=40,
+            engine=engine,
+        )
+        finals[engine] = sorted(f.alive)
+    assert finals["roll"] == finals["packed"]
+    assert finals["roll"]  # something survived 30 turns
