@@ -205,20 +205,26 @@ class Controller:
             try:
                 return step()
             except Exception as e2:
-                checkpointed = False
                 try:
-                    self.session.pause(
-                        True, world=self.backend.fetch(board), turn=turn
-                    )
-                    checkpointed = True
+                    checkpointed = self._park_checkpoint(board, turn)
                 except Exception:  # device wedged: board unfetchable
-                    pass
+                    checkpointed = False
                 self._emit(
                     DispatchError(
                         turn, error=str(e2), checkpointed=checkpointed
                     )
                 )
                 raise
+
+    def _park_checkpoint(self, board, turn: int) -> bool:
+        """Park the last good board as a paused checkpoint after a terminal
+        dispatch failure.  A seam, not just a helper: on a multi-host run the
+        ``fetch`` below is a collective allgather, and after a one-sided
+        failure the peer processes are not guaranteed to enter it — so the
+        multi-host controller overrides this to skip checkpointing rather
+        than hang alone in a collective (advisor finding, round 2)."""
+        self.session.pause(True, world=self.backend.fetch(board), turn=turn)
+        return True
 
     # -- the run (distributor, gol/distributor.go:194-262) ---------------------
     def run(self):
@@ -243,9 +249,10 @@ class Controller:
         # Adaptive dispatch (superstep=0, headless): grow the dispatch size
         # until one dispatch takes ~max_dispatch_seconds, so deep temporal
         # blocking amortises without unbounded keypress latency (VERDICT
-        # weak-6; SURVEY §7 hard part 3).  Powers of two bound the number
-        # of distinct jit specialisations; _ADAPT_CAP bounds the per-turn
-        # event flood of one dispatch.
+        # weak-6; SURVEY §7 hard part 3).  Doubling keeps the number of
+        # distinct jit specialisations logarithmic (sizes 50·2^n plus at
+        # most one tail remainder k < superstep per distinct k); _ADAPT_CAP
+        # bounds the per-turn event flood of one dispatch.
         adaptive = (
             p.superstep == 0
             and p.no_vis

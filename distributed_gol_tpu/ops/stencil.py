@@ -97,11 +97,15 @@ def frame_pool(board: jax.Array, fy: int, fx: int) -> jax.Array:
     viewer is 268 MB/turn of host↔device traffic; the viewer only renders a
     terminal-sized view anyway (``viewer/render.py``), so the pooling runs
     on device and only the pooled frame (≤ a few hundred KB) crosses to the
-    host.  Exact crop to a multiple of the factor, matching the host-side
-    ``viewer.render.downsample`` so frames and shadow boards agree."""
+    host.  Boards whose size is not a multiple of the factor are zero-padded
+    (dead cells) up to one, so trailing rows/columns of live cells still
+    light their tile — matching the host-side ``viewer.render.downsample``
+    so frames and shadow boards agree."""
     h, w = board.shape
-    ch, cw = h // fy * fy, w // fx * fx
-    return board[:ch, :cw].reshape(ch // fy, fy, cw // fx, fx).max(axis=(1, 3))
+    ph, pw = -(-h // fy) * fy, -(-w // fx) * fx
+    if (ph, pw) != (h, w):
+        board = jnp.pad(board, ((0, ph - h), (0, pw - w)))
+    return board.reshape(ph // fy, fy, pw // fx, fx).max(axis=(1, 3))
 
 
 @jax.jit

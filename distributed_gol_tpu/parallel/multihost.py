@@ -258,6 +258,15 @@ def _run_distributed(params, events, key_presses, session):
             if main:
                 super()._write_pgm(path, board_np)
 
+        def _park_checkpoint(self, board, turn):
+            # The base-class checkpoint fetch is a collective allgather; a
+            # dispatch failure may be one-sided (one process's runtime
+            # dies), and entering a collective alone hangs this process
+            # instead of aborting with the sentinel.  Skip checkpointing:
+            # the terminal DispatchError still reports checkpointed=False
+            # and the stream still ends.
+            return False
+
         def _initial_world(self):
             if negotiated is not None:
                 return negotiated

@@ -21,14 +21,17 @@ HALF = "▀"  # upper half block: fg = top row, bg = bottom row
 
 
 def downsample(board: np.ndarray, max_h: int, max_w: int) -> np.ndarray:
-    """Max-pool to fit (max_h, max_w); exact crop to a multiple of the
-    factor keeps shapes static."""
+    """Max-pool to fit (max_h, max_w); sizes not divisible by the factor are
+    zero-padded (dead cells) up to a multiple, so trailing rows/columns of
+    live cells still light their tile — matching the device-side
+    ``ops.stencil.frame_pool``."""
     h, w = board.shape
     fy = max(1, -(-h // max_h))
     fx = max(1, -(-w // max_w))
-    ch, cw = h // fy * fy, w // fx * fx
-    pooled = board[:ch, :cw].reshape(ch // fy, fy, cw // fx, fx).max(axis=(1, 3))
-    return pooled
+    ph, pw = -(-h // fy) * fy, -(-w // fx) * fx
+    if (ph, pw) != (h, w):
+        board = np.pad(board, ((0, ph - h), (0, pw - w)))
+    return board.reshape(ph // fy, fy, pw // fx, fx).max(axis=(1, 3))
 
 
 def render(board: np.ndarray, term_size: tuple[int, int] | None = None) -> str:

@@ -62,6 +62,16 @@ class TestRenderer:
         assert small.shape == (2, 2)
         assert small[0, 0] == 255 and small[1, 1] == 0
 
+    def test_downsample_keeps_trailing_cells(self):
+        # Sizes not divisible by the factor are padded, not cropped: a live
+        # cell in the last row/column must still light its tile (advisor
+        # finding r2: the crop silently dropped it from every frame).
+        b = np.zeros((10, 10), np.uint8)
+        b[9, 9] = 255
+        small = R.downsample(b, 4, 4)  # factor 3 -> padded to 12x12
+        assert small.shape == (4, 4)
+        assert small[3, 3] == 255
+
     def test_render_smoke(self):
         b = np.zeros((4, 4), np.uint8)
         b[0, 1] = 255

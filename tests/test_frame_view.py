@@ -59,6 +59,20 @@ def test_mode_selection():
     assert not headless.wants_flips() and not headless.wants_frames()
 
 
+def test_frame_pool_keeps_trailing_cells():
+    """Non-divisible board sizes are zero-padded, not cropped: live cells in
+    the trailing rows/cols appear in the frame (advisor finding r2), and the
+    device pool agrees with the host-side viewer downsample."""
+    from distributed_gol_tpu.viewer import render as R
+
+    b = np.zeros((13, 10), np.uint8)
+    b[12, 9] = 255
+    pooled = np.asarray(stencil.frame_pool(b, 3, 3))
+    assert pooled.shape == (5, 4)
+    assert pooled[4, 3] == 255
+    np.testing.assert_array_equal(pooled, R.downsample(b, 5, 4))
+
+
 def test_4096_viewer_transfer_is_bounded(tmp_path):
     """The per-turn host transfer for a 4096² viewer turn is the pooled
     frame: ≤ frame_max cells (256 KB), not the 16 MB board."""
