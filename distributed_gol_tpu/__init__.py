@@ -36,6 +36,7 @@ from distributed_gol_tpu.engine.events import (
     State,
     StateChange,
     TurnComplete,
+    TurnsCompleted,
     TurnTiming,
 )
 from distributed_gol_tpu.engine.gol import run, start
@@ -54,6 +55,7 @@ __all__ = [
     "State",
     "StateChange",
     "TurnComplete",
+    "TurnsCompleted",
     "TurnTiming",
     "run",
     "start",

@@ -62,6 +62,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write a JAX profiler trace (Perfetto/TensorBoard) to DIR")
     ap.add_argument("--timing", action="store_true",
                     help="emit TurnTiming events (per-dispatch gens/sec)")
+    ap.add_argument("--turn-events", default="per-turn",
+                    choices=["per-turn", "batch"],
+                    help="TurnComplete telemetry: reference-exact per-turn "
+                         "events, or one TurnsCompleted(first, last) per "
+                         "dispatch (headless fast path)")
     ap.add_argument("--view-mode", default="auto",
                     choices=["auto", "flips", "frame"],
                     help="viewer feed: exact per-cell flips or device-pooled "
@@ -112,6 +117,7 @@ def params_from_args(args) -> Params:
         out_dir=args.out_dir,
         ticker_period=args.ticker,
         emit_timing=args.timing,
+        turn_events=args.turn_events,
         view_mode=args.view_mode,
         frame_max=(int(fh), int(fw)),
         max_dispatch_seconds=args.max_dispatch_seconds,

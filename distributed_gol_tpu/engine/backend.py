@@ -201,17 +201,32 @@ class Backend:
         return np.asarray(jax.device_get(board))
 
     # -- compute ---------------------------------------------------------------
+    def run_turns_async(
+        self, board: jax.Array, turns: int
+    ) -> tuple[jax.Array, jax.Array]:
+        """Issue ``turns`` generations WITHOUT waiting for them: returns
+        (board, count) where the count is an unresolved on-device scalar.
+        JAX dispatch is asynchronous, so the caller may issue the next
+        superstep before forcing this one's count — the controller's
+        pipelined dispatch path overlaps host work (event emission, key
+        polling) and the per-dispatch tunnel latency with device compute.
+        Failure-injection subclasses override THIS method (``run_turns``
+        delegates here), so both the sync and pipelined paths see it."""
+        if turns == 0:
+            return board, stencil.alive_count(board)
+        new_board = self._superstep(board, turns)
+        return new_board, stencil.alive_count(new_board)
+
     def run_turns(self, board: jax.Array, turns: int) -> tuple[jax.Array, int]:
         """Advance ``turns`` generations through the engine superstep;
-        returns (board, alive count after the last turn).  The count is one
-        on-device reduction of the final board — per-turn count *vectors*
-        exist at the ops layer (``steps_with_counts``) for telemetry soaks,
-        but the controller only ever latches the superstep-boundary count,
-        so the hot path runs the fastest engine, not the counting scan."""
-        if turns == 0:
-            return board, self.count(board)
-        new_board = self._superstep(board, turns)
-        return new_board, self.count(new_board)
+        returns (board, alive count after the last turn), synchronised.
+        The count is one on-device reduction of the final board — per-turn
+        count *vectors* exist at the ops layer (``steps_with_counts``) for
+        telemetry soaks, but the controller only ever latches the
+        superstep-boundary count, so the hot path runs the fastest engine,
+        not the counting scan."""
+        new_board, count = self.run_turns_async(board, turns)
+        return new_board, int(count)
 
     def run_turn_with_flips(
         self, board: jax.Array

@@ -58,6 +58,15 @@ class Params:
     # mostly ash; the Backend warns when that trade is being made.
     # Ignored by engines without an adaptive form.
     skip_stable: bool = False
+    # TurnComplete telemetry policy: "per-turn" (the reference contract —
+    # one TurnComplete per generation, ``gol/event.go:53-58`` — at one
+    # queue.put per turn) | "batch" (one TurnsCompleted(first, last) per
+    # device dispatch).  Per-turn puts bound a headless ``gol.run()`` at
+    # Python queue throughput (≲0.5M puts/s), far below the engine's own
+    # gens/s on small/mid boards; batch mode removes that bound while
+    # keeping exact turn accounting.  Viewer-fed runs (flips/frames) are
+    # per-turn by construction and ignore this knob.
+    turn_events: str = "per-turn"
     # CellFlipped emission policy: "auto" (per-cell when a viewer is attached
     # i.e. not no_vis, off headless), "cell" (always, reference contract),
     # "batch" (one CellsFlipped per turn), "off".  Any flip mode forces
@@ -104,6 +113,8 @@ class Params:
             raise ValueError(f"unknown engine {self.engine!r}")
         if self.flip_events not in ("auto", "cell", "batch", "off"):
             raise ValueError(f"unknown flip_events {self.flip_events!r}")
+        if self.turn_events not in ("per-turn", "batch"):
+            raise ValueError(f"unknown turn_events {self.turn_events!r}")
         if self.view_mode not in ("auto", "flips", "frame"):
             raise ValueError(f"unknown view_mode {self.view_mode!r}")
         fh, fw = self.frame_max

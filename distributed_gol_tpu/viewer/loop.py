@@ -25,6 +25,7 @@ from distributed_gol_tpu.engine.events import (
     FinalTurnComplete,
     FrameReady,
     TurnComplete,
+    TurnsCompleted,
 )
 from distributed_gol_tpu.engine.params import Params
 from distributed_gol_tpu.viewer import render as R
@@ -82,7 +83,10 @@ def run_terminal(
             # Large boards: the engine ships a device-pooled frame instead
             # of per-cell flips; render it directly (it IS the view).
             shadow = np.asarray(e.frame)
-        elif isinstance(e, TurnComplete):
+        elif isinstance(e, (TurnComplete, TurnsCompleted)):
+            # TurnsCompleted: batch telemetry (one event per dispatch);
+            # reachable here only with flip_events="off", where there is
+            # nothing to redraw but the turn counter should still tick.
             now = time.monotonic()
             if now - last_draw >= min_dt:
                 last_draw = now

@@ -20,19 +20,57 @@ from distributed_gol_tpu.engine.session import Session
 
 
 class FlakyBackend(Backend):
-    """Injects ``fail`` consecutive dispatch failures, then works."""
+    """Injects ``fail`` consecutive dispatch failures, then works.
+
+    Overrides ``run_turns_async`` — the seam both the pipelined headless
+    path and the sync ``run_turns`` retry path go through — so a failure
+    here surfaces at issue time, like a Python-level dispatch error."""
 
     def __init__(self, params, fail: int):
         super().__init__(params)
         self.failures_left = fail
         self.dispatches = 0
 
-    def run_turns(self, board, turns):
+    def run_turns_async(self, board, turns):
         self.dispatches += 1
         if self.failures_left:
             self.failures_left -= 1
             raise RuntimeError("injected device failure")
-        return super().run_turns(board, turns)
+        return super().run_turns_async(board, turns)
+
+
+class _PoisonCount:
+    """A device-count stand-in whose resolution fails — models a dispatch
+    that issues fine but whose computation dies on device (the async
+    failure mode: the error surfaces when the count is forced)."""
+
+    def __init__(self, real, poisoned: bool):
+        self._real = real
+        self._poisoned = poisoned
+
+    def __int__(self):
+        if self._poisoned:
+            raise RuntimeError("injected resolve-time failure")
+        return int(self._real)
+
+
+class ResolveFlakyBackend(Backend):
+    """Injects ``fail`` dispatches whose counts fail to RESOLVE (the board
+    result is also poisoned conceptually; the controller must discard any
+    dispatch speculatively issued on top of it)."""
+
+    def __init__(self, params, fail: int):
+        super().__init__(params)
+        self.failures_left = fail
+        self.dispatches = 0
+
+    def run_turns_async(self, board, turns):
+        self.dispatches += 1
+        new_board, count = super().run_turns_async(board, turns)
+        if self.failures_left:
+            self.failures_left -= 1
+            return new_board, _PoisonCount(count, True)
+        return new_board, count
 
 
 def make_params(tmp_path, input_images, **kw):
@@ -116,16 +154,66 @@ def test_double_failure_checkpoints_and_aborts(tmp_path, input_images):
     assert np.array_equal(ckpt.world, start)
 
 
+def test_resolve_time_failure_is_retried(tmp_path, input_images):
+    """A dispatch that issues fine but dies on device surfaces when its
+    count is forced; the pipelined controller must retry it AND discard
+    the dispatch it speculatively issued on the poisoned board."""
+    (tmp_path / "ref").mkdir()
+    params = make_params(tmp_path, input_images)
+    want = reference_final(params, tmp_path, input_images)
+
+    backend = ResolveFlakyBackend(params, fail=1)
+    session = Session()
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events, session=session, backend=backend)
+    stream = drain(events)
+
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert len(errors) == 1 and errors[0].will_retry
+    assert "resolve-time" in errors[0].error
+
+    final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)][0]
+    assert final.completed_turns == params.turns
+    assert sorted(final.alive) == sorted(want.alive)
+    # The TurnComplete stream stays dense despite the discarded
+    # speculative dispatch.
+    tc = [e.completed_turns for e in stream if isinstance(e, gol.TurnComplete)]
+    assert tc == list(range(1, params.turns + 1))
+    assert session.check_states(16, 16) is None
+
+
+def test_resolve_time_terminal_failure_checkpoints(tmp_path, input_images):
+    """fail=3: the first resolve fails, its speculative successor is
+    poisoned too (discarded), and the sync retry also fails -> park the
+    last good board, emit the terminal DispatchError, raise."""
+    params = make_params(tmp_path, input_images, superstep=4)
+    backend = ResolveFlakyBackend(params, fail=3)
+    session = Session()
+    events: queue.Queue = queue.Queue()
+
+    with pytest.raises(RuntimeError, match="resolve-time"):
+        gol.run(params, events, session=session, backend=backend)
+    stream = []
+    while (e := events.get(timeout=5)) is not None:
+        stream.append(e)
+
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert [e.will_retry for e in errors] == [True, False]
+    assert errors[1].checkpointed
+    ckpt = session.check_states(16, 16)
+    assert ckpt is not None and ckpt.turn == 0
+
+
 def test_failure_mid_run_checkpoints_last_good_turn(tmp_path, input_images):
     """Failures after progress park the *latest* completed board."""
     params = make_params(tmp_path, input_images, superstep=4, turns=20)
 
     class FailAfter(FlakyBackend):
-        def run_turns(self, board, turns):
+        def run_turns_async(self, board, turns):
             # Succeed twice (8 turns), then fail the rest of the run.
             if self.dispatches >= 2:
                 self.failures_left = 2
-            return super().run_turns(board, turns)
+            return super().run_turns_async(board, turns)
 
     backend = FailAfter(params, fail=0)
     session = Session()

@@ -18,9 +18,11 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from bench import (  # noqa: E402
     bench_config,
+    bench_controller_path,
     ensure_live_backend,
     log,
     pick_engine,
+    superstep_for,
     verify_engine,
 )
 
@@ -32,6 +34,14 @@ def main():
     ap.add_argument("--sizes", default="512,4096,16384")
     ap.add_argument("--reps", type=int, default=2)
     ap.add_argument("--kturns", type=int, default=0, help="0 = auto per size")
+    ap.add_argument(
+        "--paths",
+        action="store_true",
+        help="also measure the product surface: full gol.run() headless "
+        "(batch + per-turn telemetry) and the frame-viewer feed",
+    )
+    ap.add_argument("--path-budget", type=float, default=10.0,
+                    help="wall-clock seconds per controller-path row")
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -43,6 +53,7 @@ def main():
     sizes = [int(s) for s in args.sizes.split(",")]
 
     rows = []
+    engine_gps = {}
     for size in sizes:
         for engine in ENGINES:
             resolved = pick_engine(engine, size)
@@ -54,6 +65,7 @@ def main():
             gps, cups = bench_config(size, args.kturns or 256, engine, args.reps)
             ok = verify_engine(size, engine)
             rows.append((size, engine, gps, cups, ok))
+            engine_gps[size] = max(engine_gps.get(size, 0.0), gps)
 
     print("| Board | Engine | gens/s | cell-updates/s | bit-identical |")
     print("|---|---|---|---|---|")
@@ -62,6 +74,30 @@ def main():
             f"| {size}² | `{engine}` | {gps:,.0f} | {cups:.3e} | "
             f"{'n/a' if ok is None else ok} |"
         )
+
+    if not args.paths:
+        return
+    # Product-surface rows: what a library user gets from gol.run() with a
+    # live consumer, vs the bare-superstep engine numbers above (round-2
+    # verdict weak-1/task-8).  Explicit superstep ≈ 0.5 s of device time
+    # per dispatch (one compile, no adaptive ladder) for the headless
+    # rows; the viewer rows are per-turn by construction.
+    print()
+    print("| Board | Path | gens/s | vs engine |")
+    print("|---|---|---|---|")
+    for size in sizes:
+        best = engine_gps.get(size, 0.0)
+        ss = superstep_for(best) if best else 0
+        for label, kw in (
+            ("run() batch", dict(turn_events="batch", superstep=ss)),
+            ("run() per-turn", dict(turn_events="per-turn", superstep=ss)),
+            ("viewer frames", dict(view="frame")),
+        ):
+            gps, turns = bench_controller_path(
+                size, budget_seconds=args.path_budget, **kw
+            )
+            ratio = f"{gps / best:.0%}" if best else "n/a"
+            print(f"| {size}² | {label} | {gps:,.0f} | {ratio} |")
 
 
 if __name__ == "__main__":
