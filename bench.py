@@ -56,6 +56,7 @@ def bench_config(
     target_seconds: float = 0.7,
     skip_stable: bool = False,
     burnin: int = 0,
+    skip_tile_cap: int | None = None,
 ):
     """Time `reps` supersteps of `kturns` generations each; returns
     (gens_per_sec, cell_updates_per_sec).
@@ -98,17 +99,21 @@ def bench_config(
             log("  --skip-stable has no adaptive path for this shape "
                 "(VMEM-resident board); running the plain kernel")
             skip_stable = False
-        superstep = pallas_packed.make_superstep(CONWAY, skip_stable=skip_stable)
+        superstep = pallas_packed.make_superstep(
+            CONWAY, skip_stable=skip_stable, skip_tile_cap=skip_tile_cap
+        )
         if skip_stable:
-            log("  activity-adaptive: period-6-stable tiles skip their launch")
+            log("  activity-adaptive: period-6-stable tiles skip their "
+                "launch; stable neighbourhoods elide the probe")
         if pallas_packed.is_vmem_resident(board.shape) and not skip_stable:
             log("  VMEM-resident: whole superstep in one launch")
         elif skip_stable:
             # The adaptive plan is derived per dispatch depth inside
             # _run_tiled (and calibration may change that depth), so the
             # log names the contract, not a specific T.
+            cap = skip_tile_cap or pallas_packed._SKIP_TILE_CAP
             log("  temporal blocking (adaptive plan): period-6-multiple "
-                f"launches, tiles capped at {pallas_packed._SKIP_TILE_CAP} rows")
+                f"launches, tiles capped at {cap} rows")
         else:
             log(
                 "  temporal blocking: "
@@ -265,7 +270,11 @@ def bench_controller_path(
 
 
 def verify_engine(
-    size: int, engine: str, turns: int = 64, skip_stable: bool = False
+    size: int,
+    engine: str,
+    turns: int = 64,
+    skip_stable: bool = False,
+    skip_tile_cap: int | None = None,
 ) -> bool | None:
     """Hardware correctness record: run ``turns`` generations through the
     benched engine AND an independent reference engine *on the same device*,
@@ -329,9 +338,9 @@ def verify_engine(
     elif engine == "pallas-packed":
         from distributed_gol_tpu.ops import pallas_packed
 
-        got = pallas_packed.make_superstep_bytes(CONWAY, skip_stable=skip_stable)(
-            board, turns
-        )
+        got = pallas_packed.make_superstep_bytes(
+            CONWAY, skip_stable=skip_stable, skip_tile_cap=skip_tile_cap
+        )(board, turns)
         want = packed.make_superstep(CONWAY)(board, turns)
     else:
         raise ValueError(f"unknown engine {engine!r}")
@@ -451,6 +460,13 @@ def main():
         "benchmarks; pair with --skip-stable)",
     )
     ap.add_argument(
+        "--skip-tile-cap",
+        type=int,
+        default=0,
+        help="skip-tile granularity for --skip-stable, in rows (0 = the "
+        "measured-optimal 1024-row default)",
+    )
+    ap.add_argument(
         "--no-paths",
         action="store_true",
         help="skip the controller-path (full gol.run()) measurement",
@@ -491,9 +507,12 @@ def main():
         args.reps,
         skip_stable=skip_eff,
         burnin=args.burnin,
+        skip_tile_cap=args.skip_tile_cap or None,
     )
 
     variant = "-skip" if skip_eff else ""
+    if skip_eff and args.skip_tile_cap:
+        variant = f"-skip{args.skip_tile_cap}"
     burn = f"_burnin{args.burnin}" if args.burnin else ""
     record = {
         "metric": f"gol_gens_per_sec_{size}x{size}_{engine}{variant}{burn}_{dev.platform}",
@@ -513,7 +532,16 @@ def main():
         record["controller_path_gps"] = round(cp_gps, 2)
         record["controller_vs_engine"] = round(cp_gps / gps, 4) if gps else 0.0
     if not args.no_verify:
-        ok = verify_engine(size, engine, skip_stable=skip_eff)
+        ok = verify_engine(
+            size,
+            engine,
+            # Adaptive runs verify over enough turns for several launches,
+            # so the hardware record covers probe-pass, probe-fail AND the
+            # frontier elision of later launches.
+            turns=300 if skip_eff else 64,
+            skip_stable=skip_eff,
+            skip_tile_cap=args.skip_tile_cap or None,
+        )
         if ok is not None:
             record["bit_identical"] = ok
     print(json.dumps(record))

@@ -53,11 +53,10 @@ class Backend:
                 from distributed_gol_tpu.ops import pallas_packed
 
                 pshape = (shape[0], shape[1] // 32)
-                if (
-                    params.skip_stable
-                    and pallas_packed.is_vmem_resident(pshape)
-                    and pallas_packed.skip_stable_effective(pshape)
-                ):
+                skip_engages = params.skip_stable and (
+                    pallas_packed.skip_stable_effective(pshape)
+                )
+                if skip_engages and pallas_packed.is_vmem_resident(pshape):
                     # Dual-eligible board: honouring skip_stable means the
                     # tiled kernel, abandoning the (much faster when
                     # active) VMEM-resident path.  The user asked; warn so
@@ -70,9 +69,24 @@ class Backend:
                         "the board is mostly ash this is slower",
                         stacklevel=2,
                     )
-                self._superstep = pallas_packed.make_superstep_bytes(
-                    params.rule, skip_stable=params.skip_stable
-                )
+                if skip_engages:
+                    # Adaptive kernel with live skip telemetry; cap 0 =
+                    # the measured-optimal default (see _skip_superstep).
+                    self._skip_cap = (
+                        params.skip_tile_cap or pallas_packed._SKIP_TILE_CAP
+                    )
+                    self._skip_fn = pallas_packed.make_superstep_bytes(
+                        params.rule,
+                        skip_stable=True,
+                        skip_tile_cap=self._skip_cap,
+                        with_stats=True,
+                    )
+                    self._skip_stats = []
+                    self._superstep = self._skip_superstep
+                else:
+                    self._superstep = pallas_packed.make_superstep_bytes(
+                        params.rule, skip_stable=params.skip_stable
+                    )
             elif self.engine_used == "packed":
                 from distributed_gol_tpu.ops import packed
 
@@ -92,8 +106,14 @@ class Backend:
 
                 # T-deep halos: one ppermute exchange per launch buys T
                 # generations — the sharded form of temporal blocking.
+                # skip_tile_cap=0 (auto) falls back to the default cap:
+                # the stats/auto-tune loop is single-device-only for now
+                # (see pallas_halo.make_superstep).
                 self._superstep = pallas_halo.make_superstep_bytes(
-                    self.mesh, params.rule, skip_stable=params.skip_stable
+                    self.mesh,
+                    params.rule,
+                    skip_stable=params.skip_stable,
+                    skip_tile_cap=params.skip_tile_cap or None,
                 )
             elif self.engine_used == "packed":
                 from distributed_gol_tpu.parallel import packed_halo
@@ -104,6 +124,41 @@ class Backend:
             else:
                 _superstep = halo.sharded_superstep(self.mesh)
                 self._superstep = lambda b, k: _superstep(b, self.table, k)
+
+    def _skip_superstep(self, board, turns: int):
+        """The adaptive pallas-packed engine with live skip telemetry.
+
+        The cap policy is measurement, not tuning: across fresh, 30k-gen
+        and 400k-gen 16384² boards the 1024-row default dominates every
+        regime once frontier elision exists (77.1k vs 73.6k @ 512 vs
+        49.5k @ 2048 gens/s deep-settled — BASELINE.md round-3 update),
+        so ``skip_tile_cap == 0`` simply uses it; the knob remains for
+        explicit experiments.  What IS live is the skip fraction
+        (:meth:`skip_fraction`), the direct observability the round-2
+        verdict asked for."""
+        from distributed_gol_tpu.ops import pallas_packed
+
+        new_board, skipped = self._skip_fn(board, turns)
+        h, w = self.params.image_height, self.params.image_width
+        total = pallas_packed.adaptive_tile_launches(
+            (h, w // 32), turns, self._skip_cap
+        )
+        if total:
+            self._skip_stats.append((skipped, total))
+            del self._skip_stats[:-3]
+        return new_board
+
+    def skip_fraction(self) -> float | None:
+        """The most recent safely-resolved per-dispatch skip fraction (the
+        share of tile-launches that took the skip branch, elisions
+        included), or None before enough dispatches have run.  Only counts
+        ≥ 2 dispatches old are forced — the pipelined controller keeps at
+        most one dispatch in flight, so reading this never stalls it."""
+        stats = getattr(self, "_skip_stats", None)
+        if not stats or len(stats) < 3:
+            return None
+        skipped, total = stats[-3]
+        return int(skipped) / total
 
     @staticmethod
     def _packed_kernel_upgrade(params: Params, supports_fn) -> bool:

@@ -58,6 +58,14 @@ class Params:
     # mostly ash; the Backend warns when that trade is being made.
     # Ignored by engines without an adaptive form.
     skip_stable: bool = False
+    # Skip-tile granularity for the adaptive kernel, in rows (multiple of
+    # 8).  0 (default) = the measured-optimal 1024-row cap: with the
+    # round-3 frontier elision, 1024 dominates finer AND coarser caps in
+    # every measured regime (fresh, 30k-gen, 400k-gen 16384² boards —
+    # BASELINE.md).  The knob remains for explicit experiments; the live
+    # skip fraction is observable via ``Backend.skip_fraction()``.
+    # Ignored unless skip_stable engages the tiled adaptive kernel.
+    skip_tile_cap: int = 0
     # TurnComplete telemetry policy: "per-turn" (the reference contract —
     # one TurnComplete per generation, ``gol/event.go:53-58`` — at one
     # queue.put per turn) | "batch" (one TurnsCompleted(first, last) per
@@ -123,6 +131,10 @@ class Params:
         ny, nx = self.mesh_shape
         if ny < 1 or nx < 1:
             raise ValueError(f"mesh_shape must be positive, got {self.mesh_shape}")
+        if self.skip_tile_cap < 0 or self.skip_tile_cap % 8:
+            raise ValueError(
+                "skip_tile_cap must be 0 (auto) or a positive multiple of 8"
+            )
         if self.ticker_period <= 0:
             raise ValueError("ticker_period must be positive")
         if self.max_dispatch_seconds <= 0:

@@ -166,7 +166,11 @@ def _tile_for_pad(h: int, wp: int, pad: int, tile_cap: int | None = None) -> int
 
 # Tile-height cap for the adaptive (skip_stable) plan: 16384² gets 16
 # stripes instead of 4, so a roaming glider only un-skips 1/16 of the
-# board; costs ~9% halo redundancy vs ~3% for the plain plan.
+# board; costs ~9% halo redundancy vs ~3% for the plain plan.  This is
+# what `Params.skip_tile_cap == 0` resolves to — measured dominant over
+# both finer (512: more per-tile DMA launches) and coarser (2048: more
+# un-skipping around residual activity) caps in every regime once the
+# frontier elision exists (BASELINE.md round-3 cap table).
 _SKIP_TILE_CAP = 1024
 # Stability period the adaptive kernel proves per launch: 6 = lcm(2, 3)
 # covers still lifes + period-2 oscillators + pulsars (see _kernel).
@@ -318,6 +322,15 @@ def _advance_window(tile0, tile_h: int, pad: int, turns: int, rule, skip_stable)
     """
     if not skip_stable:
         return jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), tile0)
+    return _probe_window(tile0, tile_h, pad, turns, rule)[0]
+
+
+def _probe_window(tile0, tile_h: int, pad: int, turns: int, rule):
+    """The skip proof itself: advance the window p generations; if the
+    result equals gen 0 on the inner rows, the centre tile at gen ``turns``
+    is exactly the input (see ``_advance_window``).  Returns
+    (window at gen ``turns``, stable flag) — the flag feeds the next
+    launch's probe elision and the Backend's skip telemetry."""
     tp = jax.lax.fori_loop(0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), tile0)
     # Compare on rows [p, H_ext-p) via an iota mask — Mosaic has no
     # unaligned-slice lowering, and the mask is launch-overhead only.
@@ -325,13 +338,14 @@ def _advance_window(tile0, tile_h: int, pad: int, turns: int, rule, skip_stable)
     rows = jax.lax.broadcasted_iota(jnp.int32, (h_ext, tile0.shape[1]), 0)
     inner = (rows >= _SKIP_PERIOD) & (rows < h_ext - _SKIP_PERIOD)
     stable = jnp.all(jnp.where(inner, tp ^ tile0, jnp.uint32(0)) == 0)
-    return jax.lax.cond(
+    out = jax.lax.cond(
         stable,
         lambda: tile0,
         lambda: jax.lax.fori_loop(
             _SKIP_PERIOD, turns, lambda _, a: _gen(a, rule), tp
         ),
     )
+    return out, stable
 
 
 def _kernel(
@@ -365,10 +379,129 @@ def _kernel(
     o_ref[:] = out[pad : pad + tile_h, :]
 
 
+def _kernel_adaptive(
+    prev_ref, x_hbm, o_ref, st_ref, tile, sems, *, tile_h, pad, grid, turns, rule
+):
+    """The activity-adaptive launch with frontier-aware probe elision.
+
+    ``prev_ref`` (SMEM, int32[grid]) is the previous launch's skip bitmap:
+    1 for tiles whose skip branch ran.  If a tile AND both its
+    halo-source neighbours skipped, its window is bit-identical to the
+    one the previous launch's probe proved period-6-stable, so the probe
+    (6 generations + a full-window compare) is elided too — the tile
+    costs one centre-rows HBM round-trip and nothing else.  Soundness
+    argument: BASELINE.md "frontier-aware probe elision"; the bitmap is
+    valid only within one dispatch's identical-geometry launches, which
+    the caller (``_run_tiled``) guarantees by zero-initialising it."""
+    i = pl.program_id(0)
+    left = jax.lax.rem(i + grid - 1, grid)
+    right = jax.lax.rem(i + 1, grid)
+    elide = (prev_ref[left] + prev_ref[i] + prev_ref[right]) == 3
+
+    center = pltpu.make_async_copy(
+        x_hbm.at[pl.ds(i * tile_h, tile_h), :],
+        tile.at[pl.ds(pad, tile_h), :],
+        sems.at[0],
+    )
+    center.start()
+
+    @pl.when(jnp.logical_not(elide))
+    def _():
+        # Halo rows feed only the probe/compute path; an elided tile
+        # skips their DMA entirely (the scratch rows hold stale data the
+        # elided branch never reads).
+        top = left * tile_h + (tile_h - pad)
+        bot = right * tile_h
+        c1 = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(top, pad), :], tile.at[pl.ds(0, pad), :], sems.at[1]
+        )
+        c2 = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(bot, pad), :],
+            tile.at[pl.ds(pad + tile_h, pad), :],
+            sems.at[2],
+        )
+        c1.start()
+        c2.start()
+        c1.wait()
+        c2.wait()
+
+    center.wait()
+
+    def probe():
+        out, stable = _probe_window(tile[:], tile_h, pad, turns, rule)
+        return out[pad : pad + tile_h, :], stable.astype(jnp.int32)
+
+    out_center, stable = jax.lax.cond(
+        elide,
+        lambda: (tile[pl.ds(pad, tile_h), :], jnp.int32(1)),
+        probe,
+    )
+    o_ref[:] = out_center
+    st_ref[i] = stable
+
+
 def _use_interpret() -> bool:
     # The kernel uses pltpu primitives (pltpu.roll, make_async_copy) that
     # only lower on TPU; every other backend (cpu, gpu) runs interpret mode.
     return jax.default_backend() != "tpu"
+
+
+def _plan_tile(shape: tuple[int, int], turns: int, tile_cap: int | None) -> int:
+    """The tile height a launch of ``turns`` generations will use (shared
+    by the launch builders and the stats bookkeeping in ``_run_tiled``)."""
+    tile_h = _tile_for_pad(shape[0], shape[1], _round8(turns), tile_cap)
+    if tile_h is None:
+        raise ValueError(
+            f"no VMEM tiling for {turns} turns on {shape[0]}x{shape[1]}"
+        )
+    return tile_h
+
+
+@functools.lru_cache(maxsize=None)
+def _build_launch_adaptive(
+    shape: tuple[int, int],
+    rule: LifeRule,
+    turns: int,
+    interpret: bool,
+    tile_cap: int | None,
+):
+    """The adaptive launch as ``(prev_bitmap, board) -> (board, bitmap)``:
+    the probe kernel plus frontier-aware elision (``_kernel_adaptive``)."""
+    h, wp = shape
+    _require_adaptive_eligible(turns)
+    pad = _round8(turns)
+    tile_h = _plan_tile(shape, turns, tile_cap)
+    grid = h // tile_h
+    kernel = partial(
+        _kernel_adaptive,
+        tile_h=tile_h,
+        pad=pad,
+        grid=grid,
+        turns=turns,
+        rule=rule,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_h, wp), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h, wp), jnp.uint32),
+            jax.ShapeDtypeStruct((grid,), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        compiler_params=_compiler_params(tile_h, pad, wp, True),
+        interpret=interpret,
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -378,9 +511,14 @@ def _build_launch(
     turns: int,
     interpret: bool,
     skip_stable: bool = False,
+    tile_cap: int | None = None,
 ):
     """A pallas_call advancing a packed (H, wp) board ``turns`` generations
-    in one HBM pass (turns ≤ pad ≤ _MAX_T)."""
+    in one HBM pass (turns ≤ pad ≤ _MAX_T).  ``tile_cap`` must be passed
+    whenever the caller's skip_stable REQUEST is active — even for
+    launches that are not themselves adaptive-eligible — so planning
+    (``launch_turns``) and execution use the same tile set (round-2
+    advisor finding)."""
     h, wp = shape
     if not _tiled_supports(shape):
         raise ValueError(
@@ -390,7 +528,7 @@ def _build_launch(
     if skip_stable:
         _require_adaptive_eligible(turns)
     pad = _round8(turns)
-    tile_h = _tile_for_pad(h, wp, pad, _SKIP_TILE_CAP if skip_stable else None)
+    tile_h = _tile_for_pad(h, wp, pad, tile_cap)
     if tile_h is None:
         raise ValueError(f"no VMEM tiling for {turns} turns on {h}x{wp}")
     grid = h // tile_h
@@ -422,6 +560,8 @@ def make_superstep(
     rule: LifeRule = CONWAY,
     interpret: bool | None = None,
     skip_stable: bool = False,
+    skip_tile_cap: int | None = None,
+    with_stats: bool = False,
 ):
     """``(packed, turns) -> packed``: temporally-blocked supersteps.
 
@@ -432,29 +572,58 @@ def make_superstep(
     ``skip_stable`` enables the activity-adaptive kernel: tiles whose
     halo-extended window has period dividing ``_SKIP_PERIOD`` (6 — ash:
     still lifes, blinkers, pulsars) cost 6 generations + a compare
-    instead of T.  Bit-exact for every board (the skip criterion is a
+    instead of T, and tiles whose whole neighbourhood skipped the
+    previous launch elide even the probe (BASELINE.md soundness
+    argument).  Bit-exact for every board (the skip criterion is a
     proof, not a heuristic); pays off once a long run has settled into
     mostly-stable regions and costs a few % while everything is active.
+
+    ``skip_tile_cap`` bounds the adaptive tile height (None = the
+    balanced default ``_SKIP_TILE_CAP``); ``with_stats`` makes the
+    returned fn yield ``(board, skipped_tiles)`` — the Backend's cap
+    auto-tune signal.  The denominator (`adaptive_tile_launches`) is a
+    host-side computation so the caller never has to force a device
+    value just to know the launch count.
     """
+    cap = _SKIP_TILE_CAP if (skip_stable and skip_tile_cap is None) else skip_tile_cap
 
     @partial(jax.jit, static_argnames=("turns",))
-    def run(board: jax.Array, turns: int) -> jax.Array:
-        if turns == 0:
-            return board
+    def run(board: jax.Array, turns: int):
         ip = _use_interpret() if interpret is None else interpret
         shape = board.shape
         vshape = _vmem_resident_shape(*shape)
         # skip_stable lives in the tiled kernel; boards only the resident
         # path takes (wp not a lane multiple) keep their normal fast path.
-        if vshape is not None and not (skip_stable and _tiled_supports(shape)):
+        if turns and not (
+            vshape is not None and not (skip_stable and _tiled_supports(shape))
+        ):
+            return _run_tiled(board, rule, turns, ip, skip_stable, cap, with_stats)
+        if turns:
             # Small board: relayout to vertical packing (amortised over the
             # whole superstep) and run every generation in one launch.
             v = pack_vertical(unpack(board))
             v = _build_vmem_resident(vshape, rule, turns, ip)(v)
-            return pack(unpack_vertical(v))
-        return _run_tiled(board, rule, turns, ip, skip_stable)
+            board = pack(unpack_vertical(v))
+        return (board, jnp.int32(0)) if with_stats else board
 
     return run
+
+
+def adaptive_tile_launches(
+    shape: tuple[int, int], turns: int, tile_cap: int | None
+) -> int:
+    """How many tile-launches an adaptive dispatch of ``turns`` generations
+    on packed ``shape`` performs — the denominator for the skip fraction,
+    computed host-side from the same plan ``_run_tiled`` executes (the
+    remainder launch is excluded there and here)."""
+    if not _tiled_supports(shape):
+        return 0
+    t = launch_turns(shape, turns, tile_cap)
+    t, adaptive = skip_plan(t)
+    full, _ = divmod(turns, t)
+    if not adaptive or not full:
+        return 0
+    return full * (shape[0] // _plan_tile(shape, t, tile_cap))
 
 
 def _run_tiled(
@@ -463,19 +632,44 @@ def _run_tiled(
     turns: int,
     ip: bool,
     skip_stable: bool = False,
-) -> jax.Array:
+    tile_cap: int | None = None,
+    with_stats: bool = False,
+):
     shape = board.shape
-    t = launch_turns(shape, turns, _SKIP_TILE_CAP if skip_stable else None)
+    cap = tile_cap if skip_stable else None
+    t = launch_turns(shape, turns, cap)
     adaptive = False
     if skip_stable:
         t, adaptive = skip_plan(t)
     full, rem = divmod(turns, t)
-    call = _build_launch(shape, rule, t, ip, adaptive)
-    board = jax.lax.fori_loop(0, full, lambda _, b: call(b), board)
+    skipped = jnp.int32(0)
+    if adaptive and full:
+        # Frontier-aware elision: the skip bitmap is carried between the
+        # identical-geometry launches of THIS dispatch only (zeroed here),
+        # so the inheritance proof's same-plan requirement holds by
+        # construction; the first launch probes every tile.
+        call = _build_launch_adaptive(shape, rule, t, ip, cap)
+        grid = shape[0] // _plan_tile(shape, t, cap)
+
+        def body(_, carry):
+            b, st, sk = carry
+            nb, nst = call(st, b)
+            return nb, nst, sk + jnp.sum(nst)
+
+        board, _, skipped = jax.lax.fori_loop(
+            0, full, body, (board, jnp.zeros((grid,), jnp.int32), skipped)
+        )
+    elif full:
+        call = _build_launch(shape, rule, t, ip, False, cap)
+        board = jax.lax.fori_loop(0, full, lambda _, b: call(b), board)
     if rem:
+        # The remainder launch never consumes or produces the bitmap
+        # (different geometry; see the BASELINE.md scope restrictions).
         board = _build_launch(
-            shape, rule, rem, ip, skip_stable and _adaptive_eligible(rem)
+            shape, rule, rem, ip, skip_stable and _adaptive_eligible(rem), cap
         )(board)
+    if with_stats:
+        return board, skipped
     return board
 
 
@@ -483,23 +677,34 @@ def make_superstep_bytes(
     rule: LifeRule = CONWAY,
     interpret: bool | None = None,
     skip_stable: bool = False,
+    skip_tile_cap: int | None = None,
+    with_stats: bool = False,
 ):
     """``(board_u8, turns) -> board_u8`` engine-layer drop-in: one packing
     pass each way around the kernel — VMEM-resident boards go straight to
-    the vertical layout (no intermediate horizontal round trip)."""
+    the vertical layout (no intermediate horizontal round trip).  The
+    ``skip_tile_cap`` / ``with_stats`` knobs mirror ``make_superstep``."""
+    cap = _SKIP_TILE_CAP if (skip_stable and skip_tile_cap is None) else skip_tile_cap
 
     @partial(jax.jit, static_argnames=("turns",))
-    def run(board: jax.Array, turns: int) -> jax.Array:
-        if turns == 0:
-            return board
+    def run(board: jax.Array, turns: int):
         ip = _use_interpret() if interpret is None else interpret
         h, w = board.shape
         vshape = _vmem_resident_shape(h, w // 32)
-        if vshape is not None and not (
-            skip_stable and _tiled_supports((h, w // 32))
+        if turns and not (
+            vshape is not None
+            and not (skip_stable and _tiled_supports((h, w // 32)))
         ):
+            res = _run_tiled(
+                pack(board), rule, turns, ip, skip_stable, cap, with_stats
+            )
+            if with_stats:
+                b, sk = res
+                return unpack(b), sk
+            return unpack(res)
+        if turns:
             v = _build_vmem_resident(vshape, rule, turns, ip)(pack_vertical(board))
-            return unpack_vertical(v)
-        return unpack(_run_tiled(pack(board), rule, turns, ip, skip_stable))
+            board = unpack_vertical(v)
+        return (board, jnp.int32(0)) if with_stats else board
 
     return run

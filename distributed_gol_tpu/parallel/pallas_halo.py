@@ -103,16 +103,18 @@ def _build_ext_launch(
     turns: int,
     interpret: bool,
     skip_stable: bool = False,
+    tile_cap: int | None = None,
 ):
     """pallas_call advancing a halo-extended (h_loc + 2·pad, wp) strip by
-    ``turns`` ≤ pad generations, returning the (h_loc, wp) centre."""
+    ``turns`` ≤ pad generations, returning the (h_loc, wp) centre.
+    ``tile_cap`` must be passed whenever the caller's skip_stable request
+    is active — even for non-adaptive-eligible launches — so planning and
+    execution use the same tile set (round-2 advisor finding)."""
     h_loc, wp = strip
     if skip_stable:
         _require_adaptive_eligible(turns)
     pad = _round8(turns)
-    tile_h = _tile_for_pad(
-        h_loc, wp, pad, _SKIP_TILE_CAP if skip_stable else None
-    )
+    tile_h = _tile_for_pad(h_loc, wp, pad, tile_cap)
     if tile_h is None:
         raise ValueError(f"no VMEM tiling for {turns} turns on strip {strip}")
     grid = h_loc // tile_h
@@ -139,6 +141,37 @@ def _build_ext_launch(
     )
 
 
+def launch_plan(
+    pshape: tuple[int, int],
+    mesh_shape: tuple[int, int],
+    turns: int = 128,
+    skip_tile_cap: int | None = None,
+) -> dict:
+    """The static launch plan for a packed board on a row mesh, as data:
+    ``{t, pad, tile_h, grid, halo_bytes}`` where ``halo_bytes`` is the ICI
+    traffic per device per launch (pad rows each way).  This is what the
+    driver's ``dryrun_multichip`` prints per mesh, and what BASELINE.md's
+    multi-chip scaling model is computed from — one source of truth, so the
+    published model is machine-checked against the executing planner every
+    round."""
+    h, wp = pshape
+    ny, nx = mesh_shape
+    if not supports(pshape, mesh_shape):
+        raise ValueError(f"pallas_halo does not support {pshape} on {mesh_shape}")
+    strip = (h // ny, wp)
+    t = launch_turns(strip, turns, skip_tile_cap)
+    pad = _round8(t)
+    tile_h = _tile_for_pad(strip[0], wp, pad, skip_tile_cap)
+    return {
+        "t": t,
+        "pad": pad,
+        "tile_h": tile_h,
+        "grid": strip[0] // tile_h,
+        # 2 directions x pad rows x wp words x 4 bytes, per device per launch
+        "halo_bytes": 2 * pad * wp * 4,
+    }
+
+
 def _extend_rows(local: jax.Array, pad: int) -> jax.Array:
     """(h_loc, wp) strip -> (h_loc + 2·pad, wp) with pad boundary rows from
     the ring neighbours (self-send on a 1-sized axis = the torus wrap)."""
@@ -153,14 +186,21 @@ def make_superstep(
     rule: LifeRule = CONWAY,
     interpret: bool | None = None,
     skip_stable: bool = False,
+    skip_tile_cap: int | None = None,
 ):
     """``(packed, turns) -> packed`` on the mesh: turns split into launches
     of T = ``launch_turns(strip, turns)`` generations; each launch is one
     ppermute halo exchange + one pallas_call per device.
 
     ``skip_stable``: the exact period-6 activity skip of the single-device
-    kernel, per strip tile (see ``ops/pallas_packed.py``)."""
+    kernel, per strip tile (see ``ops/pallas_packed.py``);
+    ``skip_tile_cap`` bounds the adaptive tile height (None = the default
+    ``_SKIP_TILE_CAP``).  The single-device kernel's frontier-aware probe
+    elision and skip stats are not carried here yet: the bitmap would
+    need its edge flags ppermuted between neighbouring strips — a
+    documented follow-up, not a correctness gap (the probe always runs)."""
     ny = mesh.shape["y"]
+    cap = _SKIP_TILE_CAP if (skip_stable and skip_tile_cap is None) else skip_tile_cap
 
     @partial(jax.jit, static_argnames=("turns",))
     def run(board: jax.Array, turns: int) -> jax.Array:
@@ -170,7 +210,7 @@ def make_superstep(
         h, wp = board.shape
         strip = (h // ny, wp)
         t = launch_turns(
-            strip, turns, _SKIP_TILE_CAP if skip_stable else None
+            strip, turns, cap if skip_stable else None
         )  # clamps to _MAX_T internally
         if skip_stable:
             t, _ = skip_plan(t)
@@ -179,7 +219,9 @@ def make_superstep(
         def make_step(tt: int):
             adaptive = skip_stable and _adaptive_eligible(tt)
             pad = _round8(tt)
-            call = _build_ext_launch(strip, rule, tt, ip, adaptive)
+            call = _build_ext_launch(
+                strip, rule, tt, ip, adaptive, cap if skip_stable else None
+            )
 
             # check_vma=False: pallas_call outputs carry no varying-mesh-axes
             # annotation, which the vma checker (rightly) refuses to guess;
@@ -210,13 +252,14 @@ def make_superstep_bytes(
     rule: LifeRule = CONWAY,
     interpret: bool | None = None,
     skip_stable: bool = False,
+    skip_tile_cap: int | None = None,
 ):
     """``(board_u8, turns) -> board_u8`` engine-layer drop-in: pack/unpack
     inside the jit, pinned to the mesh sharding so packing stays local."""
     from distributed_gol_tpu.ops.packed import pack, unpack
     from distributed_gol_tpu.parallel.packed_halo import packed_sharding
 
-    inner = make_superstep(mesh, rule, interpret, skip_stable)
+    inner = make_superstep(mesh, rule, interpret, skip_stable, skip_tile_cap)
 
     @partial(jax.jit, static_argnames=("turns",))
     def run(board: jax.Array, turns: int) -> jax.Array:

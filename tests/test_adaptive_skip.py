@@ -156,6 +156,157 @@ def test_backend_level_skip_stable():
     np.testing.assert_array_equal(skip.fetch(got), roll.fetch(want))
 
 
+def run_both_capped(board_np: np.ndarray, turns: int, cap: int):
+    """Bit-identity with a small tile cap: forces a multi-tile grid at the
+    hermetic board size, so the frontier-aware probe elision actually has
+    neighbours to consult (the default plan would give one 64-row tile)."""
+    p = packed.pack(jnp.asarray(board_np))
+    got = pallas_packed.make_superstep(
+        CONWAY, interpret=True, skip_stable=True, skip_tile_cap=cap
+    )(p, turns)
+    want = packed.superstep(p, CONWAY, turns)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestFrontierElision:
+    """Multi-launch dispatches where later launches elide the probe for
+    all-stable neighbourhoods (BASELINE.md soundness argument).  cap=16
+    gives a 4-tile grid at H=64 with t = 6 per launch, so turns=36-96 run
+    6-16 identical-geometry launches with the bitmap carried between."""
+
+    def test_ash_multi_launch(self):
+        b = blank()
+        b[10:12, 100:102] = 255  # block in tile 0
+        b[40, 2000:2003] = 255  # blinker in tile 2
+        run_both_capped(b, 48, cap=16)
+
+    def test_glider_invades_elided_tiles(self):
+        """The adversarial case for elision: a glider starts in one tile
+        and crosses into tiles that were skipping (and eliding) — the
+        neighbour flag must un-elide them the launch the frontier
+        arrives."""
+        b = blank()
+        g = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], dtype=np.uint8) * 255
+        b[0:3, 50:53] = g  # glider headed down-right from tile 0
+        b[30:32, 60:62] = 255  # ash in its path (tile 1)
+        b[50, 64:67] = 255  # blinker further along (tile 3)
+        for turns in (36, 48, 96):
+            run_both_capped(b, turns, cap=16)
+
+    def test_seam_wrap_with_elision(self):
+        """Glider wrapping the torus seam while the interior tiles elide:
+        the cyclic neighbour indexing of the bitmap must wrap too."""
+        b = blank()
+        g = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], dtype=np.uint8) * 255
+        b[H - 3 :, 100:103] = g
+        b[24:26, 3000:3002] = 255  # mid-board ash, elidable
+        run_both_capped(b, 48, cap=16)
+
+    def test_soup_then_ash_transition(self):
+        """A band of soup that collapses while the rest is ash — skip
+        fractions move over launches, exercising both cond branches and
+        the elide/probe boundary repeatedly."""
+        rng = np.random.default_rng(4)
+        b = blank()
+        b[16:32] = np.where(rng.random((16, W)) < 0.3, 255, 0).astype(np.uint8)
+        b[50:52, 1000:1002] = 255
+        run_both_capped(b, 96, cap=16)
+
+
+class TestSkipTileCapKnob:
+    def test_params_validation(self):
+        from distributed_gol_tpu.engine.params import Params
+
+        with pytest.raises(ValueError, match="skip_tile_cap"):
+            Params(skip_tile_cap=12)
+        with pytest.raises(ValueError, match="skip_tile_cap"):
+            Params(skip_tile_cap=-8)
+        Params(skip_tile_cap=512)  # ok
+        Params(skip_tile_cap=0)  # ok: auto
+
+    def test_explicit_cap_changes_plan(self):
+        shape = (H, W // 32)
+        assert pallas_packed._plan_tile(shape, 12, 16) == 16
+        assert pallas_packed._plan_tile(shape, 12, None) == 64
+
+    def test_adaptive_tile_launches_matches_plan(self):
+        shape = (H, W // 32)
+        # cap=16: the cost model picks t=8, skip_plan rounds to t=6 ->
+        # turns=48 is 8 full launches over a 4-tile grid.
+        t, adaptive = pallas_packed.skip_plan(
+            pallas_packed.launch_turns(shape, 48, 16)
+        )
+        assert adaptive
+        grid = H // pallas_packed._plan_tile(shape, t, 16)
+        assert (
+            pallas_packed.adaptive_tile_launches(shape, 48, 16)
+            == (48 // t) * grid
+            == 32
+        )
+        # Non-tileable shape -> 0.
+        assert pallas_packed.adaptive_tile_launches((H, 100), 48, 16) == 0
+
+    def test_backend_auto_cap_and_skip_fraction(self):
+        """Auto cap (0) uses the measured-optimal default, and the live
+        skip fraction becomes observable (≈1.0 on an all-ash board) once
+        safely-resolved dispatches exist — never forcing in-flight work.
+        Results stay bit-identical throughout."""
+        from distributed_gol_tpu.engine.backend import Backend
+        from distributed_gol_tpu.engine.params import Params
+
+        params = Params(
+            engine="pallas-packed",
+            skip_stable=True,
+            image_width=W,
+            image_height=H,
+            turns=120,
+            superstep=24,
+        )
+        backend = Backend(params)
+        assert backend.engine_used == "pallas-packed"
+        assert backend._skip_cap == pallas_packed._SKIP_TILE_CAP
+        assert backend.skip_fraction() is None
+        b = blank()
+        b[10:12, 100:102] = 255
+        board = backend.put(b)
+        want = Backend(Params(engine="roll", image_width=W, image_height=H,
+                              turns=120, superstep=24))
+        wboard = want.put(b)
+        for _ in range(5):
+            board, count = backend.run_turns(board, 24)
+            wboard, wcount = want.run_turns(wboard, 24)
+            assert count == wcount
+        assert backend._skip_cap == pallas_packed._SKIP_TILE_CAP  # no tuning
+        assert backend.skip_fraction() == 1.0  # all-ash: everything skips
+        np.testing.assert_array_equal(backend.fetch(board), want.fetch(wboard))
+
+    def test_backend_explicit_cap(self):
+        from distributed_gol_tpu.engine.backend import Backend
+        from distributed_gol_tpu.engine.params import Params
+
+        backend = Backend(
+            Params(
+                engine="pallas-packed",
+                skip_stable=True,
+                skip_tile_cap=16,
+                image_width=W,
+                image_height=H,
+                turns=48,
+                superstep=48,
+            )
+        )
+        assert backend._skip_cap == 16
+        b = blank()
+        b[8, 64:67] = 255
+        board, _ = backend.run_turns(backend.put(b), 48)
+        assert backend._skip_cap == 16  # unchanged
+        p = packed.pack(jnp.asarray(b))
+        want = packed.superstep(p, CONWAY, 48)
+        np.testing.assert_array_equal(
+            backend.fetch(board), np.asarray(packed.unpack(want))
+        )
+
+
 def test_gosper_gun_unbounded_growth():
     """A glider gun (unbounded growth) — the adversarial case for any
     skipping scheme: the active region expands every generation and newly

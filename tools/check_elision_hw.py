@@ -1,0 +1,56 @@
+"""Hardware probe for the round-3 elision kernel: on the BENCH soup
+itself (seed 0, 0.3 density), compare the adaptive engine bit-for-bit
+against the plain packed engine over thousands of generations, and print
+the per-dispatch skip fraction — explaining (or refuting) the measured
+fresh-soup speedup."""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import jax.numpy as jnp
+
+from bench import make_board, _sync, log
+from distributed_gol_tpu.models.life import CONWAY
+from distributed_gol_tpu.ops import packed, pallas_packed
+
+
+def main(size=16384, dispatches=4, kturns=1008):
+    board = packed.pack(jnp.asarray(make_board(size)))
+    adaptive = pallas_packed.make_superstep(
+        CONWAY, skip_stable=True, with_stats=True
+    )
+    # NB: packed.superstep is the packed-in/packed-out reference;
+    # packed.make_superstep is the BYTES wrapper (an earlier revision of
+    # this checker fed it packed words and chased a phantom mismatch).
+    plain = lambda b, k: packed.superstep(b, CONWAY, k)
+    a, p = board, board
+    for i in range(dispatches):
+        t0 = time.perf_counter()
+        a, skipped = adaptive(a, kturns)
+        _sync(a)
+        dt = time.perf_counter() - t0
+        total = pallas_packed.adaptive_tile_launches(
+            a.shape, kturns, pallas_packed._SKIP_TILE_CAP
+        )
+        frac = int(skipped) / total if total else float("nan")
+        log(
+            f"dispatch {i}: {kturns} gens in {dt:.2f}s "
+            f"({kturns / dt:,.0f} gens/s), skip fraction {frac:.3f} "
+            f"({int(skipped)}/{total})"
+        )
+        p = plain(p, kturns)
+        same = bool(jnp.array_equal(a, p))
+        log(f"  bit-identical vs plain packed: {same}")
+        if not same:
+            diff = int(jnp.sum(a != p))
+            log(f"  DIFFERING WORDS: {diff}")
+            sys.exit(1)
+    log("OK")
+
+
+if __name__ == "__main__":
+    main(*(int(x) for x in sys.argv[1:]))
