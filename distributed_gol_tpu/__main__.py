@@ -67,6 +67,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="TurnComplete telemetry: reference-exact per-turn "
                          "events, or one TurnsCompleted(first, last) per "
                          "dispatch (headless fast path)")
+    ap.add_argument("--window", action="store_true",
+                    help="render in a pixel window (pygame) instead of the "
+                         "terminal — the reference's SDL window experience; "
+                         "needs a display (or SDL_VIDEODRIVER=dummy)")
     ap.add_argument("--view-mode", default="auto",
                     choices=["auto", "flips", "frame"],
                     help="viewer feed: exact per-cell flips or device-pooled "
@@ -79,6 +83,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--skip-stable", action="store_true",
                     help="activity-adaptive pallas-packed kernel: period-6-"
                          "stable tiles (ash) skip their generations, exactly")
+    ap.add_argument("--skip-tile-cap", type=int, default=0, metavar="ROWS",
+                    help="skip-tile granularity for --skip-stable (multiple "
+                         "of 8). 0 = the measured-optimal default (1024 "
+                         "rows, dominant in every measured regime)")
     ap.add_argument("--soup", type=float, default=None, metavar="DENSITY",
                     help="start from a seeded random soup of this density "
                          "instead of images/WxH.pgm (huge boards need no "
@@ -122,6 +130,7 @@ def params_from_args(args) -> Params:
         frame_max=(int(fh), int(fw)),
         max_dispatch_seconds=args.max_dispatch_seconds,
         skip_stable=args.skip_stable,
+        skip_tile_cap=args.skip_tile_cap,
         soup_density=args.soup,
         soup_seed=args.soup_seed,
     )
@@ -168,6 +177,10 @@ def _drive(args, params, start_engine) -> int:
         try:
             if params.no_vis:
                 final = run_headless(params, events)
+            elif getattr(args, "window", False):
+                from distributed_gol_tpu.viewer.window import run_window
+
+                final = run_window(params, events, key_presses)
             else:
                 final = run_terminal(params, events)
         except KeyboardInterrupt:

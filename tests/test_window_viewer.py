@@ -1,0 +1,146 @@
+"""Pixel-window (pygame) viewer: the SDL-window contract on the event
+stream (``sdl/window.go``, ``sdl/loop.go``), under SDL's dummy
+videodriver so it runs headless.
+
+Same discipline as ``tests/test_events_contract.py``: the window's pixel
+buffer is built ONLY from the event stream (initial + per-turn flips XOR,
+or FrameReady frames), and must agree with the engine's own final state.
+"""
+
+import os
+import queue
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("SDL_VIDEODRIVER", "dummy")
+
+pygame = pytest.importorskip("pygame")
+
+import distributed_gol_tpu as gol  # noqa: E402
+from distributed_gol_tpu.viewer.window import Window, run_window  # noqa: E402
+
+
+def make_params(tmp_path, input_images, **kw):
+    defaults = dict(
+        turns=20,
+        image_width=64,
+        image_height=64,
+        images_dir=input_images,
+        out_dir=tmp_path,
+        no_vis=False,
+        flip_events="cell",
+    )
+    defaults.update(kw)
+    return gol.Params(**defaults)
+
+
+class TestWindow:
+    def test_flip_pixel_xor_and_bounds(self):
+        w = Window(16, 8)
+        w.flip_pixel(3, 2)
+        assert w.count_pixels() == 1
+        w.flip_pixel(3, 2)
+        assert w.count_pixels() == 0
+        # Bounds panic parity (sdl/window.go:80-83).
+        with pytest.raises(IndexError):
+            w.flip_pixel(16, 0)
+        with pytest.raises(IndexError):
+            w.flip_pixel(0, 8)
+        with pytest.raises(IndexError):
+            w.flip_pixel(-1, 0)
+        w.clear_pixels()
+        assert w.count_pixels() == 0
+        w.render_frame()  # presents without error under the dummy driver
+        w.destroy()
+
+    def test_poll_keys_maps_spqk_and_quit(self):
+        w = Window(8, 8)
+        for key in (pygame.K_s, pygame.K_p, pygame.K_q, pygame.K_k,
+                    pygame.K_x):  # x: not a binding, must be ignored
+            pygame.event.post(pygame.event.Event(pygame.KEYDOWN, key=key))
+        pygame.event.post(pygame.event.Event(pygame.QUIT))
+        assert w.poll_keys() == ["s", "p", "q", "k", "q"]
+        w.destroy()
+
+
+def test_window_shadow_matches_final_board(tmp_path, input_images):
+    """Flip-fed window: after the run, the lit pixels are exactly the
+    final alive cells (the TestSdl shadow-board contract,
+    ``sdl_test.go:107-116``, on the pixel buffer)."""
+    params = make_params(tmp_path, input_images)
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events)
+
+    seen = {}
+
+    class SpyWindow(Window):
+        def render_frame(self):
+            super().render_frame()
+            seen["pixels"] = self._pixels.copy()
+
+    win = SpyWindow(params.image_width, params.image_height)
+    final = run_window(params, events, max_fps=1e9, window=win)
+    assert final is not None and final.completed_turns == params.turns
+
+    shadow = seen["pixels"]
+    want = np.zeros_like(shadow)
+    for c in final.alive:
+        want[c.y, c.x] = 0xFF
+    np.testing.assert_array_equal(shadow, want)
+
+
+def test_window_frame_mode(tmp_path, input_images):
+    """FrameReady-fed window (large-board path, forced small here): the
+    buffer is the device-pooled frame, not per-cell flips."""
+    params = make_params(
+        tmp_path,
+        input_images,
+        flip_events="auto",
+        view_mode="frame",
+        frame_max=(16, 16),
+        turns=3,
+    )
+    assert params.wants_frames()
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events)
+    win = Window(16, 16)
+    final = run_window(params, events, max_fps=1e9, window=win)
+    assert final is not None and final.completed_turns == 3
+    assert win._pixels.shape == (16, 16)
+
+
+def test_window_forwards_keys_to_engine(tmp_path, input_images):
+    """Keys pressed in the window reach the engine: a 'q' posted to the
+    OS queue detaches the run (FinalTurnComplete with empty alive)."""
+    import threading
+    import time
+
+    params = make_params(tmp_path, input_images, turns=10**9,
+                         turn_events="batch", flip_events="off")
+    events: queue.Queue = queue.Queue()
+    keys: queue.Queue = queue.Queue()
+    t = gol.start(params, events, keys)
+    pygame.display.init()  # ensure an event queue exists before posting
+
+    def press_q_later():
+        time.sleep(1.0)  # let some dispatches land first
+        pygame.event.post(pygame.event.Event(pygame.KEYDOWN, key=pygame.K_q))
+
+    threading.Thread(target=press_q_later, daemon=True).start()
+    final = run_window(params, events, keys, max_fps=1e9)
+    t.join(timeout=60)
+    assert final is not None and final.alive == ()
+    assert final.completed_turns > 0
+
+
+def test_cli_window_flag(tmp_path, input_images, capsys):
+    from distributed_gol_tpu.__main__ import main
+
+    rc = main(
+        ["-w", "16", "-h", "16", "-turns", "3", "--window",
+         "--images-dir", str(input_images), "--out-dir", str(tmp_path)]
+    )
+    assert rc == 0
+    assert (tmp_path / "16x16x3.pgm").exists()
+    assert "Final turn 3" in capsys.readouterr().out
