@@ -33,6 +33,7 @@ class Backend:
     def __init__(self, params: Params, devices=None):
         self.params = params
         self.table = jnp.asarray(params.rule.table)
+        self._viewer_fns = {}  # fused per-turn step+count+view dispatches
         shape = (params.image_height, params.image_width)
         ny, nx = params.mesh_shape
         if params.image_height % ny or params.image_width % nx:
@@ -266,7 +267,11 @@ class Backend:
         pipelined dispatch path overlaps host work (event emission, key
         polling) and the per-dispatch tunnel latency with device compute.
         Failure-injection subclasses override THIS method (``run_turns``
-        delegates here), so both the sync and pipelined paths see it."""
+        delegates here), so both the sync and pipelined HEADLESS paths
+        see it.  The per-turn viewer paths fuse step+count+view into one
+        dispatch and do NOT route through here — override
+        ``run_turn_with_flips`` / ``run_turn_with_frame`` to intercept
+        those."""
         if turns == 0:
             return board, stencil.alive_count(board)
         new_board = self._superstep(board, turns)
@@ -289,11 +294,24 @@ class Backend:
         """One generation, returning (board, alive count, flipped (y, x) index
         arrays).  The diff happens on device (``stencil.flip_mask``); only the
         boolean mask crosses to the host — replaces the reference's O(N²)
-        client-side diff loop (``gol/distributor.go:53-59``)."""
-        new_board, count = self.run_turns(board, 1)
-        mask = self.fetch(stencil.flip_mask(board, new_board))
+        client-side diff loop (``gol/distributor.go:53-59``).  Step, count,
+        and mask are ONE fused dispatch: per-turn paths pay per-dispatch
+        transfer latency (~19 ms on this rig's tunnel) per round-trip, so
+        splitting them caps the viewer fps at a fraction of what the device
+        can do."""
+        fn = self._viewer_fns.get("flips")
+        if fn is None:
+
+            @jax.jit
+            def fn(b):
+                nb = self._superstep(b, 1)
+                return nb, stencil.alive_count(nb), stencil.flip_mask(b, nb)
+
+            self._viewer_fns["flips"] = fn
+        new_board, count, mask = fn(board)
+        mask = self.fetch(mask)
         ys, xs = np.nonzero(mask)
-        return new_board, count, np.stack([ys, xs], axis=1)
+        return new_board, int(count), np.stack([ys, xs], axis=1)
 
     def run_turn_with_frame(
         self, board: jax.Array, fy: int, fx: int
@@ -301,10 +319,19 @@ class Backend:
         """One generation, returning (board, alive count, device-pooled
         frame).  The max-pool runs on device (``stencil.frame_pool``) so the
         host transfer is the pooled frame, not the board — the large-board
-        viewer path (SURVEY.md §7 hard part 4)."""
-        new_board, count = self.run_turns(board, 1)
-        frame = self.fetch(stencil.frame_pool(new_board, fy, fx))
-        return new_board, count, frame
+        viewer path (SURVEY.md §7 hard part 4).  Fused into one dispatch,
+        like the flips path."""
+        fn = self._viewer_fns.get(("frame", fy, fx))
+        if fn is None:
+
+            @jax.jit
+            def fn(b):
+                nb = self._superstep(b, 1)
+                return nb, stencil.alive_count(nb), stencil.frame_pool(nb, fy, fx)
+
+            self._viewer_fns[("frame", fy, fx)] = fn
+        new_board, count, frame = fn(board)
+        return new_board, int(count), self.fetch(frame)
 
     def count(self, board: jax.Array) -> int:
         return int(stencil.alive_count(board))

@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_gol_tpu.models.life import CONWAY, LifeRule
 from distributed_gol_tpu.ops.pallas_packed import (
@@ -50,6 +50,7 @@ from distributed_gol_tpu.ops.pallas_packed import (
     _adaptive_eligible,
     _advance_window,
     _compiler_params,
+    _probe_window,
     _require_adaptive_eligible,
     _round8,
     _tile_for_pad,
@@ -94,6 +95,112 @@ def _ext_kernel(
     # neighbour strips' boundary rows (ops/pallas_packed.py).
     out = _advance_window(tile[:], tile_h, pad, turns, rule, skip_stable)
     o_ref[:] = out[pad : pad + tile_h, :]
+
+
+def _ext_kernel_adaptive(
+    prev_ref, x_hbm, o_ref, st_ref, tile, sem, *, tile_h, pad, turns, rule
+):
+    """The adaptive launch on an extended strip, with frontier-aware probe
+    elision (BASELINE.md soundness argument, sharded form).
+
+    ``prev_ref`` (SMEM, int32[grid + 2]) is the previous launch's skip
+    bitmap EXTENDED with the neighbouring strips' edge-tile flags — the
+    flags ride the same ``ppermute`` exchange as the halo rows, so tile
+    i's window sources are exactly flags [i, i+1, i+2]: the north source
+    (neighbour strip's last tile for i == 0, else local tile i−1), the
+    tile itself, and the south source.  All three skipped ⇒ the window is
+    bit-identical to the one whose probe passed last launch ⇒ elide: copy
+    only the centre rows (no halo DMA, no compute)."""
+    i = pl.program_id(0)
+    elide = (prev_ref[i] + prev_ref[i + 1] + prev_ref[i + 2]) == 3
+
+    @pl.when(elide)
+    def _():
+        c = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(i * tile_h + pad, tile_h), :],
+            tile.at[pl.ds(pad, tile_h), :],
+            sem,
+        )
+        c.start()
+        c.wait()
+
+    @pl.when(jnp.logical_not(elide))
+    def _():
+        c = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(i * tile_h, tile_h + 2 * pad), :], tile.at[:], sem
+        )
+        c.start()
+        c.wait()
+
+    window = tile[:]
+
+    def probe():
+        out, stable = _probe_window(window, tile_h, pad, turns, rule)
+        return out[pad : pad + tile_h, :], stable.astype(jnp.int32)
+
+    out_center, stable = jax.lax.cond(
+        elide,
+        lambda: (window[pad : pad + tile_h, :], jnp.int32(1)),
+        probe,
+    )
+    o_ref[:] = out_center
+    st_ref[i] = stable
+
+
+def _strip_plan_tile(
+    strip: tuple[int, int], turns: int, tile_cap: int | None
+) -> int:
+    """The tile height an adaptive strip launch will use — the ONE plan
+    call shared by the launch builder and the bitmap-shape computation in
+    ``make_superstep``, so the SMEM bitmap length can never drift from the
+    kernel grid (mirrors ``pallas_packed._plan_tile``)."""
+    tile_h = _tile_for_pad(strip[0], strip[1], _round8(turns), tile_cap)
+    if tile_h is None:
+        raise ValueError(f"no VMEM tiling for {turns} turns on strip {strip}")
+    return tile_h
+
+
+@functools.lru_cache(maxsize=None)
+def _build_ext_launch_adaptive(
+    strip: tuple[int, int],
+    rule: LifeRule,
+    turns: int,
+    interpret: bool,
+    tile_cap: int | None,
+):
+    """The adaptive extended-strip launch as ``(prev_ext, ext_strip) ->
+    (centre, bitmap)`` with ``prev_ext`` int32[grid + 2] (neighbour edge
+    flags prepended/appended by the caller)."""
+    h_loc, wp = strip
+    _require_adaptive_eligible(turns)
+    pad = _round8(turns)
+    tile_h = _strip_plan_tile(strip, turns, tile_cap)
+    grid = h_loc // tile_h
+    kernel = partial(
+        _ext_kernel_adaptive, tile_h=tile_h, pad=pad, turns=turns, rule=rule
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_h, wp), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h_loc, wp), jnp.uint32),
+            jax.ShapeDtypeStruct((grid,), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        compiler_params=_compiler_params(tile_h, pad, wp, True),
+        interpret=interpret,
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -193,12 +300,14 @@ def make_superstep(
     ppermute halo exchange + one pallas_call per device.
 
     ``skip_stable``: the exact period-6 activity skip of the single-device
-    kernel, per strip tile (see ``ops/pallas_packed.py``);
+    kernel, per strip tile, INCLUDING its frontier-aware probe elision
+    (round 3): the per-tile skip bitmap's edge flags ride the same
+    ``ppermute`` exchange as the halo rows, so a tile whose window sources
+    — possibly in the neighbouring strip — all skipped last launch elides
+    the probe (soundness: BASELINE.md; the bitmap is scoped to one
+    dispatch's identical-geometry launches, zeroed at dispatch start).
     ``skip_tile_cap`` bounds the adaptive tile height (None = the default
-    ``_SKIP_TILE_CAP``).  The single-device kernel's frontier-aware probe
-    elision and skip stats are not carried here yet: the bitmap would
-    need its edge flags ppermuted between neighbouring strips — a
-    documented follow-up, not a correctness gap (the probe always runs)."""
+    ``_SKIP_TILE_CAP``)."""
     ny = mesh.shape["y"]
     cap = _SKIP_TILE_CAP if (skip_stable and skip_tile_cap is None) else skip_tile_cap
 
@@ -216,31 +325,73 @@ def make_superstep(
             t, _ = skip_plan(t)
         full, rem = divmod(turns, t)
 
-        def make_step(tt: int):
-            adaptive = skip_stable and _adaptive_eligible(tt)
+        def make_step(tt: int, adaptive_ok: bool = False):
+            adaptive = skip_stable and adaptive_ok and _adaptive_eligible(tt)
             pad = _round8(tt)
-            call = _build_ext_launch(
-                strip, rule, tt, ip, adaptive, cap if skip_stable else None
-            )
-
             # check_vma=False: pallas_call outputs carry no varying-mesh-axes
             # annotation, which the vma checker (rightly) refuses to guess;
             # the body is manifestly per-device (one kernel per strip).
+            if not adaptive:
+                call = _build_ext_launch(
+                    strip,
+                    rule,
+                    tt,
+                    ip,
+                    skip_stable and _adaptive_eligible(tt),
+                    cap if skip_stable else None,
+                )
+
+                @partial(
+                    jax.shard_map,
+                    mesh=mesh,
+                    in_specs=BOARD_SPEC,
+                    out_specs=BOARD_SPEC,
+                    check_vma=False,
+                )
+                def step(local):
+                    return call(_extend_rows(local, pad))
+
+                return step
+
+            call = _build_ext_launch_adaptive(strip, rule, tt, ip, cap)
+
             @partial(
                 jax.shard_map,
                 mesh=mesh,
-                in_specs=BOARD_SPEC,
-                out_specs=BOARD_SPEC,
+                in_specs=(BOARD_SPEC, P("y")),
+                out_specs=(BOARD_SPEC, P("y")),
                 check_vma=False,
             )
-            def step(local):
-                return call(_extend_rows(local, pad))
+            def step(local, st):
+                # Neighbour edge-tile flags, exchanged exactly like the
+                # halo rows (self-send on a 1-sized axis = torus wrap).
+                north_flag = lax.ppermute(
+                    st[-1:], "y", _shift_perm(ny, forward=True)
+                )
+                south_flag = lax.ppermute(
+                    st[:1], "y", _shift_perm(ny, forward=False)
+                )
+                prev_ext = jnp.concatenate([north_flag, st, south_flag])
+                return call(prev_ext, _extend_rows(local, pad))
 
             return step
 
-        step_t = make_step(t)
-        board = jax.lax.fori_loop(0, full, lambda _, b: step_t(b), board)
+        adaptive_t = skip_stable and _adaptive_eligible(t)
+        if adaptive_t and full:
+            grid = strip[0] // _strip_plan_tile(strip, t, cap)
+            step_t = make_step(t, adaptive_ok=True)
+            # Bitmap zeroed per dispatch: launch 1 probes every tile, so
+            # the inheritance proof's same-plan requirement holds.
+            st0 = jnp.zeros((ny * grid,), jnp.int32)
+            board, _ = jax.lax.fori_loop(
+                0, full, lambda _, c: step_t(*c), (board, st0)
+            )
+        elif full:
+            step_t = make_step(t)
+            board = jax.lax.fori_loop(0, full, lambda _, b: step_t(b), board)
         if rem:
+            # The remainder launch never consumes the bitmap (different
+            # geometry; BASELINE.md scope restrictions).
             board = make_step(rem)(board)
         return board
 

@@ -111,6 +111,38 @@ def test_non_period_multiple_launch_rejected(bad_turns):
         pallas_packed._build_launch((H, W // 32), CONWAY, bad_turns, True, True)
 
 
+def test_sharded_elision_multi_launch():
+    """Sharded frontier elision: multi-launch dispatches on row meshes
+    with a small cap (multi-tile strips), a glider crossing a STRIP
+    boundary while the rest elides, and ash near the mesh seam — the
+    edge-tile flags must travel with the ppermute or a stale elision
+    would corrupt the neighbour strip's first/last tile."""
+    import jax
+
+    from distributed_gol_tpu.parallel import packed_halo, pallas_halo
+    from distributed_gol_tpu.parallel.mesh import make_mesh
+
+    b = blank()
+    g = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], dtype=np.uint8) * 255
+    b[28:31, 50:53] = g  # glider heading down-right across the H/2 seam
+    b[10:12, 3000:3002] = 255  # ash in strip 0
+    b[50, 1000:1003] = 255  # blinker in strip 1 (for ny=2)
+    b[H - 2 :, 200:202] = 255  # ash at the wrap seam
+    p = packed.pack(jnp.asarray(b))
+    for ny in (2, 4):
+        for turns in (48, 96):
+            want = np.asarray(packed.superstep(p, CONWAY, turns))
+            mesh = make_mesh((ny, 1))
+            pb = jax.device_put(
+                np.asarray(p), packed_halo.packed_sharding(mesh)
+            )
+            got = pallas_halo.make_superstep(
+                mesh, CONWAY, interpret=True, skip_stable=True,
+                skip_tile_cap=16,
+            )(pb, turns)
+            np.testing.assert_array_equal(np.asarray(got), want)
+
+
 def test_sharded_adaptive_bit_identity():
     """The sharded form (pallas_halo + skip_stable) on a virtual row mesh:
     T-deep ppermute halos feed the same per-tile skip proof."""

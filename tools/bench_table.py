@@ -40,8 +40,10 @@ def main():
         help="also measure the product surface: full gol.run() headless "
         "(batch + per-turn telemetry) and the frame-viewer feed",
     )
-    ap.add_argument("--path-budget", type=float, default=10.0,
-                    help="wall-clock seconds per controller-path row")
+    ap.add_argument("--path-budget", type=float, default=0.0,
+                    help="wall-clock seconds per controller-path row "
+                    "(0 = auto: scales with board size so the jit compile "
+                    "— ~20-40 s at 16384² — fits inside the window)")
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -88,14 +90,15 @@ def main():
     for size in sizes:
         best = engine_gps.get(size, 0.0)
         ss = superstep_for(best) if best else 0
+        budget = args.path_budget or (
+            75.0 if size >= 16384 else 30.0 if size >= 4096 else 12.0
+        )
         for label, kw in (
             ("run() batch", dict(turn_events="batch", superstep=ss)),
             ("run() per-turn", dict(turn_events="per-turn", superstep=ss)),
             ("viewer frames", dict(view="frame")),
         ):
-            gps, turns = bench_controller_path(
-                size, budget_seconds=args.path_budget, **kw
-            )
+            gps, turns = bench_controller_path(size, budget_seconds=budget, **kw)
             ratio = f"{gps / best:.0%}" if best else "n/a"
             print(f"| {size}² | {label} | {gps:,.0f} | {ratio} |")
 
