@@ -190,6 +190,7 @@ def bench_controller_path(
     view: str | None = None,
     engine: str = "auto",
     superstep: int = 0,
+    frame_stride: int = 1,
 ) -> tuple[float, int]:
     """Throughput of the full product surface — ``gol.run()`` with a live
     consumer draining the event queue — NOT the bench harness's bare
@@ -225,6 +226,7 @@ def bench_controller_path(
         turn_events=turn_events,
         engine=engine,
         superstep=superstep,
+        frame_stride=frame_stride,
     )
     events: queue.Queue = queue.Queue()
     keys: queue.Queue = queue.Queue()

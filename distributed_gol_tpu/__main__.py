@@ -77,6 +77,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "frames (auto switches on board size)")
     ap.add_argument("--frame-max", default="512x512", metavar="HxW",
                     help="max size of a device-pooled viewer frame")
+    ap.add_argument("--frame-stride", type=int, default=1, metavar="N",
+                    help="frame mode: exact generations per rendered frame "
+                         "(each frame costs one host round-trip; stride N "
+                         "multiplies wall-clock sim speed ~N on high-"
+                         "latency links)")
     ap.add_argument("--max-dispatch-seconds", type=float, default=0.25,
                     help="adaptive-superstep target per dispatch; bounds "
                          "keypress latency at ~2x this value")
@@ -128,6 +133,7 @@ def params_from_args(args) -> Params:
         turn_events=args.turn_events,
         view_mode=args.view_mode,
         frame_max=(int(fh), int(fw)),
+        frame_stride=args.frame_stride,
         max_dispatch_seconds=args.max_dispatch_seconds,
         skip_stable=args.skip_stable,
         skip_tile_cap=args.skip_tile_cap,

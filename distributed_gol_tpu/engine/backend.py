@@ -256,6 +256,11 @@ class Backend:
     def fetch(self, board: jax.Array) -> np.ndarray:
         return np.asarray(jax.device_get(board))
 
+    def fetch_many(self, *arrays):
+        """One device_get for several values — per-turn paths pay
+        per-round-trip latency, so two sequential fetches cost double."""
+        return [np.asarray(a) for a in jax.device_get(arrays)]
+
     # -- compute ---------------------------------------------------------------
     def run_turns_async(
         self, board: jax.Array, turns: int
@@ -288,6 +293,17 @@ class Backend:
         new_board, count = self.run_turns_async(board, turns)
         return new_board, int(count)
 
+    def _device_superstep(self, board, turns: int):
+        """The pure device superstep — safe to close over inside a jit.
+        ``_skip_superstep`` is impure (host-side skip-stats bookkeeping),
+        so the fused viewer dispatches must NOT trace it: they'd leak a
+        tracer into ``_skip_stats`` and kill the telemetry (round-3
+        review finding).  Viewer dispatches therefore skip the stats —
+        per-turn paths have no pipelined consumer for them anyway."""
+        if getattr(self, "_skip_fn", None) is not None:
+            return self._skip_fn(board, turns)[0]
+        return self._superstep(board, turns)
+
     def run_turn_with_flips(
         self, board: jax.Array
     ) -> tuple[jax.Array, int, np.ndarray]:
@@ -304,34 +320,48 @@ class Backend:
 
             @jax.jit
             def fn(b):
-                nb = self._superstep(b, 1)
-                return nb, stencil.alive_count(nb), stencil.flip_mask(b, nb)
+                nb = self._device_superstep(b, 1)
+                # Bit-pack the mask on device: the mask is binary, and the
+                # host link charges both per-byte bandwidth and a ~100 ms
+                # per-fetch round-trip — fewer bytes and ONE fused fetch.
+                bits = jnp.packbits(stencil.flip_mask(b, nb), axis=-1)
+                return nb, stencil.alive_count(nb), bits
 
             self._viewer_fns["flips"] = fn
-        new_board, count, mask = fn(board)
-        mask = self.fetch(mask)
+        new_board, count, bits = fn(board)
+        count, bits = self.fetch_many(count, bits)
+        mask = np.unpackbits(bits, axis=-1, count=self.params.image_width)
         ys, xs = np.nonzero(mask)
         return new_board, int(count), np.stack([ys, xs], axis=1)
 
     def run_turn_with_frame(
-        self, board: jax.Array, fy: int, fx: int
+        self, board: jax.Array, fy: int, fx: int, turns: int = 1
     ) -> tuple[jax.Array, int, np.ndarray]:
-        """One generation, returning (board, alive count, device-pooled
-        frame).  The max-pool runs on device (``stencil.frame_pool``) so the
-        host transfer is the pooled frame, not the board — the large-board
-        viewer path (SURVEY.md §7 hard part 4).  Fused into one dispatch,
-        like the flips path."""
-        fn = self._viewer_fns.get(("frame", fy, fx))
+        """``turns`` generations (the frame stride; default 1 = a frame per
+        turn), returning (board, alive count, device-pooled frame of the
+        LAST generation).  The max-pool runs on device
+        (``stencil.frame_pool``) so the host transfer is the pooled frame,
+        not the board — the large-board viewer path (SURVEY.md §7 hard
+        part 4).  Fused into one dispatch, like the flips path."""
+        fn = self._viewer_fns.get(("frame", fy, fx, turns))
         if fn is None:
 
             @jax.jit
             def fn(b):
-                nb = self._superstep(b, 1)
-                return nb, stencil.alive_count(nb), stencil.frame_pool(nb, fy, fx)
+                nb = self._device_superstep(b, turns)
+                pooled = stencil.frame_pool(nb, fy, fx)
+                # Bit-packed transfer (see run_turn_with_flips): frames
+                # are binary, the host link is the bottleneck.
+                return nb, stencil.alive_count(nb), jnp.packbits(
+                    pooled != 0, axis=-1
+                )
 
-            self._viewer_fns[("frame", fy, fx)] = fn
-        new_board, count, frame = fn(board)
-        return new_board, int(count), self.fetch(frame)
+            self._viewer_fns[("frame", fy, fx, turns)] = fn
+        new_board, count, bits = fn(board)
+        count, bits = self.fetch_many(count, bits)
+        cols = -(-self.params.image_width // fx)
+        frame = np.unpackbits(bits, axis=-1, count=cols) * np.uint8(255)
+        return new_board, int(count), frame
 
     def count(self, board: jax.Array) -> int:
         return int(stencil.alive_count(board))

@@ -296,18 +296,23 @@ class Controller:
         self._finalize(board, turn)
 
     def _viewer_loop(self, board, turn: int, state: _TickerState):
-        """Per-turn visible stepping: exact flips or device-pooled frames
-        every generation (superstep is 1 by construction), synchronous —
-        a viewer wants the freshest turn, not pipelined throughput."""
+        """Per-turn visible stepping, synchronous — a viewer wants the
+        freshest turn, not pipelined throughput.  Flips mode is exactly
+        per-turn (the reference contract needs every diff); frame mode
+        advances ``Params.frame_stride`` exact generations per rendered
+        frame (default 1), with the TurnComplete stream staying dense and
+        each frame delivered before its own turn's TurnComplete."""
         p = self.params
         wants_flips = p.wants_flips()
         fy, fx = p.frame_factors()
+        stride = p.runtime_superstep()  # 1 for flips; frame_stride for frames
         while turn < p.turns:
             self._poll_keys(board, turn)
             if self._outcome != "completed":
                 break
             t0 = time.perf_counter() if p.emit_timing else 0.0
             if wants_flips:
+                k = 1
                 board, count, coords = self._dispatch(
                     lambda: self.backend.run_turn_with_flips(board),
                     board,
@@ -317,17 +322,20 @@ class Controller:
                 state.set(turn, count)
                 self._emit_flips(turn, coords)
             else:
+                k = min(stride, p.turns - turn)
                 board, count, frame = self._dispatch(
-                    lambda: self.backend.run_turn_with_frame(board, fy, fx),
+                    lambda: self.backend.run_turn_with_frame(board, fy, fx, k),
                     board,
                     turn,
                 )
-                turn += 1
+                for i in range(k - 1):
+                    self._emit(TurnComplete(turn + i + 1))
+                turn += k
                 state.set(turn, count)
                 self._emit(FrameReady(turn, frame, (fy, fx)))
             self._emit(TurnComplete(turn))
             if p.emit_timing:
-                self._emit(TurnTiming(turn, 1, time.perf_counter() - t0))
+                self._emit(TurnTiming(turn, k, time.perf_counter() - t0))
         return board, turn
 
     def _headless_loop(self, board, turn: int, state: _TickerState):

@@ -88,6 +88,14 @@ class Params:
     view_mode: str = "auto"
     # Max (rows, cols) of a device-pooled viewer frame.
     frame_max: tuple[int, int] = (512, 512)
+    # Generations per rendered frame in frame mode (exact simulation, the
+    # viewer samples every Nth turn).  1 = reference-faithful (a frame per
+    # turn).  Useful on high-latency host links: each frame costs one
+    # synchronous fetch round-trip (~100 ms through this rig's tunnel),
+    # so stride N multiplies the per-wall-clock simulation rate by ~N
+    # while the screen still updates at the same fps.  TurnComplete
+    # events stay dense.  Ignored outside frame mode.
+    frame_stride: int = 1
     # AliveCellsCount cadence in seconds (reference: 2000 ms ticker,
     # gol/distributor.go:228); configurable so tests can run fast.
     ticker_period: float = 2.0
@@ -128,6 +136,8 @@ class Params:
         fh, fw = self.frame_max
         if fh < 1 or fw < 1:
             raise ValueError(f"frame_max must be positive, got {self.frame_max}")
+        if self.frame_stride < 1:
+            raise ValueError("frame_stride must be >= 1")
         ny, nx = self.mesh_shape
         if ny < 1 or nx < 1:
             raise ValueError(f"mesh_shape must be positive, got {self.mesh_shape}")
@@ -216,6 +226,8 @@ class Params:
         """Generations per device dispatch the controller will actually use —
         the single source of truth shared by the controller's run loop and
         the backend's engine auto-selection."""
-        if self.wants_flips() or self.wants_frames():
+        if self.wants_flips():
             return 1
+        if self.wants_frames():
+            return self.frame_stride
         return self.effective_superstep(False)

@@ -379,6 +379,24 @@ def _kernel(
     o_ref[:] = out[pad : pad + tile_h, :]
 
 
+def _elide_or_probe(window, elide, tile_h: int, pad: int, turns: int, rule):
+    """(centre rows at gen ``turns``, int32 stable flag) — THE shared
+    elide/probe body of the single-device and sharded adaptive kernels
+    (one home, like ``_advance_window``, so the two cannot drift apart).
+    ``elide`` asserts the window is bit-identical to one whose probe
+    passed last launch; otherwise the probe runs."""
+
+    def probe():
+        out, stable = _probe_window(window, tile_h, pad, turns, rule)
+        return out[pad : pad + tile_h, :], stable.astype(jnp.int32)
+
+    return jax.lax.cond(
+        elide,
+        lambda: (window[pad : pad + tile_h, :], jnp.int32(1)),
+        probe,
+    )
+
+
 def _kernel_adaptive(
     prev_ref, x_hbm, o_ref, st_ref, tile, sems, *, tile_h, pad, grid, turns, rule
 ):
@@ -427,15 +445,7 @@ def _kernel_adaptive(
 
     center.wait()
 
-    def probe():
-        out, stable = _probe_window(tile[:], tile_h, pad, turns, rule)
-        return out[pad : pad + tile_h, :], stable.astype(jnp.int32)
-
-    out_center, stable = jax.lax.cond(
-        elide,
-        lambda: (tile[pl.ds(pad, tile_h), :], jnp.int32(1)),
-        probe,
-    )
+    out_center, stable = _elide_or_probe(tile[:], elide, tile_h, pad, turns, rule)
     o_ref[:] = out_center
     st_ref[i] = stable
 

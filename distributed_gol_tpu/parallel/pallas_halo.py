@@ -50,7 +50,7 @@ from distributed_gol_tpu.ops.pallas_packed import (
     _adaptive_eligible,
     _advance_window,
     _compiler_params,
-    _probe_window,
+    _elide_or_probe,
     _require_adaptive_eligible,
     _round8,
     _tile_for_pad,
@@ -132,16 +132,8 @@ def _ext_kernel_adaptive(
         c.start()
         c.wait()
 
-    window = tile[:]
-
-    def probe():
-        out, stable = _probe_window(window, tile_h, pad, turns, rule)
-        return out[pad : pad + tile_h, :], stable.astype(jnp.int32)
-
-    out_center, stable = jax.lax.cond(
-        elide,
-        lambda: (window[pad : pad + tile_h, :], jnp.int32(1)),
-        probe,
+    out_center, stable = _elide_or_probe(
+        tile[:], elide, tile_h, pad, turns, rule
     )
     o_ref[:] = out_center
     st_ref[i] = stable

@@ -312,6 +312,34 @@ class TestSkipTileCapKnob:
         assert backend.skip_fraction() == 1.0  # all-ash: everything skips
         np.testing.assert_array_equal(backend.fetch(board), want.fetch(wboard))
 
+    def test_viewer_dispatch_does_not_poison_skip_stats(self):
+        """The fused viewer dispatches jit-close over the DEVICE superstep,
+        not the stats-keeping wrapper: tracing the impure wrapper would
+        leak a tracer into _skip_stats and make skip_fraction() raise
+        (round-3 review finding, reproduced before the fix)."""
+        from distributed_gol_tpu.engine.backend import Backend
+        from distributed_gol_tpu.engine.params import Params
+
+        p = Params(
+            engine="pallas-packed",
+            skip_stable=True,
+            image_width=W,
+            image_height=H,
+            turns=96,
+            superstep=24,
+            no_vis=False,
+            view_mode="frame",
+            frame_stride=24,
+            frame_max=(16, 16),
+        )
+        backend = Backend(p)
+        board = backend.put(blank())
+        fy, fx = p.frame_factors()
+        board, _, _ = backend.run_turn_with_frame(board, fy, fx, 24)
+        for _ in range(3):
+            board, _ = backend.run_turns(board, 24)
+        assert backend.skip_fraction() == 1.0  # all-ash, no tracers
+
     def test_backend_explicit_cap(self):
         from distributed_gol_tpu.engine.backend import Backend
         from distributed_gol_tpu.engine.params import Params

@@ -73,6 +73,56 @@ def test_frame_pool_keeps_trailing_cells():
     np.testing.assert_array_equal(pooled, R.downsample(b, 5, 4))
 
 
+def test_frame_stride_samples_exact_turns(tmp_path):
+    """frame_stride=4: the sim advances exactly, TurnComplete stays dense,
+    one FrameReady per stride delivered before its own turn's
+    TurnComplete, and each frame equals the true pooled board at that
+    turn (cross-checked against a per-turn reference run)."""
+    import distributed_gol_tpu as gol
+    from distributed_gol_tpu.engine.events import FrameReady, TurnComplete
+
+    size, turns = 2048, 10
+    images = tmp_path / "images"
+    images.mkdir()
+    write_soup(images, size)
+    params = make_params(tmp_path, images, size, turns=turns, frame_stride=4)
+    assert params.wants_frames() and params.runtime_superstep() == 4
+
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events)
+    stream = []
+    while (e := events.get(timeout=120)) is not None:
+        stream.append(e)
+
+    tc = [e.completed_turns for e in stream if isinstance(e, TurnComplete)]
+    assert tc == list(range(1, turns + 1))  # dense despite the stride
+    frames = [e for e in stream if isinstance(e, FrameReady)]
+    assert [f.completed_turns for f in frames] == [0, 4, 8, 10]  # incl. rem
+    for f in frames[1:]:
+        # frame before its own TurnComplete
+        i_f = stream.index(f)
+        i_t = next(
+            i for i, e in enumerate(stream)
+            if isinstance(e, TurnComplete)
+            and e.completed_turns == f.completed_turns
+        )
+        assert i_f < i_t
+
+    # Ground truth: a reference run's board at turn 8, pooled.
+    ref = make_params(tmp_path / "ref", images, size, turns=8)
+    (tmp_path / "ref").mkdir()
+    ev2: queue.Queue = queue.Queue()
+    gol.run(ref, ev2)
+    while (e := ev2.get(timeout=120)) is not None:
+        pass
+    from distributed_gol_tpu.engine.pgm import read_pgm
+
+    board8 = read_pgm(tmp_path / "ref" / f"{size}x{size}x8.pgm")
+    fy, fx = params.frame_factors()
+    want = np.asarray(stencil.frame_pool(board8, fy, fx))
+    np.testing.assert_array_equal(frames[2].frame, want)
+
+
 def test_4096_viewer_transfer_is_bounded(tmp_path):
     """The per-turn host transfer for a 4096² viewer turn is the pooled
     frame: ≤ frame_max cells (256 KB), not the 16 MB board."""
