@@ -175,6 +175,14 @@ def bench_config(
     return gps, gps * size * size
 
 
+def budget_for(size: int) -> float:
+    """Wall-clock seconds for one controller-path measurement: must cover
+    the fresh jit compile (~20-40 s at 16384² on this rig) plus a usable
+    steady-state window — shared by bench.py and tools/bench_table.py so
+    their rows measure the same window."""
+    return 75.0 if size >= 16384 else 30.0 if size >= 4096 else 12.0
+
+
 def superstep_for(engine_gps: float) -> int:
     """Explicit dispatch depth for controller-path measurements: ~0.5 s of
     device time per dispatch at the measured engine rate — one jit compile
@@ -529,7 +537,10 @@ def main():
         # measurement above, so one jit compile instead of the adaptive
         # ramp's ladder, and batch turn telemetry — the headless fast path.
         cp_gps, _ = bench_controller_path(
-            size, superstep=superstep_for(gps), engine=engine
+            size,
+            budget_seconds=budget_for(size),
+            superstep=superstep_for(gps),
+            engine=engine,
         )
         record["controller_path_gps"] = round(cp_gps, 2)
         record["controller_vs_engine"] = round(cp_gps / gps, 4) if gps else 0.0

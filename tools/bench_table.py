@@ -19,6 +19,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from bench import (  # noqa: E402
     bench_config,
     bench_controller_path,
+    budget_for,
     ensure_live_backend,
     log,
     pick_engine,
@@ -90,9 +91,7 @@ def main():
     for size in sizes:
         best = engine_gps.get(size, 0.0)
         ss = superstep_for(best) if best else 0
-        budget = args.path_budget or (
-            75.0 if size >= 16384 else 30.0 if size >= 4096 else 12.0
-        )
+        budget = args.path_budget or budget_for(size)
         for label, kw in (
             ("run() batch", dict(turn_events="batch", superstep=ss)),
             ("run() per-turn", dict(turn_events="per-turn", superstep=ss)),
