@@ -10,6 +10,9 @@ for this size is dryrun-proven in ``__graft_entry__.dryrun_multichip``
 (65536-row slice + static launch plan on a (4,1) mesh).
 
 Usage: python tools/bench_65536.py [--kturns N] [--reps R]
+                                   [--skip-stable] [--burnin N]
+(BENCH_65536_r03.json was produced with
+ --skip-stable --burnin 200000 --kturns 996 --reps 5.)
 """
 
 from __future__ import annotations
@@ -33,6 +36,12 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--kturns", type=int, default=512)
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--skip-stable", action="store_true",
+                    help="activity-adaptive kernel (period-6 skip + probe "
+                    "elision); pair with --burnin for steady state")
+    ap.add_argument("--burnin", type=int, default=0,
+                    help="evolve N generations before timing (rides the "
+                    "adaptive engine when --skip-stable)")
     args = ap.parse_args()
 
     import jax
@@ -54,41 +63,75 @@ def main():
     board = jax.random.bits(key, (H, WP), dtype=jnp.uint32)
     _sync(board)
 
-    superstep = pallas_packed.make_superstep(CONWAY)
-    t = pallas_packed.launch_turns(board.shape, args.kturns)
-    log(f"  temporal blocking: T={t}")
+    if args.skip_stable:
+        superstep = pallas_packed.make_superstep(
+            CONWAY, skip_stable=True, with_stats=True
+        )
+
+        def run(b, kt):
+            return superstep(b, kt)[0]
+
+        log("  activity-adaptive: period-6 skip + frontier probe elision")
+    else:
+        run = pallas_packed.make_superstep(CONWAY)
+        t = pallas_packed.launch_turns(board.shape, args.kturns)
+        log(f"  temporal blocking: T={t}")
     t0 = time.perf_counter()
-    board = superstep(board, args.kturns)
+    board = run(board, args.kturns)
     _sync(board)
     log(f"  compile+first superstep: {time.perf_counter() - t0:.1f}s")
+
+    if args.burnin:
+        t0 = time.perf_counter()
+        done = 0
+        while done < args.burnin:
+            board = run(board, args.kturns)
+            done += args.kturns
+        _sync(board)
+        log(f"  burn-in: {done} gens in {time.perf_counter() - t0:.1f}s")
 
     t0 = time.perf_counter()
     b = board
     for _ in range(args.reps):
-        b = superstep(b, args.kturns)
+        b = run(b, args.kturns)
     _sync(b)
     dt = (time.perf_counter() - t0) / args.reps
     gps = args.kturns / dt
     log(f"  65536x65536: {args.kturns} gens in {dt:.3f}s -> {gps:,.0f} gens/s, "
         f"{gps * H * H:.3e} cell-updates/s")
 
-    # Bit-identity vs the XLA packed engine, 16 gens on the evolved board.
-    want = packed.superstep(b, CONWAY, 16)
-    got = superstep(b, 16)
-    ok = bool(jnp.array_equal(got, want))
-    log(f"  verify vs XLA packed, 16 gens: {'bit-identical' if ok else 'MISMATCH'}")
-
-    print(
-        json.dumps(
-            {
-                "metric": f"gol_gens_per_sec_65536x65536_pallas-packed_{dev.platform}",
-                "value": round(gps, 2),
-                "unit": "generations/sec",
-                "cell_updates_per_sec": gps * H * H,
-                "bit_identical": ok,
-            }
+    skip_frac = None
+    if args.skip_stable:
+        # One stats dispatch at the SAME depth as the timed runs, so the
+        # recorded fraction describes the benchmarked launch plan.
+        _, skipped = superstep(b, args.kturns)
+        total = pallas_packed.adaptive_tile_launches(
+            b.shape, args.kturns, pallas_packed._SKIP_TILE_CAP
         )
-    )
+        if total:
+            skip_frac = round(int(skipped) / total, 4)
+        log(f"  skip fraction: {skip_frac}")
+
+    # Bit-identity vs the XLA packed engine on the evolved board (18 gens:
+    # a period multiple, so the adaptive path may skip — both branches on
+    # the record).
+    want = packed.superstep(b, CONWAY, 18)
+    got = run(b, 18)
+    ok = bool(jnp.array_equal(got, want))
+    log(f"  verify vs XLA packed, 18 gens: {'bit-identical' if ok else 'MISMATCH'}")
+
+    variant = "-skip" if args.skip_stable else ""
+    burn = f"_burnin{args.burnin}" if args.burnin else ""
+    record = {
+        "metric": f"gol_gens_per_sec_65536x65536_pallas-packed{variant}{burn}_{dev.platform}",
+        "value": round(gps, 2),
+        "unit": "generations/sec",
+        "cell_updates_per_sec": gps * H * H,
+        "bit_identical": ok,
+    }
+    if skip_frac is not None:
+        record["skip_fraction"] = skip_frac
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":
