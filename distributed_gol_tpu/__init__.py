@@ -28,6 +28,7 @@ from distributed_gol_tpu.engine.events import (
     AliveCellsCount,
     CellFlipped,
     CellsFlipped,
+    CycleDetected,
     DispatchError,
     Event,
     FinalTurnComplete,
@@ -46,6 +47,7 @@ __all__ = [
     "Cell",
     "CellFlipped",
     "CellsFlipped",
+    "CycleDetected",
     "DispatchError",
     "Event",
     "FinalTurnComplete",

@@ -92,6 +92,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="skip-tile granularity for --skip-stable (multiple "
                          "of 8). 0 = the measured-optimal default (1024 "
                          "rows, dominant in every measured regime)")
+    ap.add_argument("--cycle-check", type=int, default=8, metavar="N",
+                    help="probe for whole-board period-6 stability every N "
+                         "headless dispatches; once proved, the remaining "
+                         "turns fast-forward exactly (0 disables)")
     ap.add_argument("--soup", type=float, default=None, metavar="DENSITY",
                     help="start from a seeded random soup of this density "
                          "instead of images/WxH.pgm (huge boards need no "
@@ -137,6 +141,7 @@ def params_from_args(args) -> Params:
         max_dispatch_seconds=args.max_dispatch_seconds,
         skip_stable=args.skip_stable,
         skip_tile_cap=args.skip_tile_cap,
+        cycle_check=args.cycle_check,
         soup_density=args.soup,
         soup_seed=args.soup_seed,
     )

@@ -365,3 +365,45 @@ class Backend:
 
     def count(self, board: jax.Array) -> int:
         return int(stencil.alive_count(board))
+
+    # -- whole-board cycle detection (Params.cycle_check) ----------------------
+    _CYCLE_PERIOD = 6  # lcm(1, 2, 3): still lifes, blinkers, pulsars
+
+    def cycle_probe_async(self, board: jax.Array) -> jax.Array:
+        """Issue (without waiting) the whole-board periodicity check: an
+        on-device bool, true iff advancing ``_CYCLE_PERIOD`` generations
+        reproduces ``board`` exactly.  Deterministic dynamics then pin
+        every future state to one of the cycle's phases, which is what
+        licenses the controller's fast-forward.  The equality reduces
+        across shards under jit (one all-reduce on a mesh), so every
+        process of a multi-host run reads the identical flag."""
+        fn = self._viewer_fns.get("cycle_probe")
+        if fn is None:
+
+            @jax.jit
+            def fn(b):
+                return jnp.array_equal(
+                    self._device_superstep(b, self._CYCLE_PERIOD), b
+                )
+
+            self._viewer_fns["cycle_probe"] = fn
+        return fn(board)
+
+    def cycle_counts(self, board: jax.Array) -> np.ndarray:
+        """Alive counts of the ``_CYCLE_PERIOD`` cycle phases: entry i is
+        the count after i+1 generations from ``board``.  Only called once
+        a probe has proved the cycle, so these six numbers are the alive
+        counts of every remaining turn of the run."""
+        fn = self._viewer_fns.get("cycle_counts")
+        if fn is None:
+
+            @jax.jit
+            def fn(b):
+                counts = []
+                for _ in range(self._CYCLE_PERIOD):
+                    b = self._device_superstep(b, 1)
+                    counts.append(stencil.alive_count(b))
+                return jnp.stack(counts)
+
+            self._viewer_fns["cycle_counts"] = fn
+        return np.asarray(jax.device_get(fn(board)))

@@ -142,6 +142,28 @@ class TurnsCompleted(Event):
 
 
 @dataclass(frozen=True)
+class CycleDetected(Event):
+    """The whole board was proved periodic (framework extension).
+
+    Emitted by a headless run when the cycle probe
+    (``Params.cycle_check``) verifies that advancing the board ``period``
+    generations reproduces it exactly.  From that point the dynamics are
+    a fixed cycle, so the controller stops dispatching device work and
+    fast-forwards: every remaining turn's events and alive counts come
+    from the 6 cycle phases, and the final board is the phase at
+    ``turns mod period`` — bit-identical to stepping the rest of the way.
+    ``completed_turns`` is the turn at which periodicity was established
+    (the true period may be any divisor of ``period``)."""
+
+    period: int = 6
+
+    def __str__(self) -> str:
+        return (
+            f"Board is period-{self.period} stable; fast-forwarding remaining turns"
+        )
+
+
+@dataclass(frozen=True)
 class FinalTurnComplete(Event):
     """The run is over; carries the final alive-cell list, consumed directly
     by tests (``gol/event.go:61-65``, ``gol_test.go:33-41``).
@@ -209,6 +231,7 @@ AnyEvent = Union[
     FrameReady,
     TurnComplete,
     TurnsCompleted,
+    CycleDetected,
     FinalTurnComplete,
     DispatchError,
     TurnTiming,

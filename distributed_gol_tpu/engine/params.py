@@ -96,6 +96,23 @@ class Params:
     # while the screen still updates at the same fps.  TurnComplete
     # events stay dense.  Ignored outside frame mode.
     frame_stride: int = 1
+    # Whole-board cycle detection for headless runs: every N device
+    # dispatches, probe (asynchronously, off the critical path) whether
+    # advancing 6 generations reproduces the board exactly.  Once it does,
+    # the dynamics are a fixed cycle — period a divisor of 6 = lcm(1..3),
+    # which covers still lifes, blinkers and pulsars, i.e. every common
+    # ash — so the controller stops dispatching and fast-forwards the
+    # remaining turns exactly (events, counts, and the final board all
+    # come from the 6 cycle phases; see ``CycleDetected``).  The reference
+    # system's own 512² test board settles into a period-2 cycle near
+    # turn 5k (``check/alive/512x512.csv`` tail), after which its per-turn
+    # RPC loop keeps paying full price forever; this makes the default
+    # 10^10-turn CLI config (``main.go:33``) finish in seconds with
+    # ``turn_events="batch"`` (per-turn telemetry keeps the dense
+    # TurnComplete stream, which then becomes the bound).  0 disables.
+    # Boards with travelling patterns (gliders) simply never pass the
+    # probe and pay only its ~6 generations per N dispatches.
+    cycle_check: int = 8
     # AliveCellsCount cadence in seconds (reference: 2000 ms ticker,
     # gol/distributor.go:228); configurable so tests can run fast.
     ticker_period: float = 2.0
@@ -145,6 +162,8 @@ class Params:
             raise ValueError(
                 "skip_tile_cap must be 0 (auto) or a positive multiple of 8"
             )
+        if self.cycle_check < 0:
+            raise ValueError("cycle_check must be >= 0 (0 disables)")
         if self.ticker_period <= 0:
             raise ValueError("ticker_period must be positive")
         if self.max_dispatch_seconds <= 0:

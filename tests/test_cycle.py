@@ -1,0 +1,278 @@
+"""Whole-board cycle detection + exact fast-forward (Params.cycle_check).
+
+The reference's own 512² test board settles into a period-2 cycle near
+turn 5k (``check/alive/512x512.csv`` tail: 5565/5567 forever), after which
+its per-turn RPC loop keeps paying full price for every remaining turn of
+the default 10^10-turn run (``main.go:33``).  The cycle probe proves
+period-6 stability on device and then delivers the rest of the run from
+the 6 cycle phases — bit-identical events, counts, snapshots, and final
+board, at zero device supersteps.  These tests pin that exactness.
+"""
+
+import queue
+
+import numpy as np
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.engine import pgm
+from distributed_gol_tpu.engine.events import (
+    CycleDetected,
+    FinalTurnComplete,
+    ImageOutputComplete,
+    TurnComplete,
+    TurnsCompleted,
+)
+from distributed_gol_tpu.engine.session import Session
+
+from tests.oracle import oracle_run
+
+
+def blinker_board(h=16, w=16) -> np.ndarray:
+    """A still life (block) + a period-2 oscillator (blinker): globally
+    periodic from turn 0, so the first probe proves the cycle."""
+    b = np.zeros((h, w), np.uint8)
+    b[1:3, 1:3] = 255  # block
+    b[8, 5:8] = 255  # horizontal blinker
+    return b
+
+
+def write_board(images_dir, board):
+    images_dir.mkdir(parents=True, exist_ok=True)
+    h, w = board.shape
+    pgm.write_pgm(images_dir / f"{w}x{h}.pgm", board)
+
+
+def make_params(tmp_path, **kw):
+    defaults = dict(
+        turns=100,
+        image_width=16,
+        image_height=16,
+        images_dir=tmp_path / "images",
+        out_dir=tmp_path,
+        engine="roll",
+    )
+    defaults.update(kw)
+    return gol.Params(**defaults)
+
+
+def drain(events):
+    out = []
+    while (e := events.get(timeout=120)) is not None:
+        out.append(e)
+    return out
+
+
+def alive_set(board):
+    ys, xs = np.nonzero(board)
+    return {(int(x), int(y)) for y, x in zip(ys, xs)}
+
+
+def test_fast_forward_a_billion_turns_batch(tmp_path):
+    """10^9+1 turns complete near-instantly once the cycle is proved; the
+    final board is the exact phase (odd turn => flipped blinker)."""
+    board = blinker_board()
+    write_board(tmp_path / "images", board)
+    turns = 10**9 + 1
+    params = make_params(
+        tmp_path, turns=turns, turn_events="batch", superstep=4, cycle_check=2
+    )
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events)
+    stream = drain(events)
+
+    cycles = [e for e in stream if isinstance(e, CycleDetected)]
+    assert len(cycles) == 1 and cycles[0].period == 6
+
+    ranges = [
+        (e.first_turn, e.completed_turns)
+        for e in stream
+        if isinstance(e, TurnsCompleted)
+    ]
+    assert ranges[0][0] == 1 and ranges[-1][1] == turns
+    for (_, l0), (f1, _) in zip(ranges, ranges[1:]):
+        assert f1 == l0 + 1
+
+    expected = oracle_run(board, 1)  # odd total turns: phase 1 of period 2
+    final = [e for e in stream if isinstance(e, FinalTurnComplete)][0]
+    assert final.completed_turns == turns
+    assert set(final.alive) == alive_set(expected)
+    out = pgm.read_pgm(tmp_path / f"16x16x{turns}.pgm")
+    assert np.array_equal(out, expected)
+
+
+def test_fast_forward_per_turn_stream_stays_dense(tmp_path):
+    board = blinker_board()
+    write_board(tmp_path / "images", board)
+    turns = 200_000
+    params = make_params(tmp_path, turns=turns, superstep=8, cycle_check=1)
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events)
+    stream = drain(events)
+
+    assert any(isinstance(e, CycleDetected) for e in stream)
+    tc = [e.completed_turns for e in stream if isinstance(e, TurnComplete)]
+    assert tc == list(range(1, turns + 1))
+    final = [e for e in stream if isinstance(e, FinalTurnComplete)][0]
+    assert final.completed_turns == turns
+    assert set(final.alive) == alive_set(board)  # even turns: phase 0
+
+
+def test_fast_forward_adaptive_superstep(tmp_path):
+    """The adaptive (superstep=0) dispatch ladder probes and fast-forwards
+    too — the default configuration of a headless run."""
+    board = blinker_board()
+    write_board(tmp_path / "images", board)
+    turns = 10**9
+    params = make_params(
+        tmp_path, turns=turns, turn_events="batch", superstep=0, cycle_check=2
+    )
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events)
+    stream = drain(events)
+    assert any(isinstance(e, CycleDetected) for e in stream)
+    final = [e for e in stream if isinstance(e, FinalTurnComplete)][0]
+    assert final.completed_turns == turns
+    assert set(final.alive) == alive_set(board)
+
+
+def test_active_board_never_fires_and_stays_golden(
+    tmp_path, input_images, golden_images
+):
+    """A board that has not settled must never fast-forward: probes run
+    (cycle_check=1) but return false, and the run lands exactly on the
+    reference golden board."""
+    params = gol.Params(
+        turns=100,
+        image_width=64,
+        image_height=64,
+        images_dir=input_images,
+        out_dir=tmp_path,
+        engine="roll",
+        superstep=4,
+        cycle_check=1,
+    )
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events)
+    stream = drain(events)
+    assert not any(isinstance(e, CycleDetected) for e in stream)
+    golden = pgm.read_pgm(golden_images / "64x64x100.pgm")
+    out = pgm.read_pgm(tmp_path / "64x64x100.pgm")
+    assert np.array_equal(out, golden)
+
+
+def test_ticker_count_matches_cycle_phase(tmp_path):
+    """AliveCellsCount during/after fast-forward reports the phase-exact
+    count: blinker+block is 7 alive in both phases, so latch the final
+    pair and check a board whose phases differ in count."""
+    # A beacon (period 2: 8 alive then 6 alive) pins phase-dependent counts.
+    b = np.zeros((16, 16), np.uint8)
+    b[2:4, 2:4] = 255
+    b[4:6, 4:6] = 255
+    assert int((oracle_run(b, 1) != 0).sum()) == 6
+    write_board(tmp_path / "images", b)
+    turns = 10**6 + 1
+    params = make_params(
+        tmp_path, turns=turns, turn_events="batch", superstep=4, cycle_check=2
+    )
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events)
+    stream = drain(events)
+    assert any(isinstance(e, CycleDetected) for e in stream)
+    final = [e for e in stream if isinstance(e, FinalTurnComplete)][0]
+    # Odd turn: the 6-alive phase.
+    assert len(final.alive) == 6
+    expected = oracle_run(b, 1)
+    out = pgm.read_pgm(tmp_path / f"16x16x{turns}.pgm")
+    assert np.array_equal(out, expected)
+
+
+def test_keys_during_fast_forward_detach_resume_snapshot(tmp_path):
+    """'s' and 'q' during per-turn fast-forward emission operate on the
+    true phase board for the emitted turn; the detach checkpoint resumes
+    to the exact final phase."""
+    board = blinker_board()
+    write_board(tmp_path / "images", board)
+    turns = 10**7  # emission alone takes long enough for keys to land
+    session = Session()
+    params = make_params(tmp_path, turns=turns, superstep=4, cycle_check=1)
+    events: queue.Queue = queue.Queue()
+    keys: queue.Queue = queue.Queue()
+    t = gol.start(params, events, keys, session)
+
+    saw_cycle = False
+    stream = []
+    while (e := events.get(timeout=120)) is not None:
+        if not isinstance(e, TurnComplete):  # bound test memory
+            stream.append(e)
+        if isinstance(e, CycleDetected) and not saw_cycle:
+            saw_cycle = True
+            keys.put("s")
+            keys.put("q")
+    t.join(timeout=120)
+    assert saw_cycle
+
+    ckpt = session.check_states(16, 16)
+    assert ckpt is not None and ckpt.turn < turns
+    # Checkpoint world is the exact phase board for the detach turn.
+    assert np.array_equal(ckpt.world, oracle_run(board, ckpt.turn % 2))
+    final = [e for e in stream if isinstance(e, FinalTurnComplete)][0]
+    assert final.completed_turns == ckpt.turn
+
+    snaps = [e for e in stream if isinstance(e, ImageOutputComplete)]
+    assert len(snaps) == 1
+    snap_turn = int(snaps[0].filename.split("x")[2].removesuffix("current"))
+    snap = pgm.read_pgm(tmp_path / f"{snaps[0].filename}.pgm")
+    assert np.array_equal(snap, oracle_run(board, snap_turn % 2))
+
+    # Re-park the inspected checkpoint (check_states is consume-once),
+    # then resume in batch mode: the rest completes instantly.
+    session.pause(True, world=ckpt.world, turn=ckpt.turn)
+    resumed = make_params(
+        tmp_path,
+        turns=turns,
+        turn_events="batch",
+        superstep=4,
+        cycle_check=1,
+    )
+    events2: queue.Queue = queue.Queue()
+    gol.run(resumed, events2, session=session)
+    final2 = [e for e in drain(events2) if isinstance(e, FinalTurnComplete)][0]
+    assert final2.completed_turns == turns
+    assert set(final2.alive) == alive_set(board)  # even total: phase 0
+
+
+def test_probe_engines_and_mesh(tmp_path):
+    """Backend.cycle_probe_async is exact for the packed engine and on a
+    sharded mesh (the equality reduces across shards)."""
+    from distributed_gol_tpu.engine.backend import Backend
+
+    blinker = blinker_board(64, 64)
+    glider = np.zeros((64, 64), np.uint8)
+    glider[1, 2] = glider[2, 3] = glider[3, 1:4] = 255
+
+    for kw in (
+        dict(engine="packed", superstep=8),
+        dict(engine="roll", superstep=8, mesh_shape=(2, 2)),
+    ):
+        params = gol.Params(
+            image_width=64, image_height=64, turns=100, **kw
+        )
+        backend = Backend(params)
+        assert bool(backend.cycle_probe_async(backend.put(blinker)))
+        assert not bool(backend.cycle_probe_async(backend.put(glider)))
+        counts = backend.cycle_counts(backend.put(blinker))
+        assert counts.shape == (6,) and all(int(c) == 7 for c in counts)
+
+
+def test_cycle_check_disabled(tmp_path):
+    board = blinker_board()
+    write_board(tmp_path / "images", board)
+    params = make_params(
+        tmp_path, turns=3000, turn_events="batch", superstep=8, cycle_check=0
+    )
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events)
+    stream = drain(events)
+    assert not any(isinstance(e, CycleDetected) for e in stream)
+    final = [e for e in stream if isinstance(e, FinalTurnComplete)][0]
+    assert final.completed_turns == 3000

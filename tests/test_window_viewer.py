@@ -116,8 +116,12 @@ def test_window_forwards_keys_to_engine(tmp_path, input_images):
     import threading
     import time
 
+    # cycle_check=0: the 64² board settles near turn 1584, and the cycle
+    # fast-forward would legitimately COMPLETE the 10^9-turn run before
+    # the delayed keypress below — this test needs a still-running engine.
     params = make_params(tmp_path, input_images, turns=10**9,
-                         turn_events="batch", flip_events="off")
+                         turn_events="batch", flip_events="off",
+                         cycle_check=0)
     events: queue.Queue = queue.Queue()
     keys: queue.Queue = queue.Queue()
     t = gol.start(params, events, keys)
