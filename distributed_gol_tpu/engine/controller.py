@@ -451,14 +451,7 @@ class Controller:
             if probe_every and n_issued >= next_probe and issued_turn < p.turns:
                 next_probe = n_issued + probe_every
                 if probe_flag is not None:
-                    # The probe is advisory: if forcing it surfaces a device
-                    # failure (e.g. it was computed from a dispatch the
-                    # retry contract has since replaced), drop it and let
-                    # the data path's own retry handle the real failure.
-                    try:
-                        fired = bool(probe_flag)
-                    except Exception:  # noqa: BLE001 — device/runtime failure
-                        fired = False
+                    fired = self._force_probe(probe_flag)
                     probe_flag = None
                     if fired:
                         if pending is not None:
@@ -505,6 +498,21 @@ class Controller:
             board = resolve()
         return board, turn
 
+    def _force_probe(self, flag) -> bool:
+        """Force a cycle-probe flag.  Single-host, the probe is advisory:
+        if forcing it surfaces a device failure (e.g. it was computed from
+        a dispatch the retry contract has since replaced), drop it and let
+        the data path's own retry handle the real failure.  A seam because
+        multi-host must NOT swallow: the flag's value is identical on
+        every process, but *forcing* is per-process — one process quietly
+        reading False while its peers read True would diverge the
+        collective schedules, so the multi-host controller re-raises
+        instead (see MultihostController)."""
+        try:
+            return bool(flag)
+        except Exception:  # noqa: BLE001 — device/runtime failure
+            return False
+
     # Per-turn fast-forward emission chunk: bounds the latency of a key
     # poll / ticker latch during cycle-mode dense TurnComplete emission.
     _FF_CHUNK = 1 << 16
@@ -543,7 +551,13 @@ class Controller:
                 ):
                     phase = (t - turn) % period
                     board_t = (
-                        self.backend.run_turns(board, phase)[0] if phase else board
+                        self._dispatch(
+                            lambda: self.backend.run_turns(board, phase)[0],
+                            board,
+                            t,
+                        )
+                        if phase
+                        else board
                     )
                     self._poll_keys(board_t, t)
                     if self._outcome != "completed":

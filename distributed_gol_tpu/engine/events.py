@@ -150,10 +150,11 @@ class CycleDetected(Event):
     generations reproduces it exactly.  From that point the dynamics are
     a fixed cycle, so the controller stops dispatching device work and
     fast-forwards: every remaining turn's events and alive counts come
-    from the 6 cycle phases, and the final board is the phase at
-    ``turns mod period`` — bit-identical to stepping the rest of the way.
-    ``completed_turns`` is the turn at which periodicity was established
-    (the true period may be any divisor of ``period``)."""
+    from the cycle phases, and the final board is the phase at
+    ``(turns - completed_turns) mod period`` generations past the board
+    at ``completed_turns`` — bit-identical to stepping the rest of the
+    way.  ``completed_turns`` is the turn at which periodicity was
+    established (the true period may be any divisor of ``period``)."""
 
     period: int = 6
 

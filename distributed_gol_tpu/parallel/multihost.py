@@ -272,4 +272,15 @@ def _run_distributed(params, events, key_presses, session):
                 return negotiated
             return self._load_input(), 0
 
+        def _force_probe(self, flag):
+            # The base class swallows a probe-force failure (advisory
+            # single-host semantics).  Here the cycle flag gates which
+            # collectives every process issues next: its *value* is
+            # all-reduced and identical everywhere, but a one-sided
+            # failure while forcing it would make this process silently
+            # read False while peers read True — divergent collective
+            # schedules, a hang.  Abort with the stream sentinel instead
+            # (same policy as _park_checkpoint above).
+            return bool(flag)
+
     MultihostController(params, ev, keys, session, backend).run()

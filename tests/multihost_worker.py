@@ -36,6 +36,8 @@ def main():
     mode = sys.argv[5] if len(sys.argv) > 5 else "dataplane"
     if mode == "controller":
         return controller_main(coordinator, nprocs, pid, okfile, sys.argv[6])
+    if mode == "cycle":
+        return cycle_main(coordinator, nprocs, pid, okfile, sys.argv[6])
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -212,6 +214,77 @@ def controller_main(coordinator, nprocs, pid, okfile, out_dir):
         f.write("ok")
     print(f"[{pid}] controller-mode multihost run ok (incl. detach+resume)",
           flush=True)
+
+
+def cycle_main(coordinator, nprocs, pid, okfile, out_dir):
+    """Multi-host cycle fast-forward: the 64² board settles near turn 1.6k;
+    the collective probe (scheduled by dispatch count, so every process
+    issues it at the same point) proves period-6 stability, and all
+    processes fast-forward the remaining ~10^6 turns in lockstep.  Process
+    0 checks the stream and compares the final PGM byte-for-byte against a
+    single-device run of the same parameters."""
+    import queue
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import distributed_gol_tpu as gol
+    from distributed_gol_tpu.parallel import multihost
+
+    multihost.initialize(coordinator, nprocs, pid)
+    my_out = os.path.join(out_dir, f"p{pid}")
+    os.makedirs(my_out, exist_ok=True)
+    turns = 10**6
+    params = gol.Params(
+        turns=turns,
+        image_width=64,
+        image_height=64,
+        images_dir="/root/reference/images",
+        out_dir=my_out,
+        superstep=10,
+        turn_events="batch",
+        ticker_period=60.0,
+    )
+    if pid == 0:
+        events: queue.Queue = queue.Queue()
+        seen = []
+
+        def pump():
+            while (e := events.get(timeout=120)) is not None:
+                seen.append(e)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        multihost.run_distributed(params, events)
+        t.join(timeout=30)
+
+        cycles = [e for e in seen if isinstance(e, gol.CycleDetected)]
+        assert len(cycles) == 1, cycles
+        final = [e for e in seen if isinstance(e, gol.FinalTurnComplete)][0]
+        assert final.completed_turns == turns
+        assert len(final.alive) == 101  # check/alive/64x64.csv steady state
+
+        # Single-device comparison run (same process, default backend).
+        single_out = os.path.join(out_dir, "single")
+        os.makedirs(single_out, exist_ok=True)
+        from dataclasses import replace
+
+        ev2: queue.Queue = queue.Queue()
+        gol.run(replace(params, out_dir=single_out), ev2)
+        while ev2.get(timeout=120) is not None:
+            pass
+        got = open(f"{my_out}/64x64x{turns}.pgm", "rb").read()
+        want = open(f"{single_out}/64x64x{turns}.pgm", "rb").read()
+        assert got == want, "multi-host fast-forward differs from single-device"
+    else:
+        multihost.run_distributed(params)
+        assert not os.listdir(my_out), "follower wrote files"
+
+    with open(okfile, "w") as f:
+        f.write("ok")
+    print(f"[{pid}] multi-host cycle fast-forward ok ({turns} turns)", flush=True)
 
 
 if __name__ == "__main__":

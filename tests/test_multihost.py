@@ -66,6 +66,17 @@ def test_two_process_full_controller_run(tmp_path):
     _launch_workers(tmp_path, "controller", extra=(str(out),))
 
 
+def test_two_process_cycle_fast_forward(tmp_path):
+    """The whole-board cycle probe across processes: the probe is a
+    collective scheduled by dispatch count, every process proves the
+    cycle at the same point and fast-forwards ~10^6 turns in lockstep;
+    final PGM byte-identical to a single-device run (see
+    multihost_worker.cycle_main)."""
+    out = tmp_path / "out"
+    out.mkdir()
+    _launch_workers(tmp_path, "cycle", extra=(str(out),))
+
+
 def test_cli_multihost_run(tmp_path):
     """The CLI's multi-host mode: the same command on two 'hosts'
     (--process-id 0/1), golden-checked output from process 0."""
