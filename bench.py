@@ -57,9 +57,13 @@ def bench_config(
     skip_stable: bool = False,
     burnin: int = 0,
     skip_tile_cap: int | None = None,
+    out_stats: dict | None = None,
 ):
     """Time `reps` supersteps of `kturns` generations each; returns
-    (gens_per_sec, cell_updates_per_sec).
+    (gens_per_sec, cell_updates_per_sec).  ``out_stats`` (if given)
+    receives side measurements: ``active_gps``, the fresh-soup rate
+    observed during the pre-burn-in calibration — the number budget
+    sizing needs for runs that ride their own burn-in.
 
     With ``calibrate`` (default), the dispatch depth is grown until one
     dispatch takes ~``target_seconds``: the axon tunnel costs ~20 ms per
@@ -141,6 +145,11 @@ def bench_config(
             board = run(board)
             _sync(board)
             dt = time.perf_counter() - t0
+            if out_stats is not None and "active_gps" not in out_stats:
+                # First timed dispatch = the fresh-soup rate, measured on
+                # THIS hardware (budget sizing must not bake in one chip's
+                # rate).
+                out_stats["active_gps"] = kturns / dt
             if dt >= target_seconds / 2:
                 break
             kturns = min(int(kturns * target_seconds / max(dt, 1e-3)), 1 << 20)
@@ -536,6 +545,7 @@ def main():
 
         skip_eff = pallas_packed.skip_stable_effective((size, size // 32))
 
+    stats: dict = {}
     gps, cups = bench_config(
         size,
         args.kturns,
@@ -544,6 +554,7 @@ def main():
         skip_stable=skip_eff,
         burnin=args.burnin,
         skip_tile_cap=args.skip_tile_cap or None,
+        out_stats=stats,
     )
 
     variant = "-skip" if skip_eff else ""
@@ -567,11 +578,11 @@ def main():
         # compile + a burn-in at the measured-settled superstep, and the
         # steady window is the last 20% of the run.
         if skip_eff:
-            # Fresh-soup adaptive rate estimate for budget sizing: the
-            # kernel is CUPS-flat (~2.4e12 effective cell-updates/s while
-            # everything is active — BASELINE.md), so gens/s scales with
-            # 1/area; 16384² gives ~8.9k, matching the measured 9.5k.
-            active_gps = 2.4e12 / (size * size)
+            # Fresh-soup adaptive rate for budget sizing, measured on this
+            # hardware during the pre-burn-in calibration; fallback to the
+            # CUPS-flat model (~2.4e12 effective cell-updates/s active —
+            # BASELINE.md) only if calibration was skipped.
+            active_gps = stats.get("active_gps") or 2.4e12 / (size * size)
             cp_budget = budget_for(size) + args.burnin / active_gps
             cp_gps, _ = bench_controller_path(
                 size,

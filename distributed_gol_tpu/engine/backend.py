@@ -103,19 +103,35 @@ class Backend:
             self._sharding = halo.board_sharding(self.mesh)
             self.engine_used = self._resolve_sharded(params, shape, (ny, nx))
             if self.engine_used == "pallas-packed":
+                from distributed_gol_tpu.ops import pallas_packed
                 from distributed_gol_tpu.parallel import pallas_halo
 
                 # T-deep halos: one ppermute exchange per launch buys T
                 # generations — the sharded form of temporal blocking.
-                # skip_tile_cap=0 (auto) falls back to the default cap:
-                # the stats/auto-tune loop is single-device-only for now
-                # (see pallas_halo.make_superstep).
-                self._superstep = pallas_halo.make_superstep_bytes(
-                    self.mesh,
-                    params.rule,
-                    skip_stable=params.skip_stable,
-                    skip_tile_cap=params.skip_tile_cap or None,
-                )
+                if params.skip_stable:
+                    # Live skip telemetry, same contract as single-device:
+                    # the per-launch bitmap is summed on device (one
+                    # all-reduce riding the dispatch) and recorded by
+                    # _skip_superstep for Backend.skip_fraction().
+                    self._skip_cap = (
+                        params.skip_tile_cap or pallas_packed._SKIP_TILE_CAP
+                    )
+                    self._skip_fn = pallas_halo.make_superstep_bytes(
+                        self.mesh,
+                        params.rule,
+                        skip_stable=True,
+                        skip_tile_cap=self._skip_cap,
+                        with_stats=True,
+                    )
+                    self._skip_stats = []
+                    self._superstep = self._skip_superstep
+                else:
+                    self._superstep = pallas_halo.make_superstep_bytes(
+                        self.mesh,
+                        params.rule,
+                        skip_stable=False,
+                        skip_tile_cap=params.skip_tile_cap or None,
+                    )
             elif self.engine_used == "packed":
                 from distributed_gol_tpu.parallel import packed_halo
 
@@ -137,13 +153,20 @@ class Backend:
         explicit experiments.  What IS live is the skip fraction
         (:meth:`skip_fraction`), the direct observability the round-2
         verdict asked for."""
-        from distributed_gol_tpu.ops import pallas_packed
-
         new_board, skipped = self._skip_fn(board, turns)
         h, w = self.params.image_height, self.params.image_width
-        total = pallas_packed.adaptive_tile_launches(
-            (h, w // 32), turns, self._skip_cap
-        )
+        if self.mesh is not None:
+            from distributed_gol_tpu.parallel import pallas_halo
+
+            total = pallas_halo.adaptive_strip_launches(
+                (h, w // 32), self.params.mesh_shape, turns, self._skip_cap
+            )
+        else:
+            from distributed_gol_tpu.ops import pallas_packed
+
+            total = pallas_packed.adaptive_tile_launches(
+                (h, w // 32), turns, self._skip_cap
+            )
         if total:
             self._skip_stats.append((skipped, total))
             del self._skip_stats[:-3]

@@ -628,6 +628,10 @@ def adaptive_tile_launches(
     remainder launch is excluded there and here)."""
     if not _tiled_supports(shape):
         return 0
+    # None resolves to the default cap, as make_superstep(skip_stable=True)
+    # resolves it — same-plan contract for every caller.
+    if tile_cap is None:
+        tile_cap = _SKIP_TILE_CAP
     t = launch_turns(shape, turns, tile_cap)
     t, adaptive = skip_plan(t)
     full, _ = divmod(turns, t)

@@ -280,12 +280,41 @@ def _extend_rows(local: jax.Array, pad: int) -> jax.Array:
     return jnp.concatenate([from_north, local, from_south], axis=0)
 
 
+def adaptive_strip_launches(
+    pshape: tuple[int, int],
+    mesh_shape: tuple[int, int],
+    turns: int,
+    tile_cap: int | None,
+) -> int:
+    """How many tile-launches an adaptive sharded dispatch of ``turns``
+    generations performs across ALL devices — the denominator for the
+    skip fraction, from the same plan ``make_superstep`` executes (the
+    remainder launch is excluded there and here; mirrors
+    ``pallas_packed.adaptive_tile_launches``)."""
+    if not supports(pshape, mesh_shape):
+        return 0
+    # Resolve None exactly as make_superstep(skip_stable=True) does, so
+    # the "same plan" contract holds for every caller, not just ones that
+    # pre-resolve the cap.
+    if tile_cap is None:
+        tile_cap = _SKIP_TILE_CAP
+    ny = mesh_shape[0]
+    strip = (pshape[0] // ny, pshape[1])
+    t = launch_turns(strip, turns, tile_cap)
+    t, adaptive = skip_plan(t)
+    full, _ = divmod(turns, t)
+    if not adaptive or not full:
+        return 0
+    return full * ny * (strip[0] // _strip_plan_tile(strip, t, tile_cap))
+
+
 def make_superstep(
     mesh: Mesh,
     rule: LifeRule = CONWAY,
     interpret: bool | None = None,
     skip_stable: bool = False,
     skip_tile_cap: int | None = None,
+    with_stats: bool = False,
 ):
     """``(packed, turns) -> packed`` on the mesh: turns split into launches
     of T = ``launch_turns(strip, turns)`` generations; each launch is one
@@ -299,14 +328,18 @@ def make_superstep(
     the probe (soundness: BASELINE.md; the bitmap is scoped to one
     dispatch's identical-geometry launches, zeroed at dispatch start).
     ``skip_tile_cap`` bounds the adaptive tile height (None = the default
-    ``_SKIP_TILE_CAP``)."""
+    ``_SKIP_TILE_CAP``).  ``with_stats`` returns ``(board, skipped)``
+    where ``skipped`` counts skip-branch tile-launches across all devices
+    and full launches of the dispatch (the replicated result of one
+    all-reduce per launch) — same live-telemetry contract as the
+    single-device kernel."""
     ny = mesh.shape["y"]
     cap = _SKIP_TILE_CAP if (skip_stable and skip_tile_cap is None) else skip_tile_cap
 
     @partial(jax.jit, static_argnames=("turns",))
-    def run(board: jax.Array, turns: int) -> jax.Array:
+    def run(board: jax.Array, turns: int):
         if turns == 0:
-            return board
+            return (board, jnp.int32(0)) if with_stats else board
         ip = _use_interpret() if interpret is None else interpret
         h, wp = board.shape
         strip = (h // ny, wp)
@@ -369,22 +402,31 @@ def make_superstep(
             return step
 
         adaptive_t = skip_stable and _adaptive_eligible(t)
+        skipped = jnp.int32(0)
         if adaptive_t and full:
             grid = strip[0] // _strip_plan_tile(strip, t, cap)
             step_t = make_step(t, adaptive_ok=True)
             # Bitmap zeroed per dispatch: launch 1 probes every tile, so
             # the inheritance proof's same-plan requirement holds.
             st0 = jnp.zeros((ny * grid,), jnp.int32)
-            board, _ = jax.lax.fori_loop(
-                0, full, lambda _, c: step_t(*c), (board, st0)
+
+            def body(_, carry):
+                b, st, sk = carry
+                nb, nst = step_t(b, st)
+                return nb, nst, sk + jnp.sum(nst)
+
+            board, _, skipped = jax.lax.fori_loop(
+                0, full, body, (board, st0, skipped)
             )
         elif full:
             step_t = make_step(t)
             board = jax.lax.fori_loop(0, full, lambda _, b: step_t(b), board)
         if rem:
-            # The remainder launch never consumes the bitmap (different
-            # geometry; BASELINE.md scope restrictions).
+            # The remainder launch never consumes or produces the bitmap
+            # (different geometry; BASELINE.md scope restrictions).
             board = make_step(rem)(board)
+        if with_stats:
+            return board, skipped
         return board
 
     return run
@@ -396,19 +438,26 @@ def make_superstep_bytes(
     interpret: bool | None = None,
     skip_stable: bool = False,
     skip_tile_cap: int | None = None,
+    with_stats: bool = False,
 ):
     """``(board_u8, turns) -> board_u8`` engine-layer drop-in: pack/unpack
-    inside the jit, pinned to the mesh sharding so packing stays local."""
+    inside the jit, pinned to the mesh sharding so packing stays local.
+    ``with_stats`` mirrors :func:`make_superstep`."""
     from distributed_gol_tpu.ops.packed import pack, unpack
     from distributed_gol_tpu.parallel.packed_halo import packed_sharding
 
-    inner = make_superstep(mesh, rule, interpret, skip_stable, skip_tile_cap)
+    inner = make_superstep(
+        mesh, rule, interpret, skip_stable, skip_tile_cap, with_stats
+    )
 
     @partial(jax.jit, static_argnames=("turns",))
-    def run(board: jax.Array, turns: int) -> jax.Array:
+    def run(board: jax.Array, turns: int):
         if turns == 0:
-            return board
+            return (board, jnp.int32(0)) if with_stats else board
         p = jax.lax.with_sharding_constraint(pack(board), packed_sharding(mesh))
+        if with_stats:
+            out, skipped = inner(p, turns)
+            return unpack(out), skipped
         return unpack(inner(p, turns))
 
     return run

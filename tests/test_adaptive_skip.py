@@ -312,6 +312,46 @@ class TestSkipTileCapKnob:
         assert backend.skip_fraction() == 1.0  # all-ash: everything skips
         np.testing.assert_array_equal(backend.fetch(board), want.fetch(wboard))
 
+    def test_sharded_backend_skip_fraction(self):
+        """Live skip telemetry on a device mesh (round-3 parity with the
+        single-device engine): the per-launch bitmap is summed on device,
+        the denominator comes from the strip plan, and results stay
+        bit-identical to the roll engine."""
+        from distributed_gol_tpu.engine.backend import Backend
+        from distributed_gol_tpu.engine.params import Params
+        from distributed_gol_tpu.parallel import pallas_halo
+
+        params = Params(
+            engine="pallas-packed",
+            skip_stable=True,
+            image_width=W,
+            image_height=H,
+            turns=120,
+            superstep=24,
+            mesh_shape=(2, 1),
+        )
+        backend = Backend(params)
+        assert backend.engine_used == "pallas-packed"
+        assert backend.skip_fraction() is None
+        assert (
+            pallas_halo.adaptive_strip_launches(
+                (H, W // 32), (2, 1), 24, backend._skip_cap
+            )
+            > 0
+        )
+        b = blank()
+        b[10:12, 100:102] = 255  # one block: all-ash board
+        board = backend.put(b)
+        want = Backend(Params(engine="roll", image_width=W, image_height=H,
+                              turns=120, superstep=24))
+        wboard = want.put(b)
+        for _ in range(5):
+            board, count = backend.run_turns(board, 24)
+            wboard, wcount = want.run_turns(wboard, 24)
+            assert count == wcount
+        assert backend.skip_fraction() == 1.0  # all-ash: everything skips
+        np.testing.assert_array_equal(backend.fetch(board), want.fetch(wboard))
+
     def test_viewer_dispatch_does_not_poison_skip_stats(self):
         """The fused viewer dispatches jit-close over the DEVICE superstep,
         not the stats-keeping wrapper: tracing the impure wrapper would
