@@ -85,9 +85,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--max-dispatch-seconds", type=float, default=0.25,
                     help="adaptive-superstep target per dispatch; bounds "
                          "keypress latency at ~2x this value")
-    ap.add_argument("--skip-stable", action="store_true",
+    ap.add_argument("--skip-stable", action="store_true", default=None,
                     help="activity-adaptive pallas-packed kernel: period-6-"
-                         "stable tiles (ash) skip their generations, exactly")
+                         "stable tiles (ash) skip their generations, exactly "
+                         "(default: auto — ON for headless multi-generation "
+                         "runs of 100k+ turns on boards where it engages)")
+    ap.add_argument("--no-skip-stable", action="store_false", dest="skip_stable",
+                    help="force the adaptive kernel off (see --skip-stable)")
     ap.add_argument("--skip-tile-cap", type=int, default=0, metavar="ROWS",
                     help="skip-tile granularity for --skip-stable (multiple "
                          "of 8). 0 = the measured-optimal default (1024 "

@@ -54,22 +54,27 @@ class Backend:
                 from distributed_gol_tpu.ops import pallas_packed
 
                 pshape = (shape[0], shape[1] // 32)
-                skip_engages = params.skip_stable and (
+                skip_engages = params.skip_stable_requested() and (
                     pallas_packed.skip_stable_effective(pshape)
                 )
                 if skip_engages and pallas_packed.is_vmem_resident(pshape):
-                    # Dual-eligible board: honouring skip_stable means the
-                    # tiled kernel, abandoning the (much faster when
-                    # active) VMEM-resident path.  The user asked; warn so
-                    # the trade is visible.
-                    import warnings
+                    if params.skip_stable is None:
+                        # AUTO never trades the (much faster when active)
+                        # VMEM-resident fast path for the tiled adaptive
+                        # kernel on a dual-eligible board.
+                        skip_engages = False
+                    else:
+                        # Dual-eligible board: honouring an EXPLICIT
+                        # skip_stable means the tiled kernel.  The user
+                        # asked; warn so the trade is visible.
+                        import warnings
 
-                    warnings.warn(
-                        "skip_stable forces the tiled kernel on a board "
-                        "eligible for the VMEM-resident fast path; unless "
-                        "the board is mostly ash this is slower",
-                        stacklevel=2,
-                    )
+                        warnings.warn(
+                            "skip_stable forces the tiled kernel on a board "
+                            "eligible for the VMEM-resident fast path; unless "
+                            "the board is mostly ash this is slower",
+                            stacklevel=2,
+                        )
                 if skip_engages:
                     # Adaptive kernel with live skip telemetry; cap 0 =
                     # the measured-optimal default (see _skip_superstep).
@@ -86,7 +91,7 @@ class Backend:
                     self._superstep = self._skip_superstep
                 else:
                     self._superstep = pallas_packed.make_superstep_bytes(
-                        params.rule, skip_stable=params.skip_stable
+                        params.rule, skip_stable=False
                     )
             elif self.engine_used == "packed":
                 from distributed_gol_tpu.ops import packed
@@ -108,7 +113,7 @@ class Backend:
 
                 # T-deep halos: one ppermute exchange per launch buys T
                 # generations — the sharded form of temporal blocking.
-                if params.skip_stable:
+                if params.skip_stable_requested():
                     # Live skip telemetry, same contract as single-device:
                     # the per-launch bitmap is summed on device (one
                     # all-reduce riding the dispatch) and recorded by

@@ -57,7 +57,16 @@ class Params:
     # which can cost far more than skipping recovers unless the board is
     # mostly ash; the Backend warns when that trade is being made.
     # Ignored by engines without an adaptive form.
-    skip_stable: bool = False
+    #
+    # None (default) = AUTO: enable for long headless runs (turns ≥
+    # _SKIP_AUTO_TURNS) on boards where the tiled adaptive kernel engages
+    # WITHOUT sacrificing a faster path (never forces dual-eligible
+    # VMEM-resident boards off their fast path).  Rationale: every engine
+    # is bit-identical, the adaptive kernel costs ~3% while a board is
+    # active and wins ~10× once it settles (BASELINE.md) — a long run
+    # should get the measured-best configuration without knowing the knob
+    # exists.  Explicit True/False always wins.
+    skip_stable: bool | None = None
     # Skip-tile granularity for the adaptive kernel, in rows (multiple of
     # 8).  0 (default) = the measured-optimal 1024-row cap: with the
     # round-3 frontier elision, 1024 dominates finer AND coarser caps in
@@ -239,6 +248,27 @@ class Params:
         return (
             max(1, -(-self.image_height // fh)),
             max(1, -(-self.image_width // fw)),
+        )
+
+    # Auto skip_stable engages at or beyond this run length: ~20× the
+    # measured settling time of a 512²-class soup (≈5k turns) and long
+    # enough that the active-phase ~3% cost is dwarfed by the settled-
+    # phase win even if the board settles late.
+    _SKIP_AUTO_TURNS = 100_000
+
+    def skip_stable_requested(self) -> bool:
+        """The resolved skip_stable policy (None = auto).  Auto says yes
+        only for long headless multi-generation runs — per-turn-visible
+        runs can't amortise the adaptive kernel, and short runs never
+        reach the settled regime that pays for it.  The Backend still
+        applies its capability gates (tiled shapes only, never off the
+        VMEM-resident fast path on auto)."""
+        if self.skip_stable is not None:
+            return self.skip_stable
+        return (
+            self.turns >= self._SKIP_AUTO_TURNS
+            and self.no_vis
+            and self.runtime_superstep() != 1
         )
 
     def runtime_superstep(self) -> int:

@@ -425,3 +425,42 @@ def test_gosper_gun_unbounded_growth():
         b[y + 8, x + 60] = 255
     for turns in (30, 62):
         run_both(b, turns)
+
+
+def test_skip_stable_auto_policy():
+    """skip_stable=None (the default) auto-enables for long headless
+    multi-generation runs on tiled boards, never steals the
+    VMEM-resident fast path, and explicit True/False always wins."""
+    from distributed_gol_tpu.engine.backend import Backend
+    from distributed_gol_tpu.engine.params import Params
+
+    base = dict(engine="pallas-packed", image_width=W, image_height=H)
+    auto_long = Params(**base, turns=200_000)
+    assert auto_long.skip_stable_requested()
+    assert Backend(auto_long)._skip_fn is not None  # engaged
+
+    assert not Params(**base, turns=100).skip_stable_requested()
+    assert not Params(
+        **base, turns=200_000, no_vis=False, flip_events="cell"
+    ).skip_stable_requested()  # per-turn visible: can't amortise
+    assert not Params(
+        **base, turns=200_000, skip_stable=False
+    ).skip_stable_requested()  # explicit off wins
+    assert Params(turns=10, skip_stable=True, image_width=W,
+                  image_height=H).skip_stable_requested()
+
+    # Dual-eligible board (VMEM-resident AND tiled): auto declines,
+    # keeping the fast path; explicit True takes it (with a warning).
+    dual = Params(engine="pallas-packed", image_width=4096,
+                  image_height=2048, turns=200_000)
+    assert dual.skip_stable_requested()
+    b = Backend(dual)
+    assert getattr(b, "_skip_fn", None) is None
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        with pytest.raises(UserWarning):
+            Backend(Params(engine="pallas-packed", image_width=4096,
+                           image_height=2048, turns=200_000,
+                           skip_stable=True))
