@@ -577,28 +577,23 @@ def main():
         # run burns through the active phase itself: the budget covers
         # compile + a burn-in at the measured-settled superstep, and the
         # steady window is the last 20% of the run.
+        cp_kwargs = dict(
+            budget_seconds=budget_for(size),
+            superstep=superstep_for(gps),
+            engine=engine,
+        )
         if skip_eff:
             # Fresh-soup adaptive rate for budget sizing, measured on this
             # hardware during the pre-burn-in calibration; fallback to the
             # CUPS-flat model (~2.4e12 effective cell-updates/s active —
             # BASELINE.md) only if calibration was skipped.
             active_gps = stats.get("active_gps") or 2.4e12 / (size * size)
-            cp_budget = budget_for(size) + args.burnin / active_gps
-            cp_gps, _ = bench_controller_path(
-                size,
-                budget_seconds=cp_budget,
-                superstep=superstep_for(gps),
-                engine=engine,
+            cp_kwargs.update(
+                budget_seconds=budget_for(size) + args.burnin / active_gps,
                 skip_stable=True,
                 steady_frac=0.2,
             )
-        else:
-            cp_gps, _ = bench_controller_path(
-                size,
-                budget_seconds=budget_for(size),
-                superstep=superstep_for(gps),
-                engine=engine,
-            )
+        cp_gps, _ = bench_controller_path(size, **cp_kwargs)
         record["controller_path_gps"] = round(cp_gps, 2)
         record["controller_vs_engine"] = round(cp_gps / gps, 4) if gps else 0.0
     if not args.no_verify:
