@@ -180,7 +180,12 @@ class Controller:
             # Detach: park the checkpoint on the session; a new controller
             # resumes it (gol/distributor.go:139-147, broker/broker.go:143-148).
             self._emit(StateChange(turn, State.QUITTING))
-            self.session.pause(True, world=self.backend.fetch(board), turn=turn)
+            self.session.pause(
+                True,
+                world=self.backend.fetch(board),
+                turn=turn,
+                rule=self.params.rule.notation,
+            )
             self._outcome = "detached"
         elif key == "k":
             # Kill the whole system (gol/distributor.go:121-128).
@@ -246,7 +251,12 @@ class Controller:
         failure the peer processes are not guaranteed to enter it — so the
         multi-host controller overrides this to skip checkpointing rather
         than hang alone in a collective (advisor finding, round 2)."""
-        self.session.pause(True, world=self.backend.fetch(board), turn=turn)
+        self.session.pause(
+            True,
+            world=self.backend.fetch(board),
+            turn=turn,
+            rule=self.params.rule.notation,
+        )
         return True
 
     # -- the run (distributor, gol/distributor.go:194-262) ---------------------
@@ -580,7 +590,9 @@ class Controller:
         # turns == 0 the reference skips the broker entirely; otherwise
         # resume iff a paused same-size checkpoint exists.
         if p.turns > 0:
-            ckpt = self.session.check_states(p.image_width, p.image_height)
+            ckpt = self.session.check_states(
+                p.image_width, p.image_height, p.rule.notation
+            )
             if ckpt is not None:
                 return ckpt.world, ckpt.turn
         return self._load_input(), 0

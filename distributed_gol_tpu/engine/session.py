@@ -32,6 +32,13 @@ from distributed_gol_tpu.engine import pgm
 class Checkpoint:
     world: np.ndarray  # uint8 {0,255}, shape (h, w)
     turn: int
+    # Rule notation ("B3/S23") the checkpointed run used — a framework
+    # extension (the reference has exactly one rule, so its CheckStates
+    # matches on size alone): resuming a board under a different rule is a
+    # different simulation, so a mismatch blocks resume exactly like a
+    # size mismatch.  None = unknown (pre-extension checkpoints) matches
+    # anything.
+    rule: str | None = None
 
 
 class Session:
@@ -50,31 +57,57 @@ class Session:
         self._dir = Path(checkpoint_dir) if checkpoint_dir is not None else None
 
     # -- Broker.Pause (broker/broker.go:143-155) ------------------------------
-    def pause(self, paused: bool, world: np.ndarray | None = None, turn: int = 0):
+    def pause(
+        self,
+        paused: bool,
+        world: np.ndarray | None = None,
+        turn: int = 0,
+        rule: str | None = None,
+    ):
         """Set/clear the paused flag; with a world attached this is the 'q'
         checkpoint call (stubs.PauseCall carries World/Turn/Dimension,
-        stubs/stubs.go:31-36)."""
+        stubs/stubs.go:31-36).  ``rule`` records the rule notation so a
+        resume under a different rule is refused (see Checkpoint)."""
         with self._lock:
             self._paused = paused
             if paused and world is not None:
-                self._checkpoint = Checkpoint(np.asarray(world, dtype=np.uint8), turn)
+                self._checkpoint = Checkpoint(
+                    np.asarray(world, dtype=np.uint8), turn, rule
+                )
                 self._persist()
 
     # -- Broker.CheckStates (broker/broker.go:124-141) ------------------------
-    def check_states(self, width: int, height: int) -> Checkpoint | None:
+    def check_states(
+        self, width: int, height: int, rule: str | None = None
+    ) -> Checkpoint | None:
         """Resume negotiation: returns the checkpoint iff paused ∧ the saved
-        world matches (height, width); clears paused as a side effect (the
-        reference broadcasts on its pause cond here,
-        ``broker/broker.go:137-138``)."""
+        world matches (height, width) ∧ the rules agree (both known);
+        clears paused as a side effect (the reference broadcasts on its
+        pause cond here, ``broker/broker.go:137-138``).  A size or rule
+        mismatch leaves the checkpoint parked un-consumed, so a matching
+        controller can still claim it."""
         with self._lock:
             ckpt, paused = self._checkpoint, self._paused
             if ckpt is None and self._dir is not None:
-                loaded = self._load()
-                if loaded is not None:
-                    ckpt, paused = loaded
+                # Refuse from the few-byte sidecar alone when possible: a
+                # mismatch has no side effects, so repeated mismatched
+                # calls must not re-read a multi-GB world PGM each time.
+                meta = self._load_meta()
+                if meta is None or not meta.get("paused", False):
+                    return None
+                mrule = meta.get("rule")
+                if rule is not None and mrule is not None and rule != mrule:
+                    return None
+                mshape = meta.get("shape")
+                if mshape is not None and tuple(mshape) != (height, width):
+                    return None
+                world = pgm.read_pgm(self._world_path)
+                ckpt, paused = Checkpoint(world, int(meta["turn"]), mrule), True
             if not paused or ckpt is None:
                 return None
             if ckpt.world.shape != (height, width):
+                return None
+            if rule is not None and ckpt.rule is not None and rule != ckpt.rule:
                 return None
             # Adopt + consume: clear paused in memory AND on disk, so the
             # checkpoint is resumed exactly once (a second fresh process must
@@ -135,17 +168,21 @@ class Session:
         if self._dir is None or self._checkpoint is None:
             return
         self._dir.mkdir(parents=True, exist_ok=True)
-        self._meta_path.write_text(
-            json.dumps({"turn": self._checkpoint.turn, "paused": paused})
-        )
+        meta = {
+            "turn": self._checkpoint.turn,
+            "paused": paused,
+            "shape": list(self._checkpoint.world.shape),
+        }
+        if self._checkpoint.rule is not None:
+            meta["rule"] = self._checkpoint.rule
+        self._meta_path.write_text(json.dumps(meta))
 
-    def _load(self) -> tuple[Checkpoint, bool] | None:
-        """Read a durable checkpoint; no side effects on session state."""
+    def _load_meta(self) -> dict | None:
+        """Read just the durable checkpoint's sidecar (turn/paused/rule/
+        shape) — the world PGM is read only once the cheap gates pass."""
         if self._dir is None or not self._meta_path.exists():
             return None
-        meta = json.loads(self._meta_path.read_text())
-        world = pgm.read_pgm(self._world_path)
-        return Checkpoint(world, int(meta["turn"])), bool(meta.get("paused", False))
+        return json.loads(self._meta_path.read_text())
 
 
 # The default in-process session: the analog of "the one broker at

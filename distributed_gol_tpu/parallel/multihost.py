@@ -220,7 +220,9 @@ def _run_distributed(params, events, key_presses, session):
     negotiated = None
     if params.turns > 0:
         ckpt = (
-            session.check_states(params.image_width, params.image_height)
+            session.check_states(
+                params.image_width, params.image_height, params.rule.notation
+            )
             if main
             else None
         )

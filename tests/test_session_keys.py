@@ -180,6 +180,41 @@ class TestDetachResume:
         first_tc = [e for e in log if isinstance(e, gol.TurnComplete)][0]
         assert first_tc.completed_turns == 1  # started from turn 0
 
+    def test_resume_requires_matching_rule(self, tmp_path, input_images):
+        """A checkpoint records its rule notation (framework extension: the
+        reference has exactly one rule); resuming under a different rule is
+        a different simulation, so it starts fresh — and, like a size
+        mismatch, leaves the checkpoint parked for a matching controller."""
+        from distributed_gol_tpu.models.life import HIGHLIFE
+
+        session = Session()
+        session.pause(
+            True, world=np.zeros((16, 16), np.uint8), turn=7, rule="B36/S23"
+        )
+        params = make_params(tmp_path, input_images, turns=3, superstep=1)
+        events: queue.Queue = queue.Queue()
+        gol.run(params, events, None, session)  # Conway controller
+        log = drain(events)
+        first_tc = [e for e in log if isinstance(e, gol.TurnComplete)][0]
+        assert first_tc.completed_turns == 1  # fresh start from turn 0
+        # The checkpoint is still claimable by a HighLife controller.
+        ck = session.check_states(16, 16, HIGHLIFE.notation)
+        assert ck is not None and ck.turn == 7
+        # Unknown-rule checkpoints (pre-extension) match any controller.
+        session.pause(True, world=np.zeros((16, 16), np.uint8), turn=4)
+        assert session.check_states(16, 16, "B3/S23") is not None
+
+    def test_durable_checkpoint_records_rule(self, tmp_path, input_images):
+        a = Session(tmp_path / "ckpt")
+        a.pause(
+            True, world=np.zeros((16, 16), np.uint8), turn=9, rule="B36/S23"
+        )
+        b = Session(tmp_path / "ckpt")  # fresh process analog
+        assert b.check_states(16, 16, "B3/S23") is None  # wrong rule
+        c = Session(tmp_path / "ckpt")
+        ck = c.check_states(16, 16, "B36/S23")
+        assert ck is not None and ck.turn == 9 and ck.rule == "B36/S23"
+
     def test_resume_consumed_exactly_once(self, tmp_path, input_images):
         session = Session()
         session.pause(True, world=np.zeros((16, 16), np.uint8), turn=5)
