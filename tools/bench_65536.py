@@ -42,7 +42,25 @@ def main():
     ap.add_argument("--burnin", type=int, default=0,
                     help="evolve N generations before timing (rides the "
                     "adaptive engine when --skip-stable)")
+    ap.add_argument("--load-board", default=None, metavar="NPY",
+                    help="start from a packed uint32 board saved by "
+                    "--save-board instead of the fresh soup; --burnin then "
+                    "EXTENDS that board's evolution (the metric label "
+                    "carries --total-burnin).  Long burn-ins at this size "
+                    "exceed one sitting: split them across runs")
+    ap.add_argument("--save-board", default=None, metavar="NPY",
+                    help="save the post-burn-in packed board for a later "
+                    "--load-board run")
+    ap.add_argument("--total-burnin", type=int, default=None,
+                    help="total generations of evolution behind the loaded "
+                    "board + this run's --burnin (metric label only; "
+                    "defaults to --burnin)")
     args = ap.parse_args()
+    if args.load_board and args.total_burnin is None:
+        # The .npy carries no history; an unlabeled settled board would be
+        # published as a fresh-soup record (~2x faster-looking).
+        ap.error("--load-board requires --total-burnin (the loaded board's "
+                 "total evolution, so the metric label stays truthful)")
 
     import jax
     import jax.numpy as jnp
@@ -58,9 +76,13 @@ def main():
     def _sync(x):
         return np.asarray(jax.device_get(x.ravel()[0]))
 
-    # ~50%-density soup, generated packed on device (random word bits).
-    key = jax.random.key(0)
-    board = jax.random.bits(key, (H, WP), dtype=jnp.uint32)
+    if args.load_board:
+        board = jnp.asarray(np.load(args.load_board))
+        assert board.shape == (H, WP) and board.dtype == jnp.uint32
+    else:
+        # ~50%-density soup, generated packed on device (random word bits).
+        key = jax.random.key(0)
+        board = jax.random.bits(key, (H, WP), dtype=jnp.uint32)
     _sync(board)
 
     if args.skip_stable:
@@ -89,6 +111,9 @@ def main():
             done += args.kturns
         _sync(board)
         log(f"  burn-in: {done} gens in {time.perf_counter() - t0:.1f}s")
+    if args.save_board:
+        np.save(args.save_board, np.asarray(jax.device_get(board)))
+        log(f"  board saved to {args.save_board}")
 
     t0 = time.perf_counter()
     b = board
@@ -121,7 +146,8 @@ def main():
     log(f"  verify vs XLA packed, 18 gens: {'bit-identical' if ok else 'MISMATCH'}")
 
     variant = "-skip" if args.skip_stable else ""
-    burn = f"_burnin{args.burnin}" if args.burnin else ""
+    total_burn = args.total_burnin if args.total_burnin is not None else args.burnin
+    burn = f"_burnin{total_burn}" if total_burn else ""
     record = {
         "metric": f"gol_gens_per_sec_65536x65536_pallas-packed{variant}{burn}_{dev.platform}",
         "value": round(gps, 2),
