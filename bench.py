@@ -115,7 +115,7 @@ def bench_config(
             # The adaptive plan is derived per dispatch depth inside
             # _run_tiled (and calibration may change that depth), so the
             # log names the contract, not a specific T.
-            cap = skip_tile_cap or pallas_packed._SKIP_TILE_CAP
+            cap = skip_tile_cap or pallas_packed.default_skip_cap(size)
             log("  temporal blocking (adaptive plan): period-6-multiple "
                 f"launches, tiles capped at {cap} rows")
         else:

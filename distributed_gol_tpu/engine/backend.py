@@ -77,9 +77,9 @@ class Backend:
                         )
                 if skip_engages:
                     # Adaptive kernel with live skip telemetry; cap 0 =
-                    # the measured-optimal default (see _skip_superstep).
-                    self._skip_cap = (
-                        params.skip_tile_cap or pallas_packed._SKIP_TILE_CAP
+                    # the measured size-aware default (see _skip_superstep).
+                    self._skip_cap = params.skip_tile_cap or (
+                        pallas_packed.default_skip_cap(params.image_height)
                     )
                     self._skip_fn = pallas_packed.make_superstep_bytes(
                         params.rule,
@@ -118,8 +118,10 @@ class Backend:
                     # the per-launch bitmap is summed on device (one
                     # all-reduce riding the dispatch) and recorded by
                     # _skip_superstep for Backend.skip_fraction().
-                    self._skip_cap = (
-                        params.skip_tile_cap or pallas_packed._SKIP_TILE_CAP
+                    self._skip_cap = params.skip_tile_cap or (
+                        pallas_packed.default_skip_cap(
+                            params.image_height // params.mesh_shape[0]
+                        )
                     )
                     self._skip_fn = pallas_halo.make_superstep_bytes(
                         self.mesh,
@@ -150,11 +152,12 @@ class Backend:
     def _skip_superstep(self, board, turns: int):
         """The adaptive pallas-packed engine with live skip telemetry.
 
-        The cap policy is measurement, not tuning: across fresh, 30k-gen
-        and 400k-gen 16384² boards the 1024-row default dominates every
-        regime once frontier elision exists (77.1k vs 73.6k @ 512 vs
-        49.5k @ 2048 gens/s deep-settled — BASELINE.md round-3 update),
-        so ``skip_tile_cap == 0`` simply uses it; the knob remains for
+        The cap policy is measurement, not tuning: at 16384² the 1024-row
+        cap dominates every regime once frontier elision exists (77.1k vs
+        73.6k @ 512 vs 49.5k @ 2048 gens/s deep-settled), while 32768+-row
+        boards/strips measure ~2× better at 512 (65536²: 2,377 vs 1,217 —
+        BASELINE.md round-3 cap notes); ``skip_tile_cap == 0`` resolves to
+        ``pallas_packed.default_skip_cap`` and the knob remains for
         explicit experiments.  What IS live is the skip fraction
         (:meth:`skip_fraction`), the direct observability the round-2
         verdict asked for."""

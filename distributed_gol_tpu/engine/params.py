@@ -68,11 +68,13 @@ class Params:
     # exists.  Explicit True/False always wins.
     skip_stable: bool | None = None
     # Skip-tile granularity for the adaptive kernel, in rows (multiple of
-    # 8).  0 (default) = the measured-optimal 1024-row cap: with the
-    # round-3 frontier elision, 1024 dominates finer AND coarser caps in
-    # every measured regime (fresh, 30k-gen, 400k-gen 16384² boards —
-    # BASELINE.md).  The knob remains for explicit experiments; the live
-    # skip fraction is observable via ``Backend.skip_fraction()``.
+    # 8).  0 (default) = the measured-optimal size-aware cap
+    # (``pallas_packed.default_skip_cap``): 1024 rows up to 16384-class
+    # boards (dominates finer and coarser caps in every measured regime
+    # there), 512 for 32768+-row boards/strips, where finer stripes
+    # confine residual gliders to less area (65536²: 2,377 vs 1,217
+    # gens/s — BASELINE.md).  The knob remains for explicit experiments;
+    # the live skip fraction is observable via ``Backend.skip_fraction()``.
     # Ignored unless skip_stable engages the tiled adaptive kernel.
     skip_tile_cap: int = 0
     # TurnComplete telemetry policy: "per-turn" (the reference contract —

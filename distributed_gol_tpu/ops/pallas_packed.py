@@ -167,11 +167,25 @@ def _tile_for_pad(h: int, wp: int, pad: int, tile_cap: int | None = None) -> int
 # Tile-height cap for the adaptive (skip_stable) plan: 16384² gets 16
 # stripes instead of 4, so a roaming glider only un-skips 1/16 of the
 # board; costs ~9% halo redundancy vs ~3% for the plain plan.  This is
-# what `Params.skip_tile_cap == 0` resolves to — measured dominant over
-# both finer (512: more per-tile DMA launches) and coarser (2048: more
-# un-skipping around residual activity) caps in every regime once the
-# frontier elision exists (BASELINE.md round-3 cap table).
+# what `Params.skip_tile_cap == 0` resolves to — at 16384² measured
+# dominant over both finer (512: more per-tile DMA launches) and coarser
+# (2048: more un-skipping around residual activity) caps in every regime
+# once the frontier elision exists (BASELINE.md round-3 cap table).
 _SKIP_TILE_CAP = 1024
+# …but the optimum is size-dependent: at 65536² the settled board's
+# residual gliders un-skip 12 of 64 stripes at cap 1024 (skip fraction
+# plateau 0.8125 → 1,217 gens/s), while cap 512's 128 stripes confine
+# the same gliders to a smaller area (0.883 → 2,377 gens/s, +95%;
+# cap 256 backslides to 1,945 on per-stripe overhead).  Boards tall
+# enough pick the finer cap.
+_SKIP_TILE_CAP_TALL = 512
+_TALL_ROWS = 32768
+
+
+def default_skip_cap(h: int) -> int:
+    """The measured-optimal adaptive tile cap for an ``h``-row board (or
+    per-device strip) — what ``skip_tile_cap in (0, None)`` resolves to."""
+    return _SKIP_TILE_CAP_TALL if h >= _TALL_ROWS else _SKIP_TILE_CAP
 # Stability period the adaptive kernel proves per launch: 6 = lcm(2, 3)
 # covers still lifes + period-2 oscillators + pulsars (see _kernel).
 _SKIP_PERIOD = 6
@@ -589,13 +603,13 @@ def make_superstep(
     mostly-stable regions and costs a few % while everything is active.
 
     ``skip_tile_cap`` bounds the adaptive tile height (None = the
-    balanced default ``_SKIP_TILE_CAP``); ``with_stats`` makes the
+    measured size-aware default, ``default_skip_cap``); ``with_stats`` makes the
     returned fn yield ``(board, skipped_tiles)`` — the Backend's cap
     auto-tune signal.  The denominator (`adaptive_tile_launches`) is a
     host-side computation so the caller never has to force a device
     value just to know the launch count.
     """
-    cap = _SKIP_TILE_CAP if (skip_stable and skip_tile_cap is None) else skip_tile_cap
+    cap = skip_tile_cap
 
     @partial(jax.jit, static_argnames=("turns",))
     def run(board: jax.Array, turns: int):
@@ -628,10 +642,10 @@ def adaptive_tile_launches(
     remainder launch is excluded there and here)."""
     if not _tiled_supports(shape):
         return 0
-    # None resolves to the default cap, as make_superstep(skip_stable=True)
-    # resolves it — same-plan contract for every caller.
+    # None resolves to the size-aware default cap, as _run_tiled resolves
+    # it — same-plan contract for every caller.
     if tile_cap is None:
-        tile_cap = _SKIP_TILE_CAP
+        tile_cap = default_skip_cap(shape[0])
     t = launch_turns(shape, turns, tile_cap)
     t, adaptive = skip_plan(t)
     full, _ = divmod(turns, t)
@@ -650,7 +664,10 @@ def _run_tiled(
     with_stats: bool = False,
 ):
     shape = board.shape
-    cap = tile_cap if skip_stable else None
+    if skip_stable:
+        cap = tile_cap if tile_cap is not None else default_skip_cap(shape[0])
+    else:
+        cap = None
     t = launch_turns(shape, turns, cap)
     adaptive = False
     if skip_stable:
@@ -698,7 +715,7 @@ def make_superstep_bytes(
     pass each way around the kernel — VMEM-resident boards go straight to
     the vertical layout (no intermediate horizontal round trip).  The
     ``skip_tile_cap`` / ``with_stats`` knobs mirror ``make_superstep``."""
-    cap = _SKIP_TILE_CAP if (skip_stable and skip_tile_cap is None) else skip_tile_cap
+    cap = skip_tile_cap
 
     @partial(jax.jit, static_argnames=("turns",))
     def run(board: jax.Array, turns: int):

@@ -46,8 +46,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from distributed_gol_tpu.models.life import CONWAY, LifeRule
 from distributed_gol_tpu.ops.pallas_packed import (
     _LANES,
-    _SKIP_TILE_CAP,
     _adaptive_eligible,
+    default_skip_cap,
     _advance_window,
     _compiler_params,
     _elide_or_probe,
@@ -293,13 +293,13 @@ def adaptive_strip_launches(
     ``pallas_packed.adaptive_tile_launches``)."""
     if not supports(pshape, mesh_shape):
         return 0
-    # Resolve None exactly as make_superstep(skip_stable=True) does, so
-    # the "same plan" contract holds for every caller, not just ones that
-    # pre-resolve the cap.
-    if tile_cap is None:
-        tile_cap = _SKIP_TILE_CAP
     ny = mesh_shape[0]
     strip = (pshape[0] // ny, pshape[1])
+    # Resolve None exactly as make_superstep(skip_stable=True) does (from
+    # the per-device STRIP height), so the "same plan" contract holds for
+    # every caller, not just ones that pre-resolve the cap.
+    if tile_cap is None:
+        tile_cap = default_skip_cap(strip[0])
     t = launch_turns(strip, turns, tile_cap)
     t, adaptive = skip_plan(t)
     full, _ = divmod(turns, t)
@@ -328,13 +328,14 @@ def make_superstep(
     the probe (soundness: BASELINE.md; the bitmap is scoped to one
     dispatch's identical-geometry launches, zeroed at dispatch start).
     ``skip_tile_cap`` bounds the adaptive tile height (None = the default
-    ``_SKIP_TILE_CAP``).  ``with_stats`` returns ``(board, skipped)``
+    measured size-aware default from the strip height,
+    ``pallas_packed.default_skip_cap``).  ``with_stats`` returns ``(board, skipped)``
     where ``skipped`` counts skip-branch tile-launches across all devices
     and full launches of the dispatch (the replicated result of one
     all-reduce per launch) — same live-telemetry contract as the
     single-device kernel."""
     ny = mesh.shape["y"]
-    cap = _SKIP_TILE_CAP if (skip_stable and skip_tile_cap is None) else skip_tile_cap
+    raw_cap = skip_tile_cap
 
     @partial(jax.jit, static_argnames=("turns",))
     def run(board: jax.Array, turns: int):
@@ -343,6 +344,11 @@ def make_superstep(
         ip = _use_interpret() if interpret is None else interpret
         h, wp = board.shape
         strip = (h // ny, wp)
+        cap = (
+            (raw_cap if raw_cap is not None else default_skip_cap(strip[0]))
+            if skip_stable
+            else None
+        )
         t = launch_turns(
             strip, turns, cap if skip_stable else None
         )  # clamps to _MAX_T internally

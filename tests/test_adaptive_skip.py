@@ -296,7 +296,7 @@ class TestSkipTileCapKnob:
         )
         backend = Backend(params)
         assert backend.engine_used == "pallas-packed"
-        assert backend._skip_cap == pallas_packed._SKIP_TILE_CAP
+        assert backend._skip_cap == pallas_packed.default_skip_cap(H)
         assert backend.skip_fraction() is None
         b = blank()
         b[10:12, 100:102] = 255
@@ -308,7 +308,7 @@ class TestSkipTileCapKnob:
             board, count = backend.run_turns(board, 24)
             wboard, wcount = want.run_turns(wboard, 24)
             assert count == wcount
-        assert backend._skip_cap == pallas_packed._SKIP_TILE_CAP  # no tuning
+        assert backend._skip_cap == pallas_packed.default_skip_cap(H)  # no tuning
         assert backend.skip_fraction() == 1.0  # all-ash: everything skips
         np.testing.assert_array_equal(backend.fetch(board), want.fetch(wboard))
 

@@ -131,7 +131,7 @@ def main():
         # recorded fraction describes the benchmarked launch plan.
         _, skipped = superstep(b, args.kturns)
         total = pallas_packed.adaptive_tile_launches(
-            b.shape, args.kturns, pallas_packed._SKIP_TILE_CAP
+            b.shape, args.kturns, pallas_packed.default_skip_cap(b.shape[0])
         )
         if total:
             skip_frac = round(int(skipped) / total, 4)

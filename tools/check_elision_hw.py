@@ -34,7 +34,7 @@ def main(size=16384, dispatches=4, kturns=1008):
         _sync(a)
         dt = time.perf_counter() - t0
         total = pallas_packed.adaptive_tile_launches(
-            a.shape, kturns, pallas_packed._SKIP_TILE_CAP
+            a.shape, kturns, pallas_packed.default_skip_cap(a.shape[0])
         )
         frac = int(skipped) / total if total else float("nan")
         log(
