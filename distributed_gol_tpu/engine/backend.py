@@ -50,6 +50,7 @@ class Backend:
             self.mesh = None
             self._sharding = None
             self.engine_used = self._resolve_single(params, shape)
+            self._warn_if_downgraded(params, shape, (ny, nx))
             if self.engine_used == "pallas-packed":
                 from distributed_gol_tpu.ops import pallas_packed
 
@@ -107,6 +108,7 @@ class Backend:
             self.mesh = mesh_lib.make_mesh((ny, nx), devices)
             self._sharding = halo.board_sharding(self.mesh)
             self.engine_used = self._resolve_sharded(params, shape, (ny, nx))
+            self._warn_if_downgraded(params, shape, (ny, nx))
             if self.engine_used == "pallas-packed":
                 from distributed_gol_tpu.ops import pallas_packed
                 from distributed_gol_tpu.parallel import pallas_halo
@@ -181,16 +183,69 @@ class Backend:
         return new_board
 
     def skip_fraction(self) -> float | None:
-        """The most recent safely-resolved per-dispatch skip fraction (the
-        share of tile-launches that took the skip branch, elisions
-        included), or None before enough dispatches have run.  Only counts
-        ≥ 2 dispatches old are forced — the pipelined controller keeps at
-        most one dispatch in flight, so reading this never stalls it."""
+        """The most recent safely-resolved per-dispatch skip fraction, or
+        None before enough dispatches have run.  Semantics (deliberate,
+        advisor round 3): the numerator sums the stability bitmap *after*
+        each launch — i.e. the share of tile-launches whose tiles stand
+        PROVED stable at that launch boundary, elisions included — not the
+        share that executed the skip branch this launch.  The two differ
+        only by the launch that proves a tile (an all-ash board reads 1.0
+        instead of (full-1)/full); counting proved-stable tiles keeps the
+        telemetry series comparable across the recorded BENCH/BASELINE
+        artifacts.  Only counts ≥ 2 dispatches old are forced — the
+        pipelined controller keeps at most one dispatch in flight, so
+        reading this never stalls it."""
         stats = getattr(self, "_skip_stats", None)
         if not stats or len(stats) < 3:
             return None
         skipped, total = stats[-3]
         return int(skipped) / total
+
+    # Speed tier of each engine; a capability fallback moves DOWN this
+    # ranking (all engines are bit-identical, so only speed is at stake —
+    # but the gap is up to ~80x at 16384², which must not be silent).
+    _ENGINE_RANK = {"roll": 0, "pallas": 1, "packed": 2, "pallas-packed": 3}
+
+    def _warn_if_downgraded(self, params: Params, shape, mesh_shape):
+        """One stderr line whenever the engine that will actually run is a
+        slower tier than what was requested (explicit engine) or what
+        'auto' aims for before capability gates.  Policy choices 'auto'
+        makes deliberately (per-turn-visible runs prefer roll; packed on
+        non-TPU backends where the Pallas kernel doesn't lower) are not
+        downgrades and stay silent.  Round-3 verdict: the silent
+        pallas-packed -> packed -> roll degrade in ``_resolve_sharded``
+        could cost ~80x at 16384² with only ``engine_used`` recording it."""
+        import warnings
+
+        if params.engine == "auto":
+            if params.runtime_superstep() == 1:
+                return  # roll preferred deliberately: nothing to warn about
+            if shape[1] % 32:
+                # No packed-family engine can ever take this width; roll is
+                # the right engine for such boards (16², 48-wide...), not a
+                # degraded one — the README matrix documents the bound.
+                return
+            preferred = (
+                "pallas-packed"
+                if jax.default_backend() == "tpu"
+                else "packed"
+            )
+            if self._ENGINE_RANK[self.engine_used] >= self._ENGINE_RANK[preferred]:
+                return
+            requested = f"auto (prefers '{preferred}' here)"
+        else:
+            if self.engine_used == params.engine:
+                return
+            preferred = params.engine
+            requested = f"'{params.engine}'"
+        warnings.warn(
+            f"engine {requested} cannot run "
+            f"{shape[1]}x{shape[0]} on mesh {mesh_shape[0]}x{mesh_shape[1]}; "
+            f"falling back to '{self.engine_used}' (bit-identical but a "
+            f"slower tier — see the README engine x mesh capability matrix)",
+            RuntimeWarning,
+            stacklevel=3,
+        )
 
     @staticmethod
     def _packed_kernel_upgrade(params: Params, supports_fn) -> bool:

@@ -79,3 +79,31 @@ def test_2d_mesh_column():
 def test_unsupported_per_device_width_falls_to_roll():
     # 4104 / 4 = 1026, not a multiple of 32 -> word halos unsupported.
     assert used("packed", mesh=(1, 4), width=4104, height=64) == "roll"
+
+
+# --- fallback visibility (round-3 verdict, weak-5) -------------------------
+
+
+def test_explicit_engine_downgrade_warns():
+    with pytest.warns(RuntimeWarning, match="falling back to 'roll'"):
+        used("packed", width=200)
+    with pytest.warns(RuntimeWarning, match="falling back to 'packed'"):
+        used("pallas-packed", width=640)
+    with pytest.warns(RuntimeWarning, match="capability matrix"):
+        used("pallas", width=200)
+
+
+def test_auto_downgrade_warns_on_packable_widths():
+    # Global width word-aligned (4128 % 32 == 0) but the per-device strip
+    # (1032) is not: auto wanted packed, got roll — the scenario the
+    # round-3 verdict flagged as silent.
+    with pytest.warns(RuntimeWarning, match="falling back to 'roll'"):
+        used("auto", mesh=(1, 4), width=4128, height=64)
+
+
+def test_no_warning_when_engine_honoured_or_policy(recwarn):
+    used("packed")  # honoured exactly
+    used("auto")  # CPU auto prefers packed and gets it
+    used("auto", width=200)  # width unpackable by design: policy, not downgrade
+    used("auto", no_vis=False, flip_events="cell")  # per-turn roll is policy
+    assert not [w for w in recwarn if w.category is RuntimeWarning]
