@@ -419,6 +419,10 @@ def make_superstep(
             def body(_, carry):
                 b, st, sk = carry
                 nb, nst = step_t(b, st)
+                # Post-launch bitmap by design: the telemetry counts tiles
+                # PROVED stable at each launch boundary, not executed skip
+                # branches (Backend.skip_fraction documents the trade) —
+                # same accumulation as the single-device engine.
                 return nb, nst, sk + jnp.sum(nst)
 
             board, _, skipped = jax.lax.fori_loop(

@@ -77,8 +77,16 @@ def main():
         return np.asarray(jax.device_get(x.ravel()[0]))
 
     if args.load_board:
-        board = jnp.asarray(np.load(args.load_board))
-        assert board.shape == (H, WP) and board.dtype == jnp.uint32
+        loaded = np.load(args.load_board)
+        # Not an assert: under `python -O` a wrong-shape .npy would sail
+        # through and die later in an opaque kernel/sharding error.
+        if loaded.shape != (H, WP) or loaded.dtype != np.uint32:
+            raise SystemExit(
+                f"--load-board {args.load_board}: want a packed uint32 "
+                f"board of shape ({H}, {WP}), got {loaded.dtype} "
+                f"{loaded.shape}"
+            )
+        board = jnp.asarray(loaded)
     else:
         # ~50%-density soup, generated packed on device (random word bits).
         key = jax.random.key(0)
