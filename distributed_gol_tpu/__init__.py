@@ -31,6 +31,7 @@ from distributed_gol_tpu.engine.events import (
     CycleDetected,
     DispatchError,
     Event,
+    EventQueue,
     FinalTurnComplete,
     FrameReady,
     ImageOutputComplete,
@@ -50,6 +51,7 @@ __all__ = [
     "CycleDetected",
     "DispatchError",
     "Event",
+    "EventQueue",
     "FinalTurnComplete",
     "FrameReady",
     "ImageOutputComplete",
@@ -63,4 +65,4 @@ __all__ = [
     "start",
 ]
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"

@@ -177,7 +177,12 @@ def _drive(args, params, start_engine) -> int:
     """The controller-process tail shared by single-host and multi-host
     entries: keyboard listener, viewer/drain loop, Ctrl-C → graceful 'q'
     detach, optional profiler trace, final print + exit code."""
-    events: queue.Queue = queue.Queue()
+    # EventQueue: per-turn TurnComplete streams cost one queue entry per
+    # dispatch instead of one per generation (consumer-side expansion keeps
+    # the exact reference stream) — the CLI should ride the fast path.
+    from distributed_gol_tpu.engine.events import EventQueue
+
+    events: queue.Queue = EventQueue()
     key_presses: queue.Queue = queue.Queue()
     stop = threading.Event()
     restore_tty = keyboard_listener(key_presses, stop)
@@ -218,16 +223,13 @@ def _drive(args, params, start_engine) -> int:
 def run_multihost(args, params, session) -> int:
     """Multi-host entry: same CLI on every host, ``--process-id`` 0 drives.
 
-    Headless with an explicit --superstep (run_distributed's contract);
-    process 0 keeps the interactive keyboard (s/p/q/k broadcast to all)."""
+    Headless only; --superstep 0 (adaptive) works — process 0 decides the
+    dispatch size and broadcasts it (run_distributed's contract).  Process
+    0 keeps the interactive keyboard (s/p/q/k broadcast to all)."""
     from distributed_gol_tpu.parallel import multihost
 
     if not params.no_vis:
         print("error: multi-host runs are headless; pass -noVis",
-              file=sys.stderr)
-        return 2
-    if params.superstep <= 0:
-        print("error: multi-host runs need an explicit --superstep",
               file=sys.stderr)
         return 2
     multihost.initialize(args.coordinator, args.num_processes, args.process_id)

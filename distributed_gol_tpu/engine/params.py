@@ -78,13 +78,16 @@ class Params:
     # Ignored unless skip_stable engages the tiled adaptive kernel.
     skip_tile_cap: int = 0
     # TurnComplete telemetry policy: "per-turn" (the reference contract —
-    # one TurnComplete per generation, ``gol/event.go:53-58`` — at one
-    # queue.put per turn) | "batch" (one TurnsCompleted(first, last) per
-    # device dispatch).  Per-turn puts bound a headless ``gol.run()`` at
-    # Python queue throughput (≲0.5M puts/s), far below the engine's own
-    # gens/s on small/mid boards; batch mode removes that bound while
-    # keeping exact turn accounting.  Viewer-fed runs (flips/frames) are
-    # per-turn by construction and ignore this knob.
+    # one TurnComplete per generation, ``gol/event.go:53-58``) | "batch"
+    # (one TurnsCompleted(first, last) per device dispatch).  Per-turn
+    # events cost one queue.put per generation on a plain queue.Queue,
+    # bounding a headless ``gol.run()`` at Python queue throughput — pass
+    # an ``EventQueue`` as the events queue (the CLI does) and the
+    # controller enqueues each dispatch's TurnComplete range as ONE entry,
+    # re-expanded per-turn on the consumer side.  Batch mode removes the
+    # per-turn consumption cost too while keeping exact turn accounting.
+    # Viewer-fed runs (flips/frames) are per-turn by construction and
+    # ignore this knob.
     turn_events: str = "per-turn"
     # CellFlipped emission policy: "auto" (per-cell when a viewer is attached
     # i.e. not no_vis, off headless), "cell" (always, reference contract),

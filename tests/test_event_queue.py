@@ -1,0 +1,120 @@
+"""EventQueue: the per-dispatch batched transport for per-turn TurnComplete
+streams (round-3 verdict, weak-3: one ``queue.Queue.put`` per generation
+bounded the reference-exact path at 14% of the engine rate at 512²).
+
+The contract under test: a consumer draining an :class:`EventQueue` sees the
+EXACT per-turn reference stream (``gol/event.go:53-58``) — same events, same
+order — while the producer pays one queue entry per dispatch.  A plain
+``queue.Queue`` keeps the per-event puts (drop-in compatibility), so the two
+streams must be indistinguishable.
+"""
+
+import queue
+import tempfile
+
+import pytest
+
+from distributed_gol_tpu.engine.events import (
+    AliveCellsCount,
+    EventQueue,
+    FinalTurnComplete,
+    StateChange,
+    TurnComplete,
+    TurnTiming,
+)
+from distributed_gol_tpu.engine.gol import run
+from distributed_gol_tpu.engine.params import Params
+from distributed_gol_tpu.engine.session import Session
+
+
+def drain(events):
+    out = []
+    while (e := events.get(timeout=30)) is not None:
+        out.append(e)
+    return out
+
+
+class TestEventQueueUnit:
+    def test_single_turn_and_range_expand_in_order(self):
+        q = EventQueue()
+        q.put_turns(5, 5)
+        q.put(AliveCellsCount(5, 10))
+        q.put_turns(6, 9)
+        q.put(None)
+        got = drain(q)
+        assert got[0] == TurnComplete(5)
+        assert got[1] == AliveCellsCount(5, 10)
+        assert got[2:] == [TurnComplete(t) for t in range(6, 10)]
+
+    def test_empty_reflects_pending_expansion(self):
+        q = EventQueue()
+        q.put_turns(1, 3)
+        assert not q.empty()
+        assert q.get(block=False) == TurnComplete(1)
+        # Two expansions still pending: the queue must not look drained.
+        assert not q.empty()
+        assert q.get(block=False) == TurnComplete(2)
+        assert q.get(block=False) == TurnComplete(3)
+        assert q.empty()
+        with pytest.raises(queue.Empty):
+            q.get(block=False)
+
+    def test_inverted_range_is_a_noop(self):
+        q = EventQueue()
+        q.put_turns(4, 3)
+        assert q.empty()
+
+    def test_task_done_join_with_canonical_consumer(self):
+        # The standard `get(); ...; task_done()` worker pattern must keep
+        # working although a range is ONE underlying entry: surplus
+        # task_done calls from expanded events are absorbed.
+        q = EventQueue()
+        q.put_turns(1, 5)
+        q.put(AliveCellsCount(5, 7))
+        for _ in range(6):  # 5 expanded TurnCompletes + 1 plain event
+            q.get(block=False)
+            q.task_done()
+        q.join()  # returns immediately: all entries accounted
+        with pytest.raises(ValueError):
+            q.task_done()  # a 7th call is still an error, as on queue.Queue
+
+
+def _stream(events_queue, turns=20, **kw):
+    kw.setdefault("cycle_check", 0)
+    p = Params(
+        turns=turns,
+        image_width=64,
+        image_height=64,
+        images_dir="/root/reference/images",
+        out_dir=tempfile.mkdtemp(prefix="gol_evq_"),
+        **kw,
+    )
+    run(p, events_queue, session=Session())
+    return drain(events_queue)
+
+
+def _comparable(stream):
+    """Ticker events and timings are wall-clock-dependent; everything else
+    must match between transports."""
+    return [e for e in stream if not isinstance(e, (AliveCellsCount, TurnTiming))]
+
+
+class TestEventQueueStreamParity:
+    def test_headless_per_turn_stream_identical_to_plain_queue(self):
+        plain = _comparable(_stream(queue.Queue()))
+        fast = _comparable(_stream(EventQueue()))
+        assert plain == fast
+        # And the stream is the reference contract: dense TurnComplete then
+        # the final events.
+        assert [e for e in fast if isinstance(e, TurnComplete)] == [
+            TurnComplete(t) for t in range(1, 21)
+        ]
+        assert isinstance(fast[-2], FinalTurnComplete)
+        assert isinstance(fast[-1], StateChange)
+
+    def test_cycle_fast_forward_stream_identical(self):
+        # 64² settles well inside 1000 turns; the fast-forward's chunked
+        # emission must expand to the same dense stream.
+        plain = _comparable(_stream(queue.Queue(), turns=1000, cycle_check=4))
+        fast = _comparable(_stream(EventQueue(), turns=1000, cycle_check=4))
+        assert plain == fast
