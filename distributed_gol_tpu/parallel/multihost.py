@@ -181,15 +181,13 @@ def run_distributed(params, events=None, key_presses=None, session=None):
     and broadcast, because ``check_states`` is consume-once: letting every
     process ask would hand the checkpoint to whichever asked first and
     start the rest from turn 0, diverging the SPMD schedule.
-    ``params.superstep`` must be explicit (> 0): all processes must agree
-    on the dispatch schedule without exchanging wall-clock.
+    ``params.superstep`` may be 0 (adaptive): the sizing decision is
+    wall-clock-driven, so process 0 decides and broadcasts the next size
+    (one scalar broadcast per resolved dispatch — the same cadence as the
+    keypress broadcast) and every process runs the identical dispatch
+    schedule.  The auto ``skip_stable`` long-run policy rides on this: it
+    resolves from Params alone, identically everywhere.
     """
-    if params.superstep <= 0:
-        raise ValueError(
-            "multi-host runs need an explicit superstep: the adaptive "
-            "dispatch sizing is wall-clock-driven and would diverge "
-            "between processes"
-        )
     if not params.no_vis or params.wants_flips() or params.wants_frames():
         raise ValueError("multi-host runs are headless (no_vis=True)")
 
@@ -284,5 +282,23 @@ def _run_distributed(params, events, key_presses, session):
             # schedules, a hang.  Abort with the stream sentinel instead
             # (same policy as _park_checkpoint above).
             return bool(flag)
+
+        def _next_superstep(self, k, dt, superstep, warm_sizes, cap):
+            # Deterministic adaptive sizing (round-3 verdict, missing-3):
+            # dt is local wall-clock — the one input that differs between
+            # processes — so process 0 makes the decision and broadcasts
+            # it.  Every process reaches this call at the same point of
+            # the dispatch schedule (the call sites are schedule-
+            # deterministic), so the broadcast lines up like every other
+            # collective.  Process 0's warm_sizes gating rides inside its
+            # base-class call; followers' warm_sizes stay empty, which is
+            # fine — they never decide.
+            if main:
+                superstep = super()._next_superstep(
+                    k, dt, superstep, warm_sizes, cap
+                )
+            return int(
+                multihost_utils.broadcast_one_to_all(np.int32(superstep))
+            )
 
     MultihostController(params, ev, keys, session, backend).run()

@@ -38,6 +38,8 @@ def main():
         return controller_main(coordinator, nprocs, pid, okfile, sys.argv[6])
     if mode == "cycle":
         return cycle_main(coordinator, nprocs, pid, okfile, sys.argv[6])
+    if mode == "adaptive":
+        return adaptive_main(coordinator, nprocs, pid, okfile, sys.argv[6])
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -285,6 +287,85 @@ def cycle_main(coordinator, nprocs, pid, okfile, out_dir):
     with open(okfile, "w") as f:
         f.write("ok")
     print(f"[{pid}] multi-host cycle fast-forward ok ({turns} turns)", flush=True)
+
+
+def adaptive_main(coordinator, nprocs, pid, okfile, out_dir):
+    """Adaptive superstep (superstep=0) + the auto skip_stable long-run
+    policy across processes (round-3 verdict, missing-3): the dispatch
+    size is wall-clock-driven, so process 0's doubling/halving decisions
+    are broadcast and every process runs the identical schedule — proved
+    by the run completing (a divergent schedule wedges a collective and
+    times the test out) and by the final PGM being byte-identical to a
+    single-device run.  turns=10^6 makes ``skip_stable=None`` resolve to
+    the auto long-run policy on every process; the 64² board settles near
+    turn 1.6k, so the (collective, dispatch-count-scheduled) cycle probe
+    bounds the wall-clock."""
+    import queue
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import distributed_gol_tpu as gol
+    from distributed_gol_tpu.parallel import multihost
+
+    multihost.initialize(coordinator, nprocs, pid)
+    my_out = os.path.join(out_dir, f"p{pid}")
+    os.makedirs(my_out, exist_ok=True)
+    turns = 10**6
+    params = gol.Params(
+        turns=turns,
+        image_width=64,
+        image_height=64,
+        images_dir="/root/reference/images",
+        out_dir=my_out,
+        superstep=0,  # adaptive: the thing under test
+        skip_stable=None,  # auto: resolves to the long-run policy
+        max_dispatch_seconds=0.02,  # exercise growth AND the 1.5x shrink guard
+        turn_events="batch",
+        ticker_period=60.0,
+    )
+    assert params.skip_stable_requested(), "auto policy should engage here"
+    if pid == 0:
+        events: queue.Queue = queue.Queue()
+        seen = []
+
+        def pump():
+            while (e := events.get(timeout=120)) is not None:
+                seen.append(e)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        multihost.run_distributed(params, events)
+        t.join(timeout=30)
+
+        final = [e for e in seen if isinstance(e, gol.FinalTurnComplete)][0]
+        assert final.completed_turns == turns
+        assert len(final.alive) == 101  # check/alive/64x64.csv steady state
+
+        # Single-device comparison run, same adaptive params: dispatch
+        # partitioning never changes results, so byte-identity holds even
+        # though the schedules differ.
+        single_out = os.path.join(out_dir, "single")
+        os.makedirs(single_out, exist_ok=True)
+        from dataclasses import replace
+
+        ev2: queue.Queue = queue.Queue()
+        gol.run(replace(params, out_dir=single_out), ev2)
+        while ev2.get(timeout=120) is not None:
+            pass
+        got = open(f"{my_out}/64x64x{turns}.pgm", "rb").read()
+        want = open(f"{single_out}/64x64x{turns}.pgm", "rb").read()
+        assert got == want, "adaptive multi-host differs from single-device"
+    else:
+        multihost.run_distributed(params)
+        assert not os.listdir(my_out), "follower wrote files"
+
+    with open(okfile, "w") as f:
+        f.write("ok")
+    print(f"[{pid}] adaptive multi-host run ok ({turns} turns, superstep=0)",
+          flush=True)
 
 
 if __name__ == "__main__":

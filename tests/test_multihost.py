@@ -77,6 +77,16 @@ def test_two_process_cycle_fast_forward(tmp_path):
     _launch_workers(tmp_path, "cycle", extra=(str(out),))
 
 
+def test_two_process_adaptive_superstep(tmp_path):
+    """superstep=0 (adaptive) + auto skip_stable policy across processes:
+    process 0's wall-clock-driven sizing decisions are broadcast so the
+    dispatch schedule stays identical everywhere; final PGM byte-identical
+    to a single-device adaptive run (see multihost_worker.adaptive_main)."""
+    out = tmp_path / "out"
+    out.mkdir()
+    _launch_workers(tmp_path, "adaptive", extra=(str(out),))
+
+
 def test_cli_multihost_run(tmp_path):
     """The CLI's multi-host mode: the same command on two 'hosts'
     (--process-id 0/1), golden-checked output from process 0."""
