@@ -546,7 +546,12 @@ def main():
                 bench_config(s, args.kturns, pick_engine(args.engine, s), args.reps)
 
     record = measure_record(args, size, engine, args.skip_stable, args.burnin, dev)
-    if not args.skip_stable and not args.burnin and engine == "pallas-packed":
+    if (
+        not args.skip_stable
+        and not args.burnin
+        and engine == "pallas-packed"
+        and dev.platform != "cpu"  # interpret-mode burn-ins would hang CI
+    ):
         from distributed_gol_tpu.ops import pallas_packed
 
         if pallas_packed.skip_stable_effective((size, size // 32)):

@@ -142,7 +142,9 @@ def _compiler_params(
     cond branches live — measured ~1.5× the plain kernel's stack — so it
     gets a larger factor over the same launch plan."""
     ws = _PLANES * (tile_h + 2 * pad) * wp * 4
-    factor = 2.0 if skip_stable else 1.3
+    # Adaptive: + the probe/merge scratch windows (2 extra planes) for the
+    # active-row windowed compute.
+    factor = 2.5 if skip_stable else 1.3
     return pltpu.CompilerParams(
         vmem_limit_bytes=min(120 << 20, int(ws * factor) + (8 << 20))
     )
@@ -339,19 +341,27 @@ def _advance_window(tile0, tile_h: int, pad: int, turns: int, rule, skip_stable)
     return _probe_window(tile0, tile_h, pad, turns, rule)[0]
 
 
+def _probe_state(tile0, h_ext: int, rule):
+    """The probe invariant, one home for every adaptive tier: advance the
+    window p = ``_SKIP_PERIOD`` generations and compare with gen 0 on the
+    probe-valid inner rows [p, h_ext - p) — via an iota mask, since Mosaic
+    has no unaligned-slice lowering (the mask is launch-overhead only).
+    Returns (gen-p window, diff, inner mask, stable flag)."""
+    tp = jax.lax.fori_loop(0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), tile0)
+    diff = tp ^ tile0
+    rows = jax.lax.broadcasted_iota(jnp.int32, tile0.shape, 0)
+    inner = (rows >= _SKIP_PERIOD) & (rows < h_ext - _SKIP_PERIOD)
+    stable = jnp.all(jnp.where(inner, diff, jnp.uint32(0)) == 0)
+    return tp, diff, inner, stable
+
+
 def _probe_window(tile0, tile_h: int, pad: int, turns: int, rule):
     """The skip proof itself: advance the window p generations; if the
     result equals gen 0 on the inner rows, the centre tile at gen ``turns``
     is exactly the input (see ``_advance_window``).  Returns
     (window at gen ``turns``, stable flag) — the flag feeds the next
     launch's probe elision and the Backend's skip telemetry."""
-    tp = jax.lax.fori_loop(0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), tile0)
-    # Compare on rows [p, H_ext-p) via an iota mask — Mosaic has no
-    # unaligned-slice lowering, and the mask is launch-overhead only.
-    h_ext = tile_h + 2 * pad
-    rows = jax.lax.broadcasted_iota(jnp.int32, (h_ext, tile0.shape[1]), 0)
-    inner = (rows >= _SKIP_PERIOD) & (rows < h_ext - _SKIP_PERIOD)
-    stable = jnp.all(jnp.where(inner, tp ^ tile0, jnp.uint32(0)) == 0)
+    tp, _, _, stable = _probe_state(tile0, tile_h + 2 * pad, rule)
     out = jax.lax.cond(
         stable,
         lambda: tile0,
@@ -393,26 +403,136 @@ def _kernel(
     o_ref[:] = out[pad : pad + tile_h, :]
 
 
-def _elide_or_probe(window, elide, tile_h: int, pad: int, turns: int, rule):
-    """(centre rows at gen ``turns``, int32 stable flag) — THE shared
-    elide/probe body of the single-device and sharded adaptive kernels
-    (one home, like ``_advance_window``, so the two cannot drift apart).
-    ``elide`` asserts the window is bit-identical to one whose probe
-    passed last launch; otherwise the probe runs."""
+def _window_rows(tile_h: int, pad: int, turns: int) -> int | None:
+    """Static sub-window height for active-row windowed compute, or None
+    when windowing can't pay for this geometry.  The sub-window must hold
+    the active interval plus a ``2·turns`` light-cone margin per side
+    (compute halo + pinned-proof distance); the 64-row allowance is the
+    activity extent the fast path accepts before falling back."""
+    h_ext = tile_h + 2 * pad
+    s = _round8(4 * turns + 64)
+    if s + 64 > h_ext:
+        return None
+    return s
 
-    def probe():
-        out, stable = _probe_window(window, tile_h, pad, turns, rule)
-        return out[pad : pad + tile_h, :], stable.astype(jnp.int32)
+
+def _active_interval(diff, inner, h_ext: int):
+    """(lo, hi) row bounds of the nonzero rows of ``diff`` restricted to
+    the probe-valid ``inner`` mask — scalar int32s.  An all-zero diff
+    yields (h_ext, -1); callers only read the bounds when the probe
+    failed, which guarantees a nonempty interval."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, diff.shape, 0)
+    hot = inner & (diff != 0)
+    lo = jnp.min(jnp.where(hot, rows, jnp.int32(h_ext)))
+    hi = jnp.max(jnp.where(hot, rows, jnp.int32(-1)))
+    return lo, hi
+
+
+def _elide_probe_or_window(
+    tile, aux, merge, elide, tile_h: int, pad: int, turns: int, rule
+):
+    """The adaptive per-stripe body with active-row windowed compute
+    (round-4: the frontier-overhead attack).  Returns (centre rows at gen
+    ``turns``, int32 stable flag).  ``tile`` is the gen-0 window ref;
+    ``aux``/``merge`` are (h_ext, wp) VMEM scratch.
+
+    Three tiers per stripe:
+    1. elide — whole neighbourhood skipped last launch: centre copies
+       through (existing contract).
+    2. probe passes — period-6 stable: centre copies through.
+    3. probe fails — activity is confined to rows [lo, hi] of the probe
+       diff.  Soundness (same induction as the full-window skip proof,
+       anchored at the interval instead of the window edge): gen 6k
+       equals gen 0 on every row at distance ≥ 6k from [lo, hi] (and
+       ≥ 6k from the window edge), because a row's 6-gen update reads
+       only rows within 6, all of which are pinned one step earlier.
+       Hence after T ≤ pad generations, centre rows at distance ≥ T from
+       the interval are EXACTLY the input rows — copied through — and
+       rows within distance T are recomputed on a static S-row sub-window
+       placed at an 8-aligned dynamic offset covering [lo - 2T, hi + 2T]
+       (compute halo T + validity shrink T), full-width lanes preserved.
+       If the interval (+ margins) exceeds S, fall back to full-window
+       compute, continuing from the probe's gen-6 state as before.
+    """
+    h_ext = tile_h + 2 * pad
+    wp = tile.shape[1]
+    sub_rows = _window_rows(tile_h, pad, turns)
+
+    def probe_tier():
+        tile0 = tile[:]
+        tp, diff, inner, stable = _probe_state(tile0, h_ext, rule)
+
+        def full_from(tp):
+            return jax.lax.fori_loop(
+                _SKIP_PERIOD, turns, lambda _, a: _gen(a, rule), tp
+            )[pad : pad + tile_h, :]
+
+        if sub_rows is None:
+            out = jax.lax.cond(
+                stable, lambda: tile0[pad : pad + tile_h, :], lambda: full_from(tp)
+            )
+            return out, stable.astype(jnp.int32)
+
+        def active_tier():
+            # Interval + eligibility computed HERE, inside the not-stable
+            # branch: the stable probe is the dominant steady-state path
+            # and must not pay these reductions.
+            lo, hi = _active_interval(diff, inner, h_ext)
+            # Expressed as idx8 * 8 so Mosaic can statically prove the
+            # dynamic sublane offset is 8-aligned (clip/and-mask forms
+            # lose the proof; the existing kernels' "tile_index * tile_h"
+            # offsets rely on the same multiplication-carried
+            # divisibility).
+            idx8 = jnp.clip(lo - 2 * turns, 0, h_ext - sub_rows) // 8
+            win_lo = idx8 * 8
+            # Eligibility = exact coverage: every centre row needing
+            # recompute ([lo-T, hi+T] clipped to the centre) must land in
+            # the sub-window's validity region [win_lo+T, win_lo+S-T) —
+            # checked directly so the win_lo clamps can never slide the
+            # window off the recompute region.
+            rec_lo = jnp.maximum(jnp.int32(pad), lo - turns)
+            rec_hi = jnp.minimum(jnp.int32(pad + tile_h - 1), hi + turns)
+            windowed_ok = (win_lo + turns <= rec_lo) & (
+                rec_hi < win_lo + sub_rows - turns
+            )
+
+            def windowed():
+                aux[:] = tp  # gen-6 window, ref'd for the dynamic-offset load
+                sub = aux[pl.ds(win_lo, sub_rows), :]
+                computed = jax.lax.fori_loop(
+                    _SKIP_PERIOD, turns, lambda _, a: _gen(a, rule), sub
+                )
+                # Rows of the sub-window outside the validity shrink are
+                # garbage; they are also ≥ T from the interval wherever
+                # the centre needs them, so the pinned gen-0 rows stand
+                # in.  The mask is static: [T, S - T) always covers the
+                # centre's recompute region (see soundness notes above).
+                k = jax.lax.broadcasted_iota(jnp.int32, (sub_rows, wp), 0)
+                valid = (k >= turns) & (k < sub_rows - turns)
+                fixed = jnp.where(
+                    valid, computed, tile[pl.ds(win_lo, sub_rows), :]
+                )
+                merge[:] = tile[:]
+                merge[pl.ds(win_lo, sub_rows), :] = fixed
+                return merge[pad : pad + tile_h, :]
+
+            return jax.lax.cond(windowed_ok, windowed, lambda: full_from(tp))
+
+        out = jax.lax.cond(
+            stable, lambda: tile0[pad : pad + tile_h, :], active_tier
+        )
+        return out, stable.astype(jnp.int32)
 
     return jax.lax.cond(
         elide,
-        lambda: (window[pad : pad + tile_h, :], jnp.int32(1)),
-        probe,
+        lambda: (tile[pad : pad + tile_h, :], jnp.int32(1)),
+        probe_tier,
     )
 
 
 def _kernel_adaptive(
-    prev_ref, x_hbm, o_ref, st_ref, tile, sems, *, tile_h, pad, grid, turns, rule
+    prev_ref, x_hbm, o_ref, st_ref, tile, aux, merge, sems, *,
+    tile_h, pad, grid, turns, rule
 ):
     """The activity-adaptive launch with frontier-aware probe elision.
 
@@ -459,7 +579,9 @@ def _kernel_adaptive(
 
     center.wait()
 
-    out_center, stable = _elide_or_probe(tile[:], elide, tile_h, pad, turns, rule)
+    out_center, stable = _elide_probe_or_window(
+        tile, aux, merge, elide, tile_h, pad, turns, rule
+    )
     o_ref[:] = out_center
     st_ref[i] = stable
 
@@ -521,6 +643,8 @@ def _build_launch_adaptive(
         ],
         scratch_shapes=[
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # probe buffer
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # merge buffer
             pltpu.SemaphoreType.DMA((3,)),
         ],
         compiler_params=_compiler_params(tile_h, pad, wp, True),

@@ -50,7 +50,7 @@ from distributed_gol_tpu.ops.pallas_packed import (
     default_skip_cap,
     _advance_window,
     _compiler_params,
-    _elide_or_probe,
+    _elide_probe_or_window,
     _require_adaptive_eligible,
     _round8,
     _tile_for_pad,
@@ -98,7 +98,8 @@ def _ext_kernel(
 
 
 def _ext_kernel_adaptive(
-    prev_ref, x_hbm, o_ref, st_ref, tile, sem, *, tile_h, pad, turns, rule
+    prev_ref, x_hbm, o_ref, st_ref, tile, aux, merge, sem, *,
+    tile_h, pad, turns, rule
 ):
     """The adaptive launch on an extended strip, with frontier-aware probe
     elision (BASELINE.md soundness argument, sharded form).
@@ -132,8 +133,10 @@ def _ext_kernel_adaptive(
         c.start()
         c.wait()
 
-    out_center, stable = _elide_or_probe(
-        tile[:], elide, tile_h, pad, turns, rule
+    # Shared three-tier body: elide / period-6 skip / active-row windowed
+    # compute (round-4) — one home with the single-device kernel.
+    out_center, stable = _elide_probe_or_window(
+        tile, aux, merge, elide, tile_h, pad, turns, rule
     )
     o_ref[:] = out_center
     st_ref[i] = stable
@@ -188,6 +191,8 @@ def _build_ext_launch_adaptive(
         ],
         scratch_shapes=[
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # probe buffer
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # merge buffer
             pltpu.SemaphoreType.DMA,
         ],
         compiler_params=_compiler_params(tile_h, pad, wp, True),

@@ -464,3 +464,76 @@ def test_skip_stable_auto_policy():
             Backend(Params(engine="pallas-packed", image_width=4096,
                            image_height=2048, turns=200_000,
                            skip_stable=True))
+
+
+class TestActiveRowWindow:
+    """The active-row windowed compute tier (round-4 frontier-overhead
+    attack, ``_elide_probe_or_window``): a probe-failing stripe whose
+    activity is confined to a narrow row interval recomputes only a
+    static sub-window at a dynamic 8-aligned offset; every other centre
+    row is proved pinned and copies through.  Geometry: tall stripes so
+    ``_window_rows`` engages (S + 64 <= tile_h + 2 pad)."""
+
+    HT, WT = 1024, 4096  # one 1024-row stripe at the default cap
+
+    def _run_both(self, board_np, turns, cap=None):
+        p = packed.pack(jnp.asarray(board_np))
+        got = pallas_packed.make_superstep(
+            CONWAY, interpret=True, skip_stable=True, skip_tile_cap=cap
+        )(p, turns)
+        want = packed.superstep(p, CONWAY, turns)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def _board(self):
+        return np.zeros((self.HT, self.WT), dtype=np.uint8)
+
+    @staticmethod
+    def _glider(b, y, x):
+        for dy, dx in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+            b[y + dy, x + dx] = 255
+
+    def test_window_engages_for_this_geometry(self):
+        t = pallas_packed.launch_turns((self.HT, self.WT // 32), 48, 1024)
+        t, adaptive = pallas_packed.skip_plan(t)
+        assert adaptive
+        tile = pallas_packed._plan_tile((self.HT, self.WT // 32), t, 1024)
+        assert pallas_packed._window_rows(
+            tile, pallas_packed._round8(t), t
+        ) is not None
+
+    def test_narrow_activity_mid_stripe(self):
+        b = self._board()
+        self._glider(b, 500, 2000)  # one glider mid-stripe: narrow interval
+        b[100:102, 64:66] = 255  # plus far-away ash that must stay pinned
+        b[900:902, 3000:3002] = 255
+        self._run_both(b, 48)
+
+    def test_activity_near_stripe_top_clamps_window(self):
+        b = self._board()
+        self._glider(b, 2, 100)  # interval near row 0: win_lo clamps at 0
+        self._run_both(b, 48)
+
+    def test_activity_near_stripe_bottom_clamps_window(self):
+        b = self._board()
+        self._glider(b, self.HT - 8, 3500)  # clamps at h_ext - S
+        self._run_both(b, 48)
+
+    def test_wide_activity_falls_back_to_full_compute(self):
+        b = self._board()
+        self._glider(b, 100, 1000)  # two clusters ~800 rows apart:
+        self._glider(b, 900, 1000)  # interval exceeds S -> full branch
+        self._run_both(b, 48)
+
+    def test_soup_stripe(self):
+        rng = np.random.default_rng(7)
+        b = np.where(rng.random((self.HT, self.WT)) < 0.3, 255, 0).astype(
+            np.uint8
+        )
+        self._run_both(b, 24)
+
+    def test_multi_stripe_mixed(self):
+        # Two stripes via cap 512: one stable, one windowed-active.
+        b = self._board()
+        b[100:102, 64:66] = 255  # stripe 0: ash only
+        self._glider(b, 700, 2000)  # stripe 1: narrow activity
+        self._run_both(b, 48, cap=512)
