@@ -276,6 +276,45 @@ def launch_plan(
     }
 
 
+def halo_bytes_2d_model(
+    pshape: tuple[int, int], mesh_shape: tuple[int, int], turns: int = 128
+) -> dict:
+    """ICI bytes per device per launch a HYPOTHETICAL 2-D-mesh version of
+    this kernel would ship, vs the row mesh with the same device count —
+    the machine-checked form of the round-4 design decision to keep the
+    flagship tier row-only (``supports`` requires nx == 1).
+
+    The y-halo is ``pad`` rows of the device's width.  The x-halo cannot
+    be ``pad`` columns: the kernel's packed words live on the LANE axis,
+    and Mosaic lane slices are 128-lane quantized (the measured
+    column-blocking dead end in BASELINE.md is the same physics), so each
+    x-halo ships ≥ 128 words = 4096 cells per side regardless of T ≤ 128.
+    At 65536² on 8 devices that makes the (2, 4) mesh ship ~40× the
+    (8, 1) mesh's bytes; SURVEY §2's "2-D halves halo bytes at scale"
+    holds only for byte-granular engines (roll/packed support 2-D meshes
+    for exactly that reason).  Row strips also keep the full-width lane
+    rotate = the exact torus x-wrap; a 2-D mesh loses that too."""
+    h, wp = pshape
+    ny, nx = mesh_shape
+    pad = _round8(min(turns, 128))
+    row = {"mesh": (ny * nx, 1), "halo_bytes": 2 * pad * wp * 4}
+    if nx == 1:
+        return {"row": row, "mesh_2d": row, "ratio": 1.0}
+    y_bytes = 2 * pad * (wp // nx) * 4
+    # x-halo: pad CELLS = ceil(pad/32) packed words per side, rounded up
+    # to the 128-word lane quantum (= 4096 cells; one quantum suffices
+    # for any T ≤ 128 and dwarfs the actual need).
+    pad_words = -(-pad // 32)
+    x_words = -(-pad_words // _LANES) * _LANES
+    x_bytes = 2 * x_words * (h // ny) * 4
+    two_d = {"mesh": (ny, nx), "halo_bytes": y_bytes + x_bytes}
+    return {
+        "row": row,
+        "mesh_2d": two_d,
+        "ratio": two_d["halo_bytes"] / row["halo_bytes"],
+    }
+
+
 def _extend_rows(local: jax.Array, pad: int) -> jax.Array:
     """(h_loc, wp) strip -> (h_loc + 2·pad, wp) with pad boundary rows from
     the ring neighbours (self-send on a 1-sized axis = the torus wrap)."""

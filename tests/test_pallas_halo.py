@@ -105,3 +105,29 @@ def test_backend_selects_sharded_pallas(rng):
     ref, ref_count = single.run_turns(single.put(board), 16)
     assert count == ref_count
     assert np.array_equal(b.fetch(out), single.fetch(ref))
+
+
+def test_2d_mesh_designed_out_by_halo_model():
+    """The flagship tier is row-mesh-only BY MEASUREMENT-BACKED DESIGN
+    (round 4): a 2-D mesh's x-halo is 128-lane quantized (the measured
+    column-blocking physics, BASELINE.md), so at every realistic device
+    count the row mesh ships strictly fewer ICI bytes — pinned here so
+    the README/BASELINE claim cannot rot."""
+    from distributed_gol_tpu.parallel.pallas_halo import halo_bytes_2d_model
+
+    for n, shapes in [
+        (4, [(2, 2), (4, 1)]),
+        (8, [(2, 4), (4, 2), (8, 1)]),
+        (64, [(8, 8), (16, 4), (64, 1)]),
+        (256, [(16, 16), (256, 1)]),
+    ]:
+        for ny, nx in shapes:
+            m = halo_bytes_2d_model((65536, 2048), (ny, nx), 48)
+            assert m["ratio"] >= 1.0, (ny, nx, m)
+            if nx > 1:
+                assert m["ratio"] > 3, (ny, nx, m)  # not close: lane quantum
+    # And supports() enforces the decision.
+    from distributed_gol_tpu.parallel import pallas_halo
+
+    assert not pallas_halo.supports((65536, 2048), (2, 4))
+    assert pallas_halo.supports((65536, 2048), (8, 1))

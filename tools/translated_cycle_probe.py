@@ -1,0 +1,78 @@
+"""Does a settled board recur up to a TRANSLATION?  (round-4 exploratory,
+VERDICT item 8.)
+
+The 65536² steady-state plateau is set by torus-orbiting gliders: state
+that recurs *shifted*.  If the WHOLE board satisfied
+``state(t + p) == roll(state(t), k·(dy, dx))`` the controller could
+fast-forward glider-only residue exactly the way period-6 ash already is
+(final board = one superstep to the phase + one roll; counts constant per
+phase, translation-invariant).  This probe measures whether that premise
+ever holds on a real settled board: for the glider periods/shifts
+(p, |dy|=|dx|=p/4) it counts mismatching words between ``state(t+p)`` and
+every diagonal translation of ``state(t)``.  A zero count for some shift
+= the feature would fire; nonzero everywhere = the recurrence premise
+fails (gliders travel in MULTIPLE directions at once, so no single global
+translation matches) and the negative result goes to BASELINE.md.
+
+Usage: python tools/translated_cycle_probe.py BOARD.npy   (packed uint32)
+"""
+
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_gol_tpu.models.life import CONWAY
+    from distributed_gol_tpu.ops import packed
+
+    board = np.load(sys.argv[1])
+    if board.dtype != np.uint32:
+        raise SystemExit(f"want a packed uint32 board, got {board.dtype}")
+    a = jnp.asarray(board)
+    wp = a.shape[1]
+    print(f"device={jax.devices()[0]} board={a.shape[0]}x{wp * 32}")
+
+    def shift_x(p, k: int):
+        # LSB = lowest x (ops/packed.py layout); +x shift = bit left-shift
+        # with cross-word carry from the west word (cf. pallas _gen).
+        if k == 0:
+            return p
+        if k > 0:
+            return (p << k) | (jnp.roll(p, 1, axis=1) >> (32 - k))
+        k = -k
+        return (p >> k) | (jnp.roll(p, wp - 1, axis=1) << (32 - k))
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(2, 3))
+    def mismatches(b, a, dy: int, dx: int):
+        return jnp.sum(b ^ jnp.roll(shift_x(a, dx), dy, axis=0) != 0)
+
+    for period in (4, 12, 24):
+        b = packed.superstep(a, CONWAY, period)
+        s = period // 4  # glider speed c/4 diagonal
+        counts = {}
+        for dy in (-s, 0, s):
+            for dx in (-s, 0, s):
+                counts[(dy, dx)] = int(mismatches(b, a, dy, dx))
+        best = min(counts, key=counts.get)
+        print(
+            f"period {period}: best shift {best} -> {counts[best]:,} "
+            f"mismatching words (unshifted: {counts[(0, 0)]:,})"
+        )
+        if counts[best] == 0:
+            print("TRANSLATED RECURRENCE FOUND — the fast-forward would fire")
+            return 0
+    print(
+        "no translated recurrence: gliders travel in multiple directions, "
+        "no global shift matches (negative result; see BASELINE.md)"
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
