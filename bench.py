@@ -281,17 +281,26 @@ def bench_controller_path(
     keys: queue.Queue = queue.Queue()
     times: list[tuple[int, float]] = []  # (completed turns, consumer clock)
 
+    quit_at = [0.0]
+
     def consume():
         while True:
             e = events.get()
             if e is None:
                 return
+            # Events after the 'q' are outside the measurement window and
+            # get filtered out below; skip the per-event timestamping so
+            # the post-quit backlog (a per-turn run can hold millions of
+            # expanded TurnCompletes) drains several times faster and the
+            # thread reliably exits before a same-process measurement
+            # starts (a leaked consumer GIL-starves the next run).
+            if quit_at[0]:
+                continue
             if isinstance(e, (TurnComplete, TurnsCompleted)):
                 times.append((e.completed_turns, time.perf_counter()))
 
     consumer = threading.Thread(target=consume, daemon=True)
     consumer.start()
-    quit_at = [0.0]
 
     def quit_later():
         time.sleep(budget_seconds)
@@ -301,7 +310,9 @@ def bench_controller_path(
     timer = threading.Thread(target=quit_later, daemon=True)
     timer.start()
     run(params, events, keys, session=Session())
-    consumer.join(timeout=60)
+    consumer.join(timeout=300)
+    if consumer.is_alive():
+        log("  WARNING: event consumer still draining; results may be skewed")
 
     window = [(n, t) for n, t in times if t <= quit_at[0]]
     if len(window) < 2:
