@@ -316,6 +316,40 @@ def skip_plan(t: int) -> tuple[int, bool]:
     return t, _adaptive_eligible(t)
 
 
+# Settled-regime launch depth for tall boards (round 4).  At the 512-row
+# cap (boards/strips ≥ _TALL_ROWS) the fresh-soup cost key picks T≈24,
+# but a settled run's cost is probe share (6/T of generations on the full
+# window) plus per-launch fixed overhead — both ∝ 1/T — while the
+# windowed tier keeps the extra redundancy cheap.  Measured on the real
+# 200k-gen settled 65536² board: T=24 → 2,780 gens/s, T=48 → 3,831
+# (+38%), T=96 → 3,840 (flat).  The floor costs the transient active
+# phase ~8% extra halo redundancy ((512+96)/512 vs (512+48)/512), which
+# the settled phase repays permanently; only adaptive (skip_stable)
+# plans on tall boards are affected.
+_SETTLED_T = 48
+
+
+def adaptive_launch_depth(
+    shape: tuple[int, int], turns: int, cap: int | None
+) -> tuple[int, bool]:
+    """(launch depth, adaptive?) for a skip_stable dispatch — THE one
+    depth decision shared by the execution paths and the skip-fraction
+    denominators (single- and sharded-device), so plan and telemetry can
+    never drift."""
+    t = launch_turns(shape, turns, cap)
+    t, adaptive = skip_plan(t)
+    if (
+        adaptive
+        and t < _SETTLED_T
+        and shape[0] >= _TALL_ROWS
+        and turns >= _SETTLED_T
+        and _tile_for_pad(shape[0], shape[1], _round8(_SETTLED_T), cap)
+        is not None
+    ):
+        t = _SETTLED_T
+    return t, adaptive
+
+
 def _advance_window(tile0, tile_h: int, pad: int, turns: int, rule, skip_stable):
     """``turns`` generations of a halo-extended (tile_h + 2·pad, wp) window
     held in VMEM — THE shared body of the single-device and sharded tiled
@@ -770,8 +804,7 @@ def adaptive_tile_launches(
     # it — same-plan contract for every caller.
     if tile_cap is None:
         tile_cap = default_skip_cap(shape[0])
-    t = launch_turns(shape, turns, tile_cap)
-    t, adaptive = skip_plan(t)
+    t, adaptive = adaptive_launch_depth(shape, turns, tile_cap)
     full, _ = divmod(turns, t)
     if not adaptive or not full:
         return 0
@@ -790,12 +823,11 @@ def _run_tiled(
     shape = board.shape
     if skip_stable:
         cap = tile_cap if tile_cap is not None else default_skip_cap(shape[0])
+        t, adaptive = adaptive_launch_depth(shape, turns, cap)
     else:
         cap = None
-    t = launch_turns(shape, turns, cap)
-    adaptive = False
-    if skip_stable:
-        t, adaptive = skip_plan(t)
+        t = launch_turns(shape, turns, None)
+        adaptive = False
     full, rem = divmod(turns, t)
     skipped = jnp.int32(0)
     if adaptive and full:
@@ -817,12 +849,20 @@ def _run_tiled(
     elif full:
         call = _build_launch(shape, rule, t, ip, False, cap)
         board = jax.lax.fori_loop(0, full, lambda _, b: call(b), board)
+    if rem and skip_stable:
+        # Remainder split (round 4): a non-period-multiple remainder used
+        # to run one FULL-compute launch — at the tall-board settled depth
+        # (T=48) a 32-gen remainder then costs more than the 10 skipping
+        # launches it trails (measured: 2,589 vs 3,831 gens/s at 65536²).
+        # Peel the period-multiple part into a probing skip launch; only
+        # the ≤5-gen tail pays full compute.  Neither consumes/produces
+        # the bitmap (different geometry; BASELINE.md scope restrictions).
+        rem6 = rem - rem % _SKIP_PERIOD
+        if rem6:
+            board = _build_launch(shape, rule, rem6, ip, True, cap)(board)
+            rem -= rem6
     if rem:
-        # The remainder launch never consumes or produces the bitmap
-        # (different geometry; see the BASELINE.md scope restrictions).
-        board = _build_launch(
-            shape, rule, rem, ip, skip_stable and _adaptive_eligible(rem), cap
-        )(board)
+        board = _build_launch(shape, rule, rem, ip, False, cap)(board)
     if with_stats:
         return board, skipped
     return board

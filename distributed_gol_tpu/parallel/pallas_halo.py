@@ -46,7 +46,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from distributed_gol_tpu.models.life import CONWAY, LifeRule
 from distributed_gol_tpu.ops.pallas_packed import (
     _LANES,
+    _SKIP_PERIOD,
     _adaptive_eligible,
+    adaptive_launch_depth,
     default_skip_cap,
     _advance_window,
     _compiler_params,
@@ -56,7 +58,6 @@ from distributed_gol_tpu.ops.pallas_packed import (
     _tile_for_pad,
     _use_interpret,
     launch_turns,
-    skip_plan,
 )
 from distributed_gol_tpu.parallel.halo import BOARD_SPEC, _shift_perm
 
@@ -344,8 +345,7 @@ def adaptive_strip_launches(
     # every caller, not just ones that pre-resolve the cap.
     if tile_cap is None:
         tile_cap = default_skip_cap(strip[0])
-    t = launch_turns(strip, turns, tile_cap)
-    t, adaptive = skip_plan(t)
+    t, adaptive = adaptive_launch_depth(strip, turns, tile_cap)
     full, _ = divmod(turns, t)
     if not adaptive or not full:
         return 0
@@ -388,16 +388,13 @@ def make_superstep(
         ip = _use_interpret() if interpret is None else interpret
         h, wp = board.shape
         strip = (h // ny, wp)
-        cap = (
-            (raw_cap if raw_cap is not None else default_skip_cap(strip[0]))
-            if skip_stable
-            else None
-        )
-        t = launch_turns(
-            strip, turns, cap if skip_stable else None
-        )  # clamps to _MAX_T internally
         if skip_stable:
-            t, _ = skip_plan(t)
+            cap = raw_cap if raw_cap is not None else default_skip_cap(strip[0])
+            t, t_adaptive = adaptive_launch_depth(strip, turns, cap)
+        else:
+            cap = None
+            t = launch_turns(strip, turns, None)  # clamps to _MAX_T internally
+            t_adaptive = False
         full, rem = divmod(turns, t)
 
         def make_step(tt: int, adaptive_ok: bool = False):
@@ -451,7 +448,9 @@ def make_superstep(
 
             return step
 
-        adaptive_t = skip_stable and _adaptive_eligible(t)
+        # The helper's flag IS the decision (same-plan contract); only the
+        # non-skip path, which never consulted the helper, derives none.
+        adaptive_t = skip_stable and t_adaptive
         skipped = jnp.int32(0)
         if adaptive_t and full:
             grid = strip[0] // _strip_plan_tile(strip, t, cap)
@@ -475,9 +474,15 @@ def make_superstep(
         elif full:
             step_t = make_step(t)
             board = jax.lax.fori_loop(0, full, lambda _, b: step_t(b), board)
+        if rem and skip_stable:
+            # Remainder split (round 4, mirrors pallas_packed._run_tiled):
+            # peel the period-multiple part into a probing skip launch so
+            # only a ≤5-gen tail pays full compute.
+            rem6 = rem - rem % _SKIP_PERIOD
+            if rem6:
+                board = make_step(rem6)(board)
+                rem -= rem6
         if rem:
-            # The remainder launch never consumes or produces the bitmap
-            # (different geometry; BASELINE.md scope restrictions).
             board = make_step(rem)(board)
         if with_stats:
             return board, skipped

@@ -537,3 +537,25 @@ class TestActiveRowWindow:
         b[100:102, 64:66] = 255  # stripe 0: ash only
         self._glider(b, 700, 2000)  # stripe 1: narrow activity
         self._run_both(b, 48, cap=512)
+
+
+def test_settled_launch_depth_floor_for_tall_boards():
+    """Round-4 measured policy: adaptive plans on ≥32768-row boards floor
+    the launch depth at _SETTLED_T (48) — probe share and per-launch cost
+    are ∝ 1/T and dominate the settled regime (65536² measured: 2,780
+    gens/s at the cost model's T=24 vs 3,831 at T=48).  Short boards and
+    non-adaptive plans keep the pure cost-model depth, and the
+    skip-fraction denominator uses the same depth (one home)."""
+    tall = (65536, 2048)
+    t, adaptive = pallas_packed.adaptive_launch_depth(tall, 960, 512)
+    assert adaptive and t == pallas_packed._SETTLED_T
+    # Same depth feeds the telemetry denominator.
+    grid = 65536 // pallas_packed._plan_tile(tall, t, 512)
+    assert pallas_packed.adaptive_tile_launches(tall, 960, 512) == (960 // t) * grid
+    # Short board: cost-model depth, no floor.
+    short = (16384, 512)
+    t_s, ad_s = pallas_packed.adaptive_launch_depth(short, 960, 1024)
+    assert ad_s and t_s < pallas_packed._SETTLED_T == 48
+    # Dispatches shorter than the floor can't be deepened past the work.
+    t_tiny, _ = pallas_packed.adaptive_launch_depth(tall, 24, 512)
+    assert t_tiny <= 24

@@ -34,7 +34,11 @@ def log(*a):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--kturns", type=int, default=512)
+    # 960 = lcm-friendly for the settled launch depths (48/24/16): a
+    # dispatch this short would otherwise spend a visible fraction of its
+    # gens in the remainder launch, which production dispatches (≥20k
+    # gens via the adaptive controller) never do.
+    ap.add_argument("--kturns", type=int, default=960)
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--skip-stable", action="store_true",
                     help="activity-adaptive kernel (period-6 skip + probe "
