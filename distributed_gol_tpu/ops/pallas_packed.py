@@ -462,140 +462,164 @@ def _active_interval(diff, inner, h_ext: int):
     return lo, hi
 
 
-def _elide_probe_or_window(
-    tile, aux, merge, elide, tile_h: int, pad: int, turns: int, rule
-):
-    """The adaptive per-stripe body with active-row windowed compute
-    (round-4: the frontier-overhead attack).  Returns (centre rows at gen
-    ``turns``, int32 stable flag).  ``tile`` is the gen-0 window ref;
-    ``aux``/``merge`` are (h_ext, wp) VMEM scratch.
+def _route_active(tile, aux, merge, tile_h: int, pad: int, turns: int, rule):
+    """The shared active-stripe (non-elided) body of the adaptive kernels:
+    probe, then route.  Returns (route, stable) where route says which
+    scratch holds the centre rows at gen ``turns`` — 0: ``tile`` (probe
+    passed, gen 0 IS the answer), 1: ``merge`` (active-row windowed
+    compute wrote it), 2: ``aux`` (full-window compute wrote it).
+    Returning a route instead of the centre VALUE lets the ping-pong
+    kernel DMA straight from the right scratch — materialising the centre
+    in registers cost two ~2 MB VPU passes per active stripe per launch
+    (measured 30% of settled 16384² throughput).
 
-    Three tiers per stripe:
-    1. elide — whole neighbourhood skipped last launch: centre copies
-       through (existing contract).
-    2. probe passes — period-6 stable: centre copies through.
-    3. probe fails — activity is confined to rows [lo, hi] of the probe
-       diff.  Soundness (same induction as the full-window skip proof,
-       anchored at the interval instead of the window edge): gen 6k
-       equals gen 0 on every row at distance ≥ 6k from [lo, hi] (and
-       ≥ 6k from the window edge), because a row's 6-gen update reads
-       only rows within 6, all of which are pinned one step earlier.
-       Hence after T ≤ pad generations, centre rows at distance ≥ T from
-       the interval are EXACTLY the input rows — copied through — and
-       rows within distance T are recomputed on a static S-row sub-window
-       placed at an 8-aligned dynamic offset covering [lo - 2T, hi + 2T]
-       (compute halo T + validity shrink T), full-width lanes preserved.
-       If the interval (+ margins) exceeds S, fall back to full-window
-       compute, continuing from the probe's gen-6 state as before.
-    """
+    Windowed tier soundness (round 4): activity is confined to rows
+    [lo, hi] of the probe diff.  By the same induction as the full-window
+    skip proof — anchored at the interval instead of the window edge —
+    gen 6k equals gen 0 on every row at distance ≥ 6k from [lo, hi] (and
+    ≥ 6k from the window edge), because a row's 6-gen update reads only
+    rows within 6, all pinned one step earlier.  Hence after T ≤ pad
+    generations, centre rows at distance ≥ T from the interval are
+    EXACTLY the input rows — copied through — and rows within distance T
+    are recomputed on a static S-row sub-window placed at an 8-aligned
+    dynamic offset covering [lo − 2T, hi + 2T] (compute halo T + validity
+    shrink T), full-width lanes preserved.  Wide intervals fall back to
+    the full window, continuing from the probe's gen-6 state."""
     h_ext = tile_h + 2 * pad
     wp = tile.shape[1]
     sub_rows = _window_rows(tile_h, pad, turns)
+    tile0 = tile[:]
+    tp, diff, inner, stable = _probe_state(tile0, h_ext, rule)
 
-    def probe_tier():
-        tile0 = tile[:]
-        tp, diff, inner, stable = _probe_state(tile0, h_ext, rule)
-
-        def full_from(tp):
-            return jax.lax.fori_loop(
-                _SKIP_PERIOD, turns, lambda _, a: _gen(a, rule), tp
-            )[pad : pad + tile_h, :]
-
-        if sub_rows is None:
-            out = jax.lax.cond(
-                stable, lambda: tile0[pad : pad + tile_h, :], lambda: full_from(tp)
-            )
-            return out, stable.astype(jnp.int32)
-
-        def active_tier():
-            # Interval + eligibility computed HERE, inside the not-stable
-            # branch: the stable probe is the dominant steady-state path
-            # and must not pay these reductions.
-            lo, hi = _active_interval(diff, inner, h_ext)
-            # Expressed as idx8 * 8 so Mosaic can statically prove the
-            # dynamic sublane offset is 8-aligned (clip/and-mask forms
-            # lose the proof; the existing kernels' "tile_index * tile_h"
-            # offsets rely on the same multiplication-carried
-            # divisibility).
-            idx8 = jnp.clip(lo - 2 * turns, 0, h_ext - sub_rows) // 8
-            win_lo = idx8 * 8
-            # Eligibility = exact coverage: every centre row needing
-            # recompute ([lo-T, hi+T] clipped to the centre) must land in
-            # the sub-window's validity region [win_lo+T, win_lo+S-T) —
-            # checked directly so the win_lo clamps can never slide the
-            # window off the recompute region.
-            rec_lo = jnp.maximum(jnp.int32(pad), lo - turns)
-            rec_hi = jnp.minimum(jnp.int32(pad + tile_h - 1), hi + turns)
-            windowed_ok = (win_lo + turns <= rec_lo) & (
-                rec_hi < win_lo + sub_rows - turns
-            )
-
-            def windowed():
-                aux[:] = tp  # gen-6 window, ref'd for the dynamic-offset load
-                sub = aux[pl.ds(win_lo, sub_rows), :]
-                computed = jax.lax.fori_loop(
-                    _SKIP_PERIOD, turns, lambda _, a: _gen(a, rule), sub
-                )
-                # Rows of the sub-window outside the validity shrink are
-                # garbage; they are also ≥ T from the interval wherever
-                # the centre needs them, so the pinned gen-0 rows stand
-                # in.  The mask is static: [T, S - T) always covers the
-                # centre's recompute region (see soundness notes above).
-                k = jax.lax.broadcasted_iota(jnp.int32, (sub_rows, wp), 0)
-                valid = (k >= turns) & (k < sub_rows - turns)
-                fixed = jnp.where(
-                    valid, computed, tile[pl.ds(win_lo, sub_rows), :]
-                )
-                merge[:] = tile[:]
-                merge[pl.ds(win_lo, sub_rows), :] = fixed
-                return merge[pad : pad + tile_h, :]
-
-            return jax.lax.cond(windowed_ok, windowed, lambda: full_from(tp))
-
-        out = jax.lax.cond(
-            stable, lambda: tile0[pad : pad + tile_h, :], active_tier
+    def full_from():
+        aux[:] = jax.lax.fori_loop(
+            _SKIP_PERIOD, turns, lambda _, a: _gen(a, rule), tp
         )
-        return out, stable.astype(jnp.int32)
+        return jnp.int32(2)
+
+    if sub_rows is None:
+        route = jax.lax.cond(stable, lambda: jnp.int32(0), full_from)
+        return route, stable.astype(jnp.int32)
+
+    def active_tier():
+        # Interval + eligibility computed HERE, inside the not-stable
+        # branch: the stable probe is the dominant steady-state path and
+        # must not pay these reductions.
+        lo, hi = _active_interval(diff, inner, h_ext)
+        # Expressed as idx8 * 8 so Mosaic can statically prove the
+        # dynamic sublane offset is 8-aligned (clip/and-mask forms lose
+        # the proof; the existing kernels' "tile_index * tile_h" offsets
+        # rely on the same multiplication-carried divisibility).
+        idx8 = jnp.clip(lo - 2 * turns, 0, h_ext - sub_rows) // 8
+        win_lo = idx8 * 8
+        # Eligibility = exact coverage: every centre row needing recompute
+        # ([lo-T, hi+T] clipped to the centre) must land in the
+        # sub-window's validity region [win_lo+T, win_lo+S-T) — checked
+        # directly so the win_lo clamps can never slide the window off
+        # the recompute region.
+        rec_lo = jnp.maximum(jnp.int32(pad), lo - turns)
+        rec_hi = jnp.minimum(jnp.int32(pad + tile_h - 1), hi + turns)
+        windowed_ok = (win_lo + turns <= rec_lo) & (
+            rec_hi < win_lo + sub_rows - turns
+        )
+
+        def windowed():
+            aux[:] = tp  # gen-6 window, ref'd for the dynamic-offset load
+            sub = aux[pl.ds(win_lo, sub_rows), :]
+            computed = jax.lax.fori_loop(
+                _SKIP_PERIOD, turns, lambda _, a: _gen(a, rule), sub
+            )
+            # Rows of the sub-window outside the validity shrink are
+            # garbage; they are also ≥ T from the interval wherever the
+            # centre needs them, so the pinned gen-0 rows stand in.  The
+            # mask is static: [T, S - T) always covers the centre's
+            # recompute region (see soundness notes above).
+            k = jax.lax.broadcasted_iota(jnp.int32, (sub_rows, wp), 0)
+            valid = (k >= turns) & (k < sub_rows - turns)
+            fixed = jnp.where(valid, computed, tile[pl.ds(win_lo, sub_rows), :])
+            merge[:] = tile[:]
+            merge[pl.ds(win_lo, sub_rows), :] = fixed
+            return jnp.int32(1)
+
+        return jax.lax.cond(windowed_ok, windowed, full_from)
+
+    route = jax.lax.cond(stable, lambda: jnp.int32(0), active_tier)
+    return route, stable.astype(jnp.int32)
+
+
+def _elide_probe_or_window(
+    tile, aux, merge, elide, tile_h: int, pad: int, turns: int, rule
+):
+    """Value-returning wrapper over :func:`_route_active` for the sharded
+    strip kernel (whose blocked output spec wants the centre as a value):
+    (centre rows at gen ``turns``, int32 stable flag).  Tier semantics and
+    soundness live in ``_route_active``."""
+
+    def active():
+        route, stable = _route_active(tile, aux, merge, tile_h, pad, turns, rule)
+        out = jax.lax.switch(
+            route,
+            [
+                lambda: tile[pad : pad + tile_h, :],
+                lambda: merge[pad : pad + tile_h, :],
+                lambda: aux[pad : pad + tile_h, :],
+            ],
+        )
+        return out, stable
 
     return jax.lax.cond(
         elide,
         lambda: (tile[pad : pad + tile_h, :], jnp.int32(1)),
-        probe_tier,
+        active,
     )
 
 
 def _kernel_adaptive(
-    prev_ref, x_hbm, o_ref, st_ref, tile, aux, merge, sems, *,
-    tile_h, pad, grid, turns, rule
+    prev_ref, x_hbm, dst_prev, o_hbm, st_ref, tile, aux, merge, sems,
+    *, tile_h, pad, grid, turns, rule
 ):
-    """The activity-adaptive launch with frontier-aware probe elision.
+    """The activity-adaptive launch with frontier-aware probe elision and
+    ping-pong write elision (round 4).
 
     ``prev_ref`` (SMEM, int32[grid]) is the previous launch's skip bitmap:
     1 for tiles whose skip branch ran.  If a tile AND both its
     halo-source neighbours skipped, its window is bit-identical to the
     one the previous launch's probe proved period-6-stable, so the probe
-    (6 generations + a full-window compare) is elided too — the tile
-    costs one centre-rows HBM round-trip and nothing else.  Soundness
+    (6 generations + a full-window compare) is elided too.  Soundness
     argument: BASELINE.md "frontier-aware probe elision"; the bitmap is
     valid only within one dispatch's identical-geometry launches, which
-    the caller (``_run_tiled``) guarantees by zero-initialising it."""
+    the caller (``_run_tiled``) guarantees by zero-initialising it.
+
+    Ping-pong write elision: ``dst_prev`` (the board from TWO launches
+    ago) is aliased onto the output ``o_hbm`` (``input_output_aliases``
+    in the builder), and the launch schedule alternates two buffers.  An
+    elided tile's state satisfies S_k == S_{k-1} == S_{k-2} on its
+    centre rows (the elide condition is exactly the chain of per-launch
+    skip proofs), and S_{k-2} is what the output buffer already holds —
+    so the tile does NOTHING: no centre read, no halo read, no write.
+    Elided tiles cost one SMEM flag; the steady-state HBM traffic is the
+    active frontier only (previously every elided tile still paid a
+    centre in+out round-trip, which bounded settled 16384² at ~186k
+    gens/s).  Launch 1 of a dispatch has a zero bitmap, so every tile
+    writes and both buffers are fully defined before any elision."""
+    del dst_prev  # same memory as o_hbm (aliased); contents ARE the output
     i = pl.program_id(0)
     left = jax.lax.rem(i + grid - 1, grid)
     right = jax.lax.rem(i + 1, grid)
     elide = (prev_ref[left] + prev_ref[i] + prev_ref[right]) == 3
 
-    center = pltpu.make_async_copy(
-        x_hbm.at[pl.ds(i * tile_h, tile_h), :],
-        tile.at[pl.ds(pad, tile_h), :],
-        sems.at[0],
-    )
-    center.start()
+    @pl.when(elide)
+    def _():
+        st_ref[i] = 1
 
     @pl.when(jnp.logical_not(elide))
     def _():
-        # Halo rows feed only the probe/compute path; an elided tile
-        # skips their DMA entirely (the scratch rows hold stale data the
-        # elided branch never reads).
+        center = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(i * tile_h, tile_h), :],
+            tile.at[pl.ds(pad, tile_h), :],
+            sems.at[0],
+        )
+        center.start()
         top = left * tile_h + (tile_h - pad)
         bot = right * tile_h
         c1 = pltpu.make_async_copy(
@@ -608,16 +632,25 @@ def _kernel_adaptive(
         )
         c1.start()
         c2.start()
+        center.wait()
         c1.wait()
         c2.wait()
 
-    center.wait()
+        route, stable = _route_active(tile, aux, merge, tile_h, pad, turns, rule)
+        st_ref[i] = stable
+        # The centre is DMA'd straight from whichever scratch holds it —
+        # no staging copy (see _route_active).
+        for code, src in ((0, tile), (1, merge), (2, aux)):
 
-    out_center, stable = _elide_probe_or_window(
-        tile, aux, merge, elide, tile_h, pad, turns, rule
-    )
-    o_ref[:] = out_center
-    st_ref[i] = stable
+            @pl.when(route == code)
+            def _(src=src):
+                out = pltpu.make_async_copy(
+                    src.at[pl.ds(pad, tile_h), :],
+                    o_hbm.at[pl.ds(i * tile_h, tile_h), :],
+                    sems.at[0],
+                )
+                out.start()
+                out.wait()
 
 
 def _use_interpret() -> bool:
@@ -645,8 +678,11 @@ def _build_launch_adaptive(
     interpret: bool,
     tile_cap: int | None,
 ):
-    """The adaptive launch as ``(prev_bitmap, board) -> (board, bitmap)``:
-    the probe kernel plus frontier-aware elision (``_kernel_adaptive``)."""
+    """The adaptive launch as ``(prev_bitmap, board, dst_prev) ->
+    (board, bitmap)`` where ``dst_prev`` (the board from two launches ago)
+    is ALIASED onto the board output — the ping-pong write-elision
+    contract (see ``_kernel_adaptive``): callers must alternate two
+    buffers and zero the bitmap at dispatch start."""
     h, wp = shape
     _require_adaptive_eligible(turns)
     pad = _round8(turns)
@@ -666,15 +702,17 @@ def _build_launch_adaptive(
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
-            pl.BlockSpec((tile_h, wp), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((h, wp), jnp.uint32),
             jax.ShapeDtypeStruct((grid,), jnp.int32),
         ],
+        input_output_aliases={2: 0},
         scratch_shapes=[
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # probe buffer
@@ -835,17 +873,33 @@ def _run_tiled(
         # identical-geometry launches of THIS dispatch only (zeroed here),
         # so the inheritance proof's same-plan requirement holds by
         # construction; the first launch probes every tile.
+        #
+        # Ping-pong: each launch writes into the buffer from two launches
+        # ago (aliased output), so an elided tile skips its write — its
+        # rows there already hold S_{k-2} == S_k.  The loop body unrolls
+        # TWO launches so each buffer stays in its own carry slot (slot
+        # a = odd states, slot b = even states): a rotating (prev, cur)
+        # carry would make XLA break the buffer cycle with a full-board
+        # copy per launch (measured: all-ash fell from 681k to 206k
+        # gens/s before the unroll).  Launch 1 sees a zero bitmap and
+        # writes every tile, fully defining buffer a regardless of its
+        # initial contents.
         call = _build_launch_adaptive(shape, rule, t, ip, cap)
         grid = shape[0] // _plan_tile(shape, t, cap)
+        st0 = jnp.zeros((grid,), jnp.int32)
 
         def body(_, carry):
-            b, st, sk = carry
-            nb, nst = call(st, b)
-            return nb, nst, sk + jnp.sum(nst)
+            a, b, st, sk = carry
+            nb1, nst1 = call(st, b, a)
+            nb2, nst2 = call(nst1, nb1, b)
+            return nb1, nb2, nst2, sk + jnp.sum(nst1) + jnp.sum(nst2)
 
-        board, _, skipped = jax.lax.fori_loop(
-            0, full, body, (board, jnp.zeros((grid,), jnp.int32), skipped)
+        a, board, st, skipped = jax.lax.fori_loop(
+            0, full // 2, body, (jnp.zeros_like(board), board, st0, skipped)
         )
+        if full % 2:
+            board, nst = call(st, board, a)
+            skipped = skipped + jnp.sum(nst)
     elif full:
         call = _build_launch(shape, rule, t, ip, False, cap)
         board = jax.lax.fori_loop(0, full, lambda _, b: call(b), board)

@@ -559,3 +559,39 @@ def test_settled_launch_depth_floor_for_tall_boards():
     # Dispatches shorter than the floor can't be deepened past the work.
     t_tiny, _ = pallas_packed.adaptive_launch_depth(tall, 24, 512)
     assert t_tiny <= 24
+
+
+class TestPingPongWriteElision:
+    """Ping-pong write elision (round 4): elided stripes skip their write
+    because the aliased output buffer (two launches back) already holds
+    S_{k-2} == S_k.  These dispatches span ≥4 launches so stripes are
+    written from BOTH buffers and elided in between; bit-identity vs the
+    XLA packed engine catches any stale-buffer row."""
+
+    HT, WT = 2048, 4096
+
+    def _run_both(self, b, turns):
+        p = packed.pack(jnp.asarray(b))
+        got = pallas_packed.make_superstep(
+            CONWAY, interpret=True, skip_stable=True, skip_tile_cap=512
+        )(p, turns)
+        want = packed.superstep(p, CONWAY, turns)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_all_ash_even_and_odd_launch_counts(self):
+        b = np.zeros((self.HT, self.WT), dtype=np.uint8)
+        for y in (100, 700, 1200, 1900):
+            b[y : y + 2, 200:202] = 255  # a block per stripe
+        t, _ = pallas_packed.adaptive_launch_depth((self.HT, self.WT // 32), 960, 512)
+        self._run_both(b, 4 * t)  # final board lands in the launch-2 buffer
+        self._run_both(b, 5 * t)  # ...and in the other one
+
+    def test_mixed_glider_and_ash_stripes(self):
+        b = np.zeros((self.HT, self.WT), dtype=np.uint8)
+        b[100:102, 200:202] = 255
+        b[1900:1902, 3000:3002] = 255
+        for dy, dx in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+            b[1000 + dy, 2000 + dx] = 255  # keeps its stripe un-elided
+        t, _ = pallas_packed.adaptive_launch_depth((self.HT, self.WT // 32), 960, 512)
+        self._run_both(b, 4 * t)
+        self._run_both(b, 4 * t + 20)  # + remainder split path
