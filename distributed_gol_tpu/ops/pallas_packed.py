@@ -547,31 +547,22 @@ def _route_active(tile, aux, merge, tile_h: int, pad: int, turns: int, rule):
     return route, stable.astype(jnp.int32)
 
 
-def _elide_probe_or_window(
-    tile, aux, merge, elide, tile_h: int, pad: int, turns: int, rule
-):
-    """Value-returning wrapper over :func:`_route_active` for the sharded
-    strip kernel (whose blocked output spec wants the centre as a value):
-    (centre rows at gen ``turns``, int32 stable flag).  Tier semantics and
-    soundness live in ``_route_active``."""
+def _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sem):
+    """Write the centre rows from whichever scratch :func:`_route_active`
+    said holds them (0: tile, 1: merge, 2: aux) straight to the output —
+    no staging copy.  One home for the single-device and sharded adaptive
+    kernels, like the tier body itself."""
+    for code, src in ((0, tile), (1, merge), (2, aux)):
 
-    def active():
-        route, stable = _route_active(tile, aux, merge, tile_h, pad, turns, rule)
-        out = jax.lax.switch(
-            route,
-            [
-                lambda: tile[pad : pad + tile_h, :],
-                lambda: merge[pad : pad + tile_h, :],
-                lambda: aux[pad : pad + tile_h, :],
-            ],
-        )
-        return out, stable
-
-    return jax.lax.cond(
-        elide,
-        lambda: (tile[pad : pad + tile_h, :], jnp.int32(1)),
-        active,
-    )
+        @pl.when(route == code)
+        def _(src=src):
+            out = pltpu.make_async_copy(
+                src.at[pl.ds(pad, tile_h), :],
+                o_hbm.at[pl.ds(i * tile_h, tile_h), :],
+                sem,
+            )
+            out.start()
+            out.wait()
 
 
 def _kernel_adaptive(
@@ -638,19 +629,7 @@ def _kernel_adaptive(
 
         route, stable = _route_active(tile, aux, merge, tile_h, pad, turns, rule)
         st_ref[i] = stable
-        # The centre is DMA'd straight from whichever scratch holds it —
-        # no staging copy (see _route_active).
-        for code, src in ((0, tile), (1, merge), (2, aux)):
-
-            @pl.when(route == code)
-            def _(src=src):
-                out = pltpu.make_async_copy(
-                    src.at[pl.ds(pad, tile_h), :],
-                    o_hbm.at[pl.ds(i * tile_h, tile_h), :],
-                    sems.at[0],
-                )
-                out.start()
-                out.wait()
+        _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sems.at[0])
 
 
 def _use_interpret() -> bool:

@@ -52,8 +52,9 @@ from distributed_gol_tpu.ops.pallas_packed import (
     default_skip_cap,
     _advance_window,
     _compiler_params,
-    _elide_probe_or_window,
+    _dma_route_out,
     _require_adaptive_eligible,
+    _route_active,
     _round8,
     _tile_for_pad,
     _use_interpret,
@@ -99,11 +100,12 @@ def _ext_kernel(
 
 
 def _ext_kernel_adaptive(
-    prev_ref, x_hbm, o_ref, st_ref, tile, aux, merge, sem, *,
-    tile_h, pad, turns, rule
+    prev_ref, local, north, south, dst_prev, o_hbm, st_ref,
+    tile, aux, merge, sems, *, tile_h, pad, grid, turns, rule
 ):
-    """The adaptive launch on an extended strip, with frontier-aware probe
-    elision (BASELINE.md soundness argument, sharded form).
+    """The adaptive strip launch: frontier-aware probe elision + active-row
+    windowed compute + ping-pong write elision (sharded form; one tier
+    body with the single-device kernel via ``_route_active``).
 
     ``prev_ref`` (SMEM, int32[grid + 2]) is the previous launch's skip
     bitmap EXTENDED with the neighbouring strips' edge-tile flags — the
@@ -111,36 +113,81 @@ def _ext_kernel_adaptive(
     i's window sources are exactly flags [i, i+1, i+2]: the north source
     (neighbour strip's last tile for i == 0, else local tile i−1), the
     tile itself, and the south source.  All three skipped ⇒ the window is
-    bit-identical to the one whose probe passed last launch ⇒ elide: copy
-    only the centre rows (no halo DMA, no compute)."""
+    bit-identical to the one whose probe passed last launch ⇒ elide.
+
+    Round-4 I/O redesign: the strip is NOT pre-extended.  ``local`` is
+    the device's (h_loc, wp) strip and ``north``/``south`` are the
+    ``pad``-row ppermute'd neighbour boundaries; each tile assembles its
+    own window by DMA (edge tiles pull their outer halo from the
+    neighbour buffers), so the old ``_extend_rows`` concatenate — a full
+    strip copy per launch — is gone.  ``dst_prev`` (the strip from two
+    launches ago) is aliased onto ``o_hbm``; an elided tile does NOTHING
+    (same S_k == S_{k-2} chain as the single-device kernel)."""
+    del dst_prev  # same memory as o_hbm (aliased); contents ARE the output
     i = pl.program_id(0)
     elide = (prev_ref[i] + prev_ref[i + 1] + prev_ref[i + 2]) == 3
 
     @pl.when(elide)
     def _():
-        c = pltpu.make_async_copy(
-            x_hbm.at[pl.ds(i * tile_h + pad, tile_h), :],
-            tile.at[pl.ds(pad, tile_h), :],
-            sem,
-        )
-        c.start()
-        c.wait()
+        st_ref[i] = 1
 
     @pl.when(jnp.logical_not(elide))
     def _():
-        c = pltpu.make_async_copy(
-            x_hbm.at[pl.ds(i * tile_h, tile_h + 2 * pad), :], tile.at[:], sem
+        center = pltpu.make_async_copy(
+            local.at[pl.ds(i * tile_h, tile_h), :],
+            tile.at[pl.ds(pad, tile_h), :],
+            sems.at[0],
         )
-        c.start()
-        c.wait()
+        center.start()
 
-    # Shared three-tier body: elide / period-6 skip / active-row windowed
-    # compute (round-4) — one home with the single-device kernel.
-    out_center, stable = _elide_probe_or_window(
-        tile, aux, merge, elide, tile_h, pad, turns, rule
-    )
-    o_ref[:] = out_center
-    st_ref[i] = stable
+        # Halo copies: start inside the source-selecting branches, wait
+        # once after all starts — both branches of each pair move the
+        # same (pad, wp) extent to the same destination on the same
+        # semaphore, so a uniform wait descriptor overlaps all three
+        # DMAs (the single-device kernel's shape).
+        @pl.when(i == 0)
+        def _():
+            pltpu.make_async_copy(
+                north.at[:], tile.at[pl.ds(0, pad), :], sems.at[1]
+            ).start()
+
+        @pl.when(i > 0)
+        def _():
+            # (i-1)*tile_h + (tile_h - pad) == i*tile_h - pad, but in the
+            # multiplication-plus-8-multiple form Mosaic can prove
+            # 8-aligned (the subtraction form fails the divisibility
+            # check at compile time).
+            pltpu.make_async_copy(
+                local.at[pl.ds((i - 1) * tile_h + (tile_h - pad), pad), :],
+                tile.at[pl.ds(0, pad), :],
+                sems.at[1],
+            ).start()
+
+        @pl.when(i == grid - 1)
+        def _():
+            pltpu.make_async_copy(
+                south.at[:], tile.at[pl.ds(pad + tile_h, pad), :], sems.at[2]
+            ).start()
+
+        @pl.when(i < grid - 1)
+        def _():
+            pltpu.make_async_copy(
+                local.at[pl.ds((i + 1) * tile_h, pad), :],
+                tile.at[pl.ds(pad + tile_h, pad), :],
+                sems.at[2],
+            ).start()
+
+        pltpu.make_async_copy(
+            north.at[:], tile.at[pl.ds(0, pad), :], sems.at[1]
+        ).wait()
+        pltpu.make_async_copy(
+            south.at[:], tile.at[pl.ds(pad + tile_h, pad), :], sems.at[2]
+        ).wait()
+        center.wait()
+
+        route, stable = _route_active(tile, aux, merge, tile_h, pad, turns, rule)
+        st_ref[i] = stable
+        _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sems.at[0])
 
 
 def _strip_plan_tile(
@@ -164,16 +211,25 @@ def _build_ext_launch_adaptive(
     interpret: bool,
     tile_cap: int | None,
 ):
-    """The adaptive extended-strip launch as ``(prev_ext, ext_strip) ->
-    (centre, bitmap)`` with ``prev_ext`` int32[grid + 2] (neighbour edge
-    flags prepended/appended by the caller)."""
+    """The adaptive strip launch as ``(prev_ext, local, north, south,
+    dst_prev) -> (strip, bitmap)`` with ``prev_ext`` int32[grid + 2]
+    (neighbour edge flags prepended/appended by the caller) and
+    ``dst_prev`` (the strip from two launches ago) ALIASED onto the strip
+    output — the ping-pong write-elision contract (see
+    ``_ext_kernel_adaptive``): callers alternate two buffers and zero the
+    bitmap at dispatch start."""
     h_loc, wp = strip
     _require_adaptive_eligible(turns)
     pad = _round8(turns)
     tile_h = _strip_plan_tile(strip, turns, tile_cap)
     grid = h_loc // tile_h
     kernel = partial(
-        _ext_kernel_adaptive, tile_h=tile_h, pad=pad, turns=turns, rule=rule
+        _ext_kernel_adaptive,
+        tile_h=tile_h,
+        pad=pad,
+        grid=grid,
+        turns=turns,
+        rule=rule,
     )
     return pl.pallas_call(
         kernel,
@@ -181,20 +237,24 @@ def _build_ext_launch_adaptive(
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
-            pl.BlockSpec((tile_h, wp), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((h_loc, wp), jnp.uint32),
             jax.ShapeDtypeStruct((grid,), jnp.int32),
         ],
+        input_output_aliases={4: 0},
         scratch_shapes=[
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # probe buffer
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # merge buffer
-            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((3,)),
         ],
         compiler_params=_compiler_params(tile_h, pad, wp, True),
         interpret=interpret,
@@ -430,11 +490,11 @@ def make_superstep(
             @partial(
                 jax.shard_map,
                 mesh=mesh,
-                in_specs=(BOARD_SPEC, P("y")),
+                in_specs=(P("y"), BOARD_SPEC, BOARD_SPEC),
                 out_specs=(BOARD_SPEC, P("y")),
                 check_vma=False,
             )
-            def step(local, st):
+            def step(st, local, prev):
                 # Neighbour edge-tile flags, exchanged exactly like the
                 # halo rows (self-send on a 1-sized axis = torus wrap).
                 north_flag = lax.ppermute(
@@ -444,7 +504,17 @@ def make_superstep(
                     st[:1], "y", _shift_perm(ny, forward=False)
                 )
                 prev_ext = jnp.concatenate([north_flag, st, south_flag])
-                return call(prev_ext, _extend_rows(local, pad))
+                # Only the pad-row boundaries cross ICI; the kernel
+                # assembles each tile's window itself, so the old
+                # _extend_rows concatenate (a full strip copy per
+                # launch) is gone.
+                north = lax.ppermute(
+                    local[-pad:, :], "y", _shift_perm(ny, forward=True)
+                )
+                south = lax.ppermute(
+                    local[:pad, :], "y", _shift_perm(ny, forward=False)
+                )
+                return call(prev_ext, local, north, south, prev)
 
             return step
 
@@ -457,20 +527,27 @@ def make_superstep(
             step_t = make_step(t, adaptive_ok=True)
             # Bitmap zeroed per dispatch: launch 1 probes every tile, so
             # the inheritance proof's same-plan requirement holds.
+            # Ping-pong (mirrors pallas_packed._run_tiled): two launches
+            # per loop iteration so each strip buffer keeps its carry
+            # slot — a rotating carry would cost XLA a strip copy per
+            # launch.  Post-launch bitmap accumulation by design: the
+            # telemetry counts tiles PROVED stable at each launch
+            # boundary, not executed skip branches
+            # (Backend.skip_fraction documents the trade).
             st0 = jnp.zeros((ny * grid,), jnp.int32)
 
             def body(_, carry):
-                b, st, sk = carry
-                nb, nst = step_t(b, st)
-                # Post-launch bitmap by design: the telemetry counts tiles
-                # PROVED stable at each launch boundary, not executed skip
-                # branches (Backend.skip_fraction documents the trade) —
-                # same accumulation as the single-device engine.
-                return nb, nst, sk + jnp.sum(nst)
+                a, b, st, sk = carry
+                nb1, nst1 = step_t(st, b, a)
+                nb2, nst2 = step_t(nst1, nb1, b)
+                return nb1, nb2, nst2, sk + jnp.sum(nst1) + jnp.sum(nst2)
 
-            board, _, skipped = jax.lax.fori_loop(
-                0, full, body, (board, st0, skipped)
+            a, board, st, skipped = jax.lax.fori_loop(
+                0, full // 2, body, (jnp.zeros_like(board), board, st0, skipped)
             )
+            if full % 2:
+                board, nst = step_t(st, board, a)
+                skipped = skipped + jnp.sum(nst)
         elif full:
             step_t = make_step(t)
             board = jax.lax.fori_loop(0, full, lambda _, b: step_t(b), board)

@@ -468,7 +468,7 @@ def test_skip_stable_auto_policy():
 
 class TestActiveRowWindow:
     """The active-row windowed compute tier (round-4 frontier-overhead
-    attack, ``_elide_probe_or_window``): a probe-failing stripe whose
+    attack, ``_route_active``): a probe-failing stripe whose
     activity is confined to a narrow row interval recomputes only a
     static sub-window at a dynamic 8-aligned offset; every other centre
     row is proved pinned and copies through.  Geometry: tall stripes so

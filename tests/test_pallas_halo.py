@@ -131,3 +131,36 @@ def test_2d_mesh_designed_out_by_halo_model():
 
     assert not pallas_halo.supports((65536, 2048), (2, 4))
     assert pallas_halo.supports((65536, 2048), (8, 1))
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 1), (4, 1)])
+def test_sharded_ping_pong_multi_launch_elision(rng, mesh_shape):
+    """Round-4 sharded ping-pong: dispatches spanning ≥4 launches on a
+    mesh, with ash strips (elided — write-skipped from both buffers) and
+    one active strip; bit-identity vs the XLA packed engine catches any
+    stale-buffer row, and the skip telemetry still counts every launch."""
+    H, W = 512, 4096
+    b = np.zeros((H, W), dtype=np.uint8)
+    b[10:12, 100:102] = 255
+    b[300:302, 3000:3002] = 255
+    for dy, dx in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+        b[150 + dy, 2000 + dx] = 255
+    mesh = make_mesh(mesh_shape)
+    p = packed.pack(jnp.asarray(b))
+    pb = jax.device_put(np.asarray(p), packed_sharding(mesh))
+    strip = (H // mesh_shape[0], W // 32)
+    from distributed_gol_tpu.ops import pallas_packed
+
+    t, adaptive = pallas_packed.adaptive_launch_depth(strip, 960, 64)
+    assert adaptive
+    run = pallas_halo.make_superstep(
+        mesh, CONWAY, skip_stable=True, skip_tile_cap=64, with_stats=True
+    )
+    for turns in (4 * t, 5 * t, 4 * t + 20):  # both parities + remainder split
+        out, skipped = run(pb, turns)
+        ref = packed.superstep(p, CONWAY, turns)
+        assert np.array_equal(np.asarray(out), np.asarray(ref)), turns
+        total = pallas_halo.adaptive_strip_launches(
+            p.shape, mesh_shape, turns, 64
+        )
+        assert total > 0 and 0 < int(skipped) <= total
