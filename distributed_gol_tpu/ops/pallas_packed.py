@@ -547,6 +547,35 @@ def _route_active(tile, aux, merge, tile_h: int, pad: int, turns: int, rule):
     return route, stable.astype(jnp.int32)
 
 
+def _dma_window_in(x_hbm, tile, i, left, right, tile_h, pad, sems):
+    """Load stripe ``i``'s halo-extended window (centre + both pad-row
+    halos, overlapped DMAs) into the ``tile`` scratch — one home for the
+    adaptive kernels' input protocol, like ``_dma_route_out`` for the
+    output.  Offsets are ``tile_index * tile_h + multiple-of-8`` forms so
+    Mosaic can prove 8-alignment."""
+    center = pltpu.make_async_copy(
+        x_hbm.at[pl.ds(i * tile_h, tile_h), :],
+        tile.at[pl.ds(pad, tile_h), :],
+        sems.at[0],
+    )
+    center.start()
+    top = left * tile_h + (tile_h - pad)
+    bot = right * tile_h
+    c1 = pltpu.make_async_copy(
+        x_hbm.at[pl.ds(top, pad), :], tile.at[pl.ds(0, pad), :], sems.at[1]
+    )
+    c2 = pltpu.make_async_copy(
+        x_hbm.at[pl.ds(bot, pad), :],
+        tile.at[pl.ds(pad + tile_h, pad), :],
+        sems.at[2],
+    )
+    c1.start()
+    c2.start()
+    center.wait()
+    c1.wait()
+    c2.wait()
+
+
 def _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sem):
     """Write the centre rows from whichever scratch :func:`_route_active`
     said holds them (0: tile, 1: merge, 2: aux) straight to the output —
@@ -563,6 +592,258 @@ def _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sem):
             )
             out.start()
             out.wait()
+
+
+# -- frontier-tracked adaptive kernel (round 4, tier 4) ------------------------
+#
+# The probing kernel rediscovers the active set every launch: every stripe
+# whose neighbourhood isn't fully skip-proved pays a 6-generation FULL-window
+# probe — in steady state that is the dominant cost (active stripes probe,
+# and so does every stripe ADJACENT to one, because the binary bitmap can't
+# say how far away the neighbour's activity is).  The frontier kernel
+# replaces the bitmap with per-stripe ACTIVE ROW INTERVALS carried in SMEM
+# between launches:
+#
+# - A stripe whose window (+6-row pin margin) intersects no tracked
+#   interval SKIPS with no compute and no probe (soundness: rows ≥ 6 from
+#   every active row are gen-6-pinned — the induction of the skip proof —
+#   and pad ≥ T keeps activity from reaching the centre in one launch, so
+#   the centre is unchanged AND stays pinned; its own interval must have
+#   been empty or it would have self-intersected).  Skipped twice in a row
+#   ⇒ ping-pong write elision as before (ps flag).
+# - A computed stripe derives its recompute sub-window directly from the
+#   interval union (no probe), runs T generations, then 6 MORE and diffs —
+#   the exact new interval for the next launch.  The full-window fallback
+#   measures the same way; pad is deepened to round8(T+6) so gen T+6 is
+#   valid on the whole centre (otherwise edge rows are unmeasurable and
+#   intervals could never tighten after the full launch 1).
+# - Launch 1 starts with FULL intervals (everything computes, exactly like
+#   the probing kernel's probe-everything launch) and measures exact
+#   intervals for launch 2 on.
+_EMPTY_LO = 1 << 30
+
+
+def _frontier_plan(
+    shape: tuple[int, int], turns: int, tile_cap: int | None
+) -> tuple[int, int] | None:
+    """(pad_f, sub_rows) for the frontier kernel, or None when the
+    geometry can't host it OR the probing kernel's per-active-stripe cost
+    is already lower.  tile_h is ALWAYS ``_plan_tile`` — the same grid as
+    the telemetry denominator — only the halo deepens to round8(turns+6).
+
+    The selection is a static cost model, validated on hardware at both
+    poles: per active stripe, frontier ≈ (T+6)·S_f row-gens (no probe,
+    but the sub-window carries t6 margins and the compute restarts at
+    gen 0), probing ≈ 6·h_ext + (T−6)·S_p (full-window probe, reused as
+    the first 6 generations).  Tall tiles (16384²: h_ext ≈ 1104) make
+    the probe dominant — frontier measured 613k vs 183k gens/s settled —
+    while short tiles (65536² cap 512: h_ext = 608) already had cheap
+    probes and frontier's wider windows LOSE skips (measured 3,373 vs
+    5,153; skip fraction 0.8313 vs 0.8828)."""
+    h, wp = shape
+    tile_h = _plan_tile(shape, turns, tile_cap)
+    pad_f = _round8(turns + _SKIP_PERIOD)
+    if pad_f > tile_h:
+        return None
+    if _PLANES * (tile_h + 2 * pad_f) * wp * 4 > _VMEM_BUDGET:
+        return None
+    h_ext_f = tile_h + 2 * pad_f
+    sub_rows = _round8(4 * turns + 96)
+    if sub_rows + 64 > h_ext_f:
+        return None
+    pad_p = _round8(turns)
+    s_p = _window_rows(tile_h, pad_p, turns)
+    if s_p is not None:
+        frontier_cost = (turns + _SKIP_PERIOD) * sub_rows
+        probing_cost = _SKIP_PERIOD * (tile_h + 2 * pad_p) + (
+            turns - _SKIP_PERIOD
+        ) * s_p
+        if probing_cost <= frontier_cost:
+            return None
+    return pad_f, sub_rows
+
+
+def _kernel_frontier(
+    ps_ref, alo_ref, ahi_ref, x_hbm, dst_prev, o_hbm,
+    st_ref, nlo_ref, nhi_ref, tile, aux, merge, sems,
+    *, tile_h, pad, grid, turns, rule, sub_rows,
+):
+    del dst_prev  # same memory as o_hbm (aliased); contents ARE the output
+    i = pl.program_id(0)
+    left = jax.lax.rem(i + grid - 1, grid)
+    right = jax.lax.rem(i + 1, grid)
+    h_ext = tile_h + 2 * pad
+    t6 = turns + _SKIP_PERIOD
+    w_lo = i * tile_h - pad  # window bounds, global rows (frame-local)
+    w_hi = (i + 1) * tile_h + pad - 1  # inclusive
+
+    # Neighbour intervals translated into the adjacency frame: the left
+    # neighbour's rows sit directly above this stripe even across the
+    # torus wrap (content-wise that IS where its halo comes from), so
+    # wrap handling is placement, not cyclic interval arithmetic.
+    hit = jnp.bool_(False)
+    u_lo = jnp.int32(_EMPTY_LO)
+    u_hi = jnp.int32(-_EMPTY_LO)
+    for j, slot in ((left, -1), (i, 0), (right, 1)):
+        off = (i + slot) * tile_h - j * tile_h
+        lo = alo_ref[j] + off
+        hi = ahi_ref[j] + off
+        nonempty = lo <= hi
+        hit = hit | (
+            nonempty
+            & (lo - _SKIP_PERIOD <= w_hi)
+            & (hi + _SKIP_PERIOD >= w_lo)
+        )
+        u_lo = jnp.where(nonempty, jnp.minimum(u_lo, lo), u_lo)
+        u_hi = jnp.where(nonempty, jnp.maximum(u_hi, hi), u_hi)
+
+    @pl.when(jnp.logical_not(hit))
+    def _():
+        st_ref[i] = 1
+        nlo_ref[i] = _EMPTY_LO
+        nhi_ref[i] = -1
+
+        @pl.when(ps_ref[i] == 0)
+        def _():
+            # Skipped, but not twice in a row: the output buffer holds
+            # S_{k-2} ≠ S_k, so the unchanged centre must still be
+            # copied across (VMEM round-trip; elision proper starts the
+            # next launch).
+            c_in = pltpu.make_async_copy(
+                x_hbm.at[pl.ds(i * tile_h, tile_h), :],
+                tile.at[pl.ds(pad, tile_h), :],
+                sems.at[0],
+            )
+            c_in.start()
+            c_in.wait()
+            c_out = pltpu.make_async_copy(
+                tile.at[pl.ds(pad, tile_h), :],
+                o_hbm.at[pl.ds(i * tile_h, tile_h), :],
+                sems.at[0],
+            )
+            c_out.start()
+            c_out.wait()
+
+    @pl.when(hit)
+    def _():
+        st_ref[i] = 0
+        _dma_window_in(x_hbm, tile, i, left, right, tile_h, pad, sems)
+
+        # Activity farther than t6 from the centre can neither change it
+        # nor seed new centre actives this launch: clamp the union there
+        # (sound; only narrows the recompute/measure region).
+        c_lo = i * tile_h
+        c_hi = (i + 1) * tile_h - 1
+        d_lo = jnp.maximum(u_lo, c_lo - t6) - w_lo  # window-frame coords
+        d_hi = jnp.minimum(u_hi, c_hi + t6) - w_lo
+        # Measure region: every possible new centre active lies within
+        # t6 of the (unclamped-within-reach) union.
+        m_lo = jnp.maximum(d_lo - t6, pad)
+        m_hi = jnp.minimum(d_hi + t6, pad + tile_h - 1)
+        idx8 = jnp.clip(d_lo - 2 * turns - 16, 0, h_ext - sub_rows) // 8
+        win_lo = idx8 * 8
+        windowed_ok = (win_lo + t6 <= m_lo) & (m_hi < win_lo + sub_rows - t6)
+
+        wp = tile.shape[1]
+
+        def measure(gT, g6, base_row):
+            """Exact new interval: rows of the measure region where the
+            gen-(T+6) state differs from gen T, in global coords."""
+            rows = jax.lax.broadcasted_iota(jnp.int32, gT.shape, 0) + base_row
+            hot = ((g6 ^ gT) != 0) & (rows >= m_lo) & (rows <= m_hi)
+            lo = jnp.min(jnp.where(hot, rows, jnp.int32(_EMPTY_LO)))
+            hi = jnp.max(jnp.where(hot, rows, jnp.int32(-_EMPTY_LO)))
+            empty = lo > hi
+            return (
+                jnp.where(empty, jnp.int32(_EMPTY_LO), lo + w_lo),
+                jnp.where(empty, jnp.int32(-1), hi + w_lo),
+            )
+
+        def windowed():
+            sub0 = tile[pl.ds(win_lo, sub_rows), :]
+            gT = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), sub0)
+            k = jax.lax.broadcasted_iota(jnp.int32, (sub_rows, wp), 0)
+            valid = (k >= turns) & (k < sub_rows - turns)
+            fixed = jnp.where(valid, gT, tile[pl.ds(win_lo, sub_rows), :])
+            merge[:] = tile[:]
+            merge[pl.ds(win_lo, sub_rows), :] = fixed
+            g6 = jax.lax.fori_loop(
+                0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), gT
+            )
+            lo, hi = measure(gT, g6, win_lo)
+            return jnp.int32(1), lo, hi
+
+        def full():
+            gT = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), tile[:])
+            aux[:] = gT
+            g6 = jax.lax.fori_loop(
+                0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), gT
+            )
+            lo, hi = measure(gT, g6, 0)
+            return jnp.int32(2), lo, hi
+
+        route, lo, hi = jax.lax.cond(windowed_ok, windowed, full)
+        nlo_ref[i] = lo
+        nhi_ref[i] = hi
+        _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sems.at[0])
+
+
+@functools.lru_cache(maxsize=None)
+def _build_launch_frontier(
+    shape: tuple[int, int],
+    rule: LifeRule,
+    turns: int,
+    interpret: bool,
+    tile_cap: int | None,
+):
+    """The frontier launch as ``(ps, alo, ahi, board, dst_prev) ->
+    (board, st, nlo, nhi)`` with ``dst_prev`` aliased onto the board
+    output (ping-pong, as ``_build_launch_adaptive``)."""
+    h, wp = shape
+    _require_adaptive_eligible(turns)
+    plan = _frontier_plan(shape, turns, tile_cap)
+    if plan is None:
+        raise ValueError(f"no frontier plan for {turns} turns on {shape}")
+    pad, sub_rows = plan
+    tile_h = _plan_tile(shape, turns, tile_cap)
+    grid = h // tile_h
+    kernel = partial(
+        _kernel_frontier,
+        tile_h=tile_h,
+        pad=pad,
+        grid=grid,
+        turns=turns,
+        rule=rule,
+        sub_rows=sub_rows,
+    )
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            smem,
+            smem,
+            smem,
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY), smem, smem, smem],
+        out_shape=[
+            jax.ShapeDtypeStruct((h, wp), jnp.uint32),
+            jax.ShapeDtypeStruct((grid,), jnp.int32),
+            jax.ShapeDtypeStruct((grid,), jnp.int32),
+            jax.ShapeDtypeStruct((grid,), jnp.int32),
+        ],
+        input_output_aliases={4: 0},
+        scratch_shapes=[
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # full buffer
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # merge buffer
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        compiler_params=_compiler_params(tile_h, pad, wp, True),
+        interpret=interpret,
+    )
 
 
 def _kernel_adaptive(
@@ -605,28 +886,7 @@ def _kernel_adaptive(
 
     @pl.when(jnp.logical_not(elide))
     def _():
-        center = pltpu.make_async_copy(
-            x_hbm.at[pl.ds(i * tile_h, tile_h), :],
-            tile.at[pl.ds(pad, tile_h), :],
-            sems.at[0],
-        )
-        center.start()
-        top = left * tile_h + (tile_h - pad)
-        bot = right * tile_h
-        c1 = pltpu.make_async_copy(
-            x_hbm.at[pl.ds(top, pad), :], tile.at[pl.ds(0, pad), :], sems.at[1]
-        )
-        c2 = pltpu.make_async_copy(
-            x_hbm.at[pl.ds(bot, pad), :],
-            tile.at[pl.ds(pad + tile_h, pad), :],
-            sems.at[2],
-        )
-        c1.start()
-        c2.start()
-        center.wait()
-        c1.wait()
-        c2.wait()
-
+        _dma_window_in(x_hbm, tile, i, left, right, tile_h, pad, sems)
         route, stable = _route_active(tile, aux, merge, tile_h, pad, turns, rule)
         st_ref[i] = stable
         _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sems.at[0])
@@ -848,37 +1108,67 @@ def _run_tiled(
     full, rem = divmod(turns, t)
     skipped = jnp.int32(0)
     if adaptive and full:
-        # Frontier-aware elision: the skip bitmap is carried between the
-        # identical-geometry launches of THIS dispatch only (zeroed here),
-        # so the inheritance proof's same-plan requirement holds by
-        # construction; the first launch probes every tile.
+        # State (skip flags; plus active intervals for the frontier
+        # kernel) is carried between the identical-geometry launches of
+        # THIS dispatch only (reset here), so the inheritance proofs'
+        # same-plan requirement holds by construction; launch 1 computes
+        # every tile.
         #
         # Ping-pong: each launch writes into the buffer from two launches
-        # ago (aliased output), so an elided tile skips its write — its
+        # ago (aliased output), so a skipped tile elides its write — its
         # rows there already hold S_{k-2} == S_k.  The loop body unrolls
         # TWO launches so each buffer stays in its own carry slot (slot
         # a = odd states, slot b = even states): a rotating (prev, cur)
         # carry would make XLA break the buffer cycle with a full-board
         # copy per launch (measured: all-ash fell from 681k to 206k
-        # gens/s before the unroll).  Launch 1 sees a zero bitmap and
-        # writes every tile, fully defining buffer a regardless of its
-        # initial contents.
-        call = _build_launch_adaptive(shape, rule, t, ip, cap)
-        grid = shape[0] // _plan_tile(shape, t, cap)
-        st0 = jnp.zeros((grid,), jnp.int32)
+        # gens/s before the unroll).
+        tile_h = _plan_tile(shape, t, cap)
+        grid = shape[0] // tile_h
+        fplan = _frontier_plan(shape, t, cap)
+        if fplan is not None:
+            # Frontier-tracked kernel: per-stripe active-row intervals
+            # replace both the probe and the binary elision bitmap.
+            # Launch 1 starts from FULL intervals (everything computes,
+            # measuring exact intervals for launch 2 on).
+            call = _build_launch_frontier(shape, rule, t, ip, cap)
+            lo0 = jnp.arange(grid, dtype=jnp.int32) * tile_h
+            hi0 = lo0 + (tile_h - 1)
+            ps0 = jnp.zeros((grid,), jnp.int32)
 
-        def body(_, carry):
-            a, b, st, sk = carry
-            nb1, nst1 = call(st, b, a)
-            nb2, nst2 = call(nst1, nb1, b)
-            return nb1, nb2, nst2, sk + jnp.sum(nst1) + jnp.sum(nst2)
+            def body(_, carry):
+                a, b, ps, lo, hi, sk = carry
+                nb1, st1, lo1, hi1 = call(ps, lo, hi, b, a)
+                nb2, st2, lo2, hi2 = call(st1, lo1, hi1, nb1, b)
+                return (
+                    nb1, nb2, st2, lo2, hi2,
+                    sk + jnp.sum(st1) + jnp.sum(st2),
+                )
 
-        a, board, st, skipped = jax.lax.fori_loop(
-            0, full // 2, body, (jnp.zeros_like(board), board, st0, skipped)
-        )
-        if full % 2:
-            board, nst = call(st, board, a)
-            skipped = skipped + jnp.sum(nst)
+            a, board, ps, flo, fhi, skipped = jax.lax.fori_loop(
+                0,
+                full // 2,
+                body,
+                (jnp.zeros_like(board), board, ps0, lo0, hi0, skipped),
+            )
+            if full % 2:
+                board, st1, _, _ = call(ps, flo, fhi, board, a)
+                skipped = skipped + jnp.sum(st1)
+        else:
+            call = _build_launch_adaptive(shape, rule, t, ip, cap)
+            st0 = jnp.zeros((grid,), jnp.int32)
+
+            def body(_, carry):
+                a, b, st, sk = carry
+                nb1, nst1 = call(st, b, a)
+                nb2, nst2 = call(nst1, nb1, b)
+                return nb1, nb2, nst2, sk + jnp.sum(nst1) + jnp.sum(nst2)
+
+            a, board, st, skipped = jax.lax.fori_loop(
+                0, full // 2, body, (jnp.zeros_like(board), board, st0, skipped)
+            )
+            if full % 2:
+                board, nst = call(st, board, a)
+                skipped = skipped + jnp.sum(nst)
     elif full:
         call = _build_launch(shape, rule, t, ip, False, cap)
         board = jax.lax.fori_loop(0, full, lambda _, b: call(b), board)

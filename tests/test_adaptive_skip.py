@@ -595,3 +595,19 @@ class TestPingPongWriteElision:
         t, _ = pallas_packed.adaptive_launch_depth((self.HT, self.WT // 32), 960, 512)
         self._run_both(b, 4 * t)
         self._run_both(b, 4 * t + 20)  # + remainder split path
+
+    def test_probing_kernel_still_covered_when_frontier_declines(self, monkeypatch):
+        # The static cost model routes this geometry to the frontier
+        # kernel; force the probing ping-pong kernel so its write-elision
+        # path keeps interpret coverage (it remains the fallback for
+        # short-tile geometries like 65536² cap 512, where it measures
+        # faster — see _frontier_plan).
+        monkeypatch.setattr(pallas_packed, "_frontier_plan", lambda *a: None)
+        pallas_packed._build_launch_adaptive.cache_clear()
+        b = np.zeros((self.HT, self.WT), dtype=np.uint8)
+        b[100:102, 200:202] = 255
+        for dy, dx in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+            b[1000 + dy, 2000 + dx] = 255
+        t, _ = pallas_packed.adaptive_launch_depth((self.HT, self.WT // 32), 960, 512)
+        self._run_both(b, 4 * t)
+        self._run_both(b, 5 * t)
