@@ -659,8 +659,15 @@ def measure_record(args, size, engine, skip_stable, burnin, dev) -> dict:
                 # record actually is.
                 record["controller_path_regime"] = "fresh-soup"
         cp_gps, _ = bench_controller_path(size, **cp_kwargs)
-        record["controller_path_gps"] = round(cp_gps, 2)
-        record["controller_vs_engine"] = round(cp_gps / gps, 4) if gps else 0.0
+        if cp_gps > 0:
+            record["controller_path_gps"] = round(cp_gps, 2)
+            record["controller_vs_engine"] = round(cp_gps / gps, 4) if gps else 0.0
+        else:
+            # Empty steady window (e.g. the jit compile ate the whole
+            # budget): an honest absence beats publishing 0.0 as a rate.
+            log("  controller path: no steady window inside the budget; "
+                "field omitted")
+            record["controller_path_note"] = "no steady window inside budget"
     if not args.no_verify:
         ok = verify_engine(
             size,

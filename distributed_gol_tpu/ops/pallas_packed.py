@@ -748,15 +748,15 @@ def _kernel_frontier(
 
         def measure(gT, g6, base_row):
             """Exact new interval: rows of the measure region where the
-            gen-(T+6) state differs from gen T, in global coords."""
-            rows = jax.lax.broadcasted_iota(jnp.int32, gT.shape, 0) + base_row
-            hot = ((g6 ^ gT) != 0) & (rows >= m_lo) & (rows <= m_hi)
-            lo = jnp.min(jnp.where(hot, rows, jnp.int32(_EMPTY_LO)))
-            hi = jnp.max(jnp.where(hot, rows, jnp.int32(-_EMPTY_LO)))
+            gen-(T+6) state differs from gen T, in global coords — the
+            reduction itself is the shared ``_active_interval``."""
+            fr = jax.lax.broadcasted_iota(jnp.int32, gT.shape, 0) + base_row
+            inner = (fr >= m_lo) & (fr <= m_hi)
+            lo, hi = _active_interval(g6 ^ gT, inner, gT.shape[0])
             empty = lo > hi
             return (
-                jnp.where(empty, jnp.int32(_EMPTY_LO), lo + w_lo),
-                jnp.where(empty, jnp.int32(-1), hi + w_lo),
+                jnp.where(empty, jnp.int32(_EMPTY_LO), lo + base_row + w_lo),
+                jnp.where(empty, jnp.int32(-1), hi + base_row + w_lo),
             )
 
         def windowed():
