@@ -225,9 +225,13 @@ class Backend:
                 # the right engine for such boards (16², 48-wide...), not a
                 # degraded one — the README matrix documents the bound.
                 return
+            # On a 2-D mesh (nx > 1) 'packed' IS auto's by-design choice:
+            # the flagship kernel is row-mesh-only (pallas_halo.supports
+            # requires nx == 1; halo_bytes_2d_model pins why), so running
+            # it there isn't a downgrade and must not warn (advisor r4).
             preferred = (
                 "pallas-packed"
-                if jax.default_backend() == "tpu"
+                if jax.default_backend() == "tpu" and mesh_shape[1] == 1
                 else "packed"
             )
             if self._ENGINE_RANK[self.engine_used] >= self._ENGINE_RANK[preferred]:

@@ -101,6 +101,23 @@ def test_auto_downgrade_warns_on_packable_widths():
         used("auto", mesh=(1, 4), width=4128, height=64)
 
 
+def test_auto_2d_mesh_on_tpu_is_policy_not_downgrade(monkeypatch, recwarn):
+    """Advisor r4: auto on a 2-D mesh resolves to 'packed' BY DESIGN (the
+    flagship kernel is row-mesh-only), so a TPU backend must not warn.
+    The backend is faked to 'tpu' for the resolution only — the (2, 2)
+    mesh never reaches a Pallas build (supports() rejects nx > 1 first)."""
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert used("auto", mesh=(2, 2)) == "packed"
+    assert not [w for w in recwarn if w.category is RuntimeWarning]
+    # Pin the asymmetry: on a SINGLE device (a degenerate row mesh) the
+    # same fake backend does prefer pallas-packed, so a width only the
+    # packed engine takes (640: wp % 128 != 0, H % 256 != 0) must warn.
+    with pytest.warns(RuntimeWarning, match="falling back to 'packed'"):
+        assert used("auto", width=640) == "packed"
+
+
 def test_no_warning_when_engine_honoured_or_policy(recwarn):
     used("packed")  # honoured exactly
     used("auto")  # CPU auto prefers packed and gets it
