@@ -132,7 +132,11 @@ def _round8(x: int) -> int:
 
 
 def _compiler_params(
-    tile_h: int, pad: int, wp: int, skip_stable: bool = False
+    tile_h: int,
+    pad: int,
+    wp: int,
+    skip_stable: bool = False,
+    sequential_grid: bool = False,
 ) -> pltpu.CompilerParams:
     """Raise Mosaic's scoped-VMEM ceiling (default 16 MB) to what the tile
     actually needs: the budgeted working set plus slack for DMA double
@@ -146,7 +150,13 @@ def _compiler_params(
     # active-row windowed compute.
     factor = 2.5 if skip_stable else 1.3
     return pltpu.CompilerParams(
-        vmem_limit_bytes=min(120 << 20, int(ws * factor) + (8 << 20))
+        vmem_limit_bytes=min(120 << 20, int(ws * factor) + (8 << 20)),
+        # The megakernel's launch axis MUST run in issue order (SMEM state
+        # carries across grid steps); "arbitrary" semantics pin both dims
+        # sequential.
+        dimension_semantics=("arbitrary", "arbitrary")
+        if sequential_grid
+        else None,
     )
 
 
@@ -594,7 +604,7 @@ def _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sem):
             out.wait()
 
 
-# -- frontier-tracked adaptive kernel (round 4, tier 4) ------------------------
+# -- frontier-tracked adaptive kernel (round 4 tier 4; round 5: megakernel) ----
 #
 # The probing kernel rediscovers the active set every launch: every stripe
 # whose neighbourhood isn't fully skip-proved pays a 6-generation FULL-window
@@ -620,6 +630,30 @@ def _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sem):
 # - Launch 1 starts with FULL intervals (everything computes, exactly like
 #   the probing kernel's probe-everything launch) and measures exact
 #   intervals for launch 2 on.
+#
+# Round 5 adds, on top of the round-4 tier:
+#
+# - TWO tracked intervals per stripe (``_measure2``): the exact active-row
+#   set is split at the midpoint of its span, so a stripe carrying two
+#   separated clusters no longer publishes one stripe-wide union — the
+#   round-4 65536² cap sweep showed that union collapsing the skip cascade
+#   (BASELINE.md: skip pinned at 0.831 while the real residue was 163
+#   words in 15/128 stripes).
+# - Per-interval CLAMPING before the recompute union (``_hit_union``):
+#   interval parts farther than T+6 rows from every centre row can neither
+#   change the centre this launch nor seed a measurable new active, so
+#   they are intersected away per interval BEFORE the union — a
+#   neighbour's far cluster no longer drags this stripe's recompute
+#   window wide open.
+# - The WHOLE DISPATCH runs as ONE pallas_call (``_kernel_frontier_mega``):
+#   grid (launches, stripes), executed sequentially in row-major order, so
+#   the interval/skip state lives in SMEM scratch across launches and the
+#   ping-pong buffers are two aliased HBM refs the kernel reads/writes by
+#   launch parity.  The round-4 form paid one XLA dispatch per launch —
+#   measured 33 µs fixed (all-dead 16384² floor: 910k gens/s at T=30,
+#   i.e. 1.1 µs/gen of pure launch overhead vs 1.8 µs/gen of real work on
+#   the settled board).  One launch per DISPATCH makes that overhead
+#   per-dispatch instead of per-launch.
 _EMPTY_LO = 1 << 30
 
 
@@ -663,142 +697,284 @@ def _frontier_plan(
     return pad_f, sub_rows
 
 
-def _kernel_frontier(
-    ps_ref, alo_ref, ahi_ref, x_hbm, dst_prev, o_hbm,
-    st_ref, nlo_ref, nhi_ref, tile, aux, merge, sems,
-    *, tile_h, pad, grid, turns, rule, sub_rows,
-):
-    del dst_prev  # same memory as o_hbm (aliased); contents ARE the output
-    i = pl.program_id(0)
-    left = jax.lax.rem(i + grid - 1, grid)
-    right = jax.lax.rem(i + 1, grid)
-    h_ext = tile_h + 2 * pad
-    t6 = turns + _SKIP_PERIOD
-    w_lo = i * tile_h - pad  # window bounds, global rows (frame-local)
-    w_hi = (i + 1) * tile_h + pad - 1  # inclusive
+def _hit_union(ivals, w_lo, w_hi, c_lo, c_hi, t6):
+    """Fold a neighbourhood's tracked intervals (scalar (lo, hi) pairs
+    already translated into this stripe's row frame) into the skip
+    decision and the clamped recompute union.
 
-    # Neighbour intervals translated into the adjacency frame: the left
-    # neighbour's rows sit directly above this stripe even across the
-    # torus wrap (content-wise that IS where its halo comes from), so
-    # wrap handling is placement, not cyclic interval arithmetic.
+    ``hit``: some interval (+6-row pin margin) reaches the window — the
+    exact complement of the skip proof's "no activity near the window".
+    ``(u_lo, u_hi)``: union of the intervals intersected with the reach
+    band [c_lo − t6, c_hi + t6].  Activity farther than t6 = T+6 rows
+    from every centre row can neither change the centre within T
+    generations nor seed a new active measurable at gen T+6, so it is
+    dropped PER INTERVAL before the union (round 5) — clamping the union
+    afterwards (round 4) kept phantom rows between a far cluster and the
+    band edge.  ``hit`` with an empty union is legal (activity within the
+    pad-rounding sliver of the window but outside the band): the compute
+    branch then recomputes nothing and measures an empty region, which
+    is sound — see ``_frontier_body``."""
     hit = jnp.bool_(False)
     u_lo = jnp.int32(_EMPTY_LO)
     u_hi = jnp.int32(-_EMPTY_LO)
-    for j, slot in ((left, -1), (i, 0), (right, 1)):
-        off = (i + slot) * tile_h - j * tile_h
-        lo = alo_ref[j] + off
-        hi = ahi_ref[j] + off
+    for lo, hi in ivals:
         nonempty = lo <= hi
         hit = hit | (
             nonempty
             & (lo - _SKIP_PERIOD <= w_hi)
             & (hi + _SKIP_PERIOD >= w_lo)
         )
-        u_lo = jnp.where(nonempty, jnp.minimum(u_lo, lo), u_lo)
-        u_hi = jnp.where(nonempty, jnp.maximum(u_hi, hi), u_hi)
+        clo = jnp.maximum(lo, c_lo - t6)
+        chi = jnp.minimum(hi, c_hi + t6)
+        keep = nonempty & (clo <= chi)
+        u_lo = jnp.where(keep, jnp.minimum(u_lo, clo), u_lo)
+        u_hi = jnp.where(keep, jnp.maximum(u_hi, chi), u_hi)
+    return hit, u_lo, u_hi
+
+
+def _measure2(gT, g6, base_row, m_lo, m_hi, frame_off):
+    """Exact new intervals: rows of the measure region where the
+    gen-(T+6) state differs from gen T, split into up to TWO disjoint
+    intervals at the midpoint of their span (round 5).  The split lets a
+    stripe carrying two separated clusters publish them separately
+    instead of as one stripe-wide union — the mechanism behind the
+    65536² skip-cascade collapse (BASELINE.md round-4 cap sweep).
+    Returns stripe-frame (lo0, hi0, lo1, hi1); empty = (_EMPTY_LO, −1);
+    interval 0 sits strictly below interval 1 when both are nonempty."""
+    diff = g6 ^ gT
+    rows = jax.lax.broadcasted_iota(jnp.int32, gT.shape, 0) + base_row
+    hot = (rows >= m_lo) & (rows <= m_hi) & (diff != 0)
+    lo = jnp.min(jnp.where(hot, rows, jnp.int32(_EMPTY_LO)))
+    hi = jnp.max(jnp.where(hot, rows, jnp.int32(-_EMPTY_LO)))
+    # Midpoint split: a valid 2-interval cover for any threshold (every
+    # active row lands in exactly one side); the midpoint separates the
+    # common case — two compact clusters — whenever their gap spans it.
+    t = (lo + hi) // 2
+    hi0 = jnp.max(jnp.where(hot & (rows <= t), rows, jnp.int32(-_EMPTY_LO)))
+    lo1 = jnp.min(jnp.where(hot & (rows > t), rows, jnp.int32(_EMPTY_LO)))
+    empty = lo > hi
+    e1 = lo1 > hi  # nothing above the split: interval 0 carries [lo, hi]
+    return (
+        jnp.where(empty, jnp.int32(_EMPTY_LO), lo + frame_off),
+        jnp.where(empty, jnp.int32(-1), jnp.where(e1, hi, hi0) + frame_off),
+        jnp.where(empty | e1, jnp.int32(_EMPTY_LO), lo1 + frame_off),
+        jnp.where(empty | e1, jnp.int32(-1), hi + frame_off),
+    )
+
+
+def _frontier_body(tile, aux, merge, u_lo, u_hi, i, tile_h, pad, turns, rule, sub_rows):
+    """The compute branch of the frontier kernels — everything between
+    the window DMA-in and the routed DMA-out, factored out so the
+    sharded strip form can share it verbatim.  Derives the
+    recompute sub-window straight from the clamped interval union (no
+    probe), advances it T generations, then 6 more to measure the exact
+    new intervals.  Returns (route, lo0, hi0, lo1, hi1): route as in
+    :func:`_dma_route_out`, intervals in stripe-frame rows.
+
+    Soundness (unchanged from round 4, restated for the clamped union):
+    every active row reachable from this stripe's centre survives the
+    per-interval clamp (it is within t6 of a centreated row — see
+    ``_hit_union``), so centre rows farther than T from [u_lo, u_hi] are
+    T-pinned and keep their gen-0 value; the sub-window's validity
+    region always covers the recompute region when ``windowed_ok``
+    (checked directly), and sub-window rows in the validity region are
+    the TRUE gen-T state regardless of the intervals — their full light
+    cone lies inside the window, which was loaded from the true gen-0
+    tile.  The measure region [d − t6, d + t6] ∩ centre covers every row
+    whose state can differ between gens T and T+6 (such a row is within
+    6 of a gen-T active row, itself within T of a gen-0 one)."""
+    h_ext = tile_h + 2 * pad
+    t6 = turns + _SKIP_PERIOD
+    w_lo = i * tile_h - pad  # window top, stripe-frame rows
+    d_lo = u_lo - w_lo  # window-frame coords
+    d_hi = u_hi - w_lo
+    m_lo = jnp.maximum(d_lo - t6, pad)
+    m_hi = jnp.minimum(d_hi + t6, pad + tile_h - 1)
+    # Expressed as idx8 * 8 so Mosaic can statically prove the dynamic
+    # sublane offset is 8-aligned (clip/and-mask forms lose the proof).
+    idx8 = jnp.clip(d_lo - 2 * turns - 16, 0, h_ext - sub_rows) // 8
+    win_lo = idx8 * 8
+    # Eligibility = exact coverage: the whole measure region (a superset
+    # of the centre's recompute region) must land in the sub-window's
+    # gen-(T+6) validity region [win_lo + t6, win_lo + S − t6).
+    windowed_ok = (win_lo + t6 <= m_lo) & (m_hi < win_lo + sub_rows - t6)
+    wp = tile.shape[1]
+
+    def windowed():
+        sub0 = tile[pl.ds(win_lo, sub_rows), :]
+        gT = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), sub0)
+        k = jax.lax.broadcasted_iota(jnp.int32, (sub_rows, wp), 0)
+        valid = (k >= turns) & (k < sub_rows - turns)
+        fixed = jnp.where(valid, gT, tile[pl.ds(win_lo, sub_rows), :])
+        merge[:] = tile[:]
+        merge[pl.ds(win_lo, sub_rows), :] = fixed
+        g6 = jax.lax.fori_loop(0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), gT)
+        return (jnp.int32(1),) + _measure2(gT, g6, win_lo, m_lo, m_hi, w_lo)
+
+    def full():
+        gT = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), tile[:])
+        aux[:] = gT
+        g6 = jax.lax.fori_loop(0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), gT)
+        return (jnp.int32(2),) + _measure2(gT, g6, 0, m_lo, m_hi, w_lo)
+
+    return jax.lax.cond(windowed_ok, windowed, full)
+
+
+def _kernel_frontier_mega(
+    xa, xb, oa, ob, sk_ref,
+    tile, aux, merge,
+    ilo0, ihi0, ilo1, ihi1, ist,
+    acc, sems,
+    *, tile_h, pad, grid, nlaunch, turns, rule, sub_rows,
+):
+    """The WHOLE adaptive dispatch as one kernel: grid (nlaunch, grid)
+    executes launches in row-major order (dimension_semantics
+    "arbitrary" — sequential), so SMEM scratch carries the per-stripe
+    interval/skip state across launches and the two HBM board refs
+    ping-pong by launch parity.
+
+    Buffer protocol: ``oa`` holds S_0 on entry (aliased input board);
+    launch l reads the board written at l−1 (``oa`` for even l) and
+    writes the buffer last written at l−2 (``ob`` for even l) — an
+    elided stripe's rows there already hold S_{l−2} == S_l, the round-4
+    ping-pong invariant, now without the two-launch XLA unroll.  Launch
+    0 computes every stripe (forced full union), so ``ob`` is fully
+    defined before any elision.  The final board sits in ``ob`` when
+    nlaunch is odd, ``oa`` when even — the builder's caller selects.
+
+    State protocol: the interval/stability scratches are (2, grid),
+    row l%2 written by launch l, neighbours read from row (l+1)%2 —
+    so a stripe never reads a neighbour's CURRENT-launch value no
+    matter the grid order within one launch.  (The HBM board refs
+    can't be indexed dynamically, hence their pl.when parity blocks;
+    SMEM can, hence one array each.)"""
+    del xa, xb  # same memory as oa/ob (aliased); contents ARE the boards
+    l = pl.program_id(0)
+    i = pl.program_id(1)
+    left = jax.lax.rem(i + grid - 1, grid)
+    right = jax.lax.rem(i + 1, grid)
+    t6 = turns + _SKIP_PERIOD
+    w_lo = i * tile_h - pad
+    w_hi = (i + 1) * tile_h + pad - 1
+    c_lo = i * tile_h
+    c_hi = (i + 1) * tile_h - 1
+    wr = jax.lax.rem(l, 2)
+    rd = 1 - wr
+    even = wr == 0
+    first = l == 0
+
+    @pl.when(first & (i == 0))
+    def _():
+        acc[0] = 0
+
+    # Neighbour intervals from the previous launch's state row, placed
+    # into this stripe's frame: the left neighbour's rows sit directly
+    # above even across the torus wrap (content-wise that IS where its
+    # halo comes from), so wrap handling is placement, not cyclic
+    # interval arithmetic.
+    ivals = []
+    for j, slot in ((left, -1), (i, 0), (right, 1)):
+        off = (i + slot) * tile_h - j * tile_h
+        ivals.append((ilo0[rd, j] + off, ihi0[rd, j] + off))
+        ivals.append((ilo1[rd, j] + off, ihi1[rd, j] + off))
+    hit, u_lo, u_hi = _hit_union(ivals, w_lo, w_hi, c_lo, c_hi, t6)
+    # Launch 0: no tracked state yet — force the probing kernel's
+    # "launch 1 computes everything" semantics with the maximal clamped
+    # union (windowed_ok then fails, so the full branch measures the
+    # exact intervals for launch 1 on).
+    hit = hit | first
+    u_lo = jnp.where(first, c_lo - t6, u_lo)
+    u_hi = jnp.where(first, c_hi + t6, u_hi)
+    # Own skip flag from the previous launch (launch 0 never reads it).
+    ps = ist[rd, i]
+
+    def put_state(st, lo0, hi0, lo1, hi1):
+        ist[wr, i] = st
+        ilo0[wr, i] = lo0
+        ihi0[wr, i] = hi0
+        ilo1[wr, i] = lo1
+        ihi1[wr, i] = hi1
 
     @pl.when(jnp.logical_not(hit))
     def _():
-        st_ref[i] = 1
-        nlo_ref[i] = _EMPTY_LO
-        nhi_ref[i] = -1
+        put_state(1, _EMPTY_LO, -1, _EMPTY_LO, -1)
+        acc[0] = acc[0] + 1
 
-        @pl.when(ps_ref[i] == 0)
+        @pl.when(ps == 0)
         def _():
-            # Skipped, but not twice in a row: the output buffer holds
-            # S_{k-2} ≠ S_k, so the unchanged centre must still be
+            # Skipped, but not twice in a row: the write buffer holds
+            # S_{l−2} ≠ S_l, so the unchanged centre must still be
             # copied across (VMEM round-trip; elision proper starts the
             # next launch).
-            c_in = pltpu.make_async_copy(
-                x_hbm.at[pl.ds(i * tile_h, tile_h), :],
-                tile.at[pl.ds(pad, tile_h), :],
-                sems.at[0],
-            )
-            c_in.start()
-            c_in.wait()
-            c_out = pltpu.make_async_copy(
-                tile.at[pl.ds(pad, tile_h), :],
-                o_hbm.at[pl.ds(i * tile_h, tile_h), :],
-                sems.at[0],
-            )
-            c_out.start()
-            c_out.wait()
+            def copy_centre(src, dst):
+                c_in = pltpu.make_async_copy(
+                    src.at[pl.ds(i * tile_h, tile_h), :],
+                    tile.at[pl.ds(pad, tile_h), :],
+                    sems.at[0],
+                )
+                c_in.start()
+                c_in.wait()
+                c_out = pltpu.make_async_copy(
+                    tile.at[pl.ds(pad, tile_h), :],
+                    dst.at[pl.ds(i * tile_h, tile_h), :],
+                    sems.at[0],
+                )
+                c_out.start()
+                c_out.wait()
+
+            @pl.when(even)
+            def _():
+                copy_centre(oa, ob)
+
+            @pl.when(jnp.logical_not(even))
+            def _():
+                copy_centre(ob, oa)
 
     @pl.when(hit)
     def _():
-        st_ref[i] = 0
-        _dma_window_in(x_hbm, tile, i, left, right, tile_h, pad, sems)
+        @pl.when(even)
+        def _():
+            _dma_window_in(oa, tile, i, left, right, tile_h, pad, sems)
 
-        # Activity farther than t6 from the centre can neither change it
-        # nor seed new centre actives this launch: clamp the union there
-        # (sound; only narrows the recompute/measure region).
-        c_lo = i * tile_h
-        c_hi = (i + 1) * tile_h - 1
-        d_lo = jnp.maximum(u_lo, c_lo - t6) - w_lo  # window-frame coords
-        d_hi = jnp.minimum(u_hi, c_hi + t6) - w_lo
-        # Measure region: every possible new centre active lies within
-        # t6 of the (unclamped-within-reach) union.
-        m_lo = jnp.maximum(d_lo - t6, pad)
-        m_hi = jnp.minimum(d_hi + t6, pad + tile_h - 1)
-        idx8 = jnp.clip(d_lo - 2 * turns - 16, 0, h_ext - sub_rows) // 8
-        win_lo = idx8 * 8
-        windowed_ok = (win_lo + t6 <= m_lo) & (m_hi < win_lo + sub_rows - t6)
+        @pl.when(jnp.logical_not(even))
+        def _():
+            _dma_window_in(ob, tile, i, left, right, tile_h, pad, sems)
 
-        wp = tile.shape[1]
+        route, lo0, hi0, lo1, hi1 = _frontier_body(
+            tile, aux, merge, u_lo, u_hi, i, tile_h, pad, turns, rule, sub_rows
+        )
+        put_state(0, lo0, hi0, lo1, hi1)
 
-        def measure(gT, g6, base_row):
-            """Exact new interval: rows of the measure region where the
-            gen-(T+6) state differs from gen T, in global coords — the
-            reduction itself is the shared ``_active_interval``."""
-            fr = jax.lax.broadcasted_iota(jnp.int32, gT.shape, 0) + base_row
-            inner = (fr >= m_lo) & (fr <= m_hi)
-            lo, hi = _active_interval(g6 ^ gT, inner, gT.shape[0])
-            empty = lo > hi
-            return (
-                jnp.where(empty, jnp.int32(_EMPTY_LO), lo + base_row + w_lo),
-                jnp.where(empty, jnp.int32(-1), hi + base_row + w_lo),
-            )
+        @pl.when(even)
+        def _():
+            _dma_route_out(route, tile, merge, aux, ob, i, tile_h, pad, sems.at[0])
 
-        def windowed():
-            sub0 = tile[pl.ds(win_lo, sub_rows), :]
-            gT = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), sub0)
-            k = jax.lax.broadcasted_iota(jnp.int32, (sub_rows, wp), 0)
-            valid = (k >= turns) & (k < sub_rows - turns)
-            fixed = jnp.where(valid, gT, tile[pl.ds(win_lo, sub_rows), :])
-            merge[:] = tile[:]
-            merge[pl.ds(win_lo, sub_rows), :] = fixed
-            g6 = jax.lax.fori_loop(
-                0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), gT
-            )
-            lo, hi = measure(gT, g6, win_lo)
-            return jnp.int32(1), lo, hi
+        @pl.when(jnp.logical_not(even))
+        def _():
+            _dma_route_out(route, tile, merge, aux, oa, i, tile_h, pad, sems.at[0])
 
-        def full():
-            gT = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), tile[:])
-            aux[:] = gT
-            g6 = jax.lax.fori_loop(
-                0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), gT
-            )
-            lo, hi = measure(gT, g6, 0)
-            return jnp.int32(2), lo, hi
-
-        route, lo, hi = jax.lax.cond(windowed_ok, windowed, full)
-        nlo_ref[i] = lo
-        nhi_ref[i] = hi
-        _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sems.at[0])
+    @pl.when((l == nlaunch - 1) & (i == grid - 1))
+    def _():
+        sk_ref[0] = acc[0]
 
 
 @functools.lru_cache(maxsize=None)
-def _build_launch_frontier(
+def _build_dispatch_frontier(
     shape: tuple[int, int],
     rule: LifeRule,
     turns: int,
+    nlaunch: int,
     interpret: bool,
     tile_cap: int | None,
 ):
-    """The frontier launch as ``(ps, alo, ahi, board, dst_prev) ->
-    (board, st, nlo, nhi)`` with ``dst_prev`` aliased onto the board
-    output (ping-pong, as ``_build_launch_adaptive``)."""
+    """The frontier megakernel as ``(board, scratch_board) ->
+    (board_a, board_b, skipped)`` — ``nlaunch`` launches of ``turns``
+    generations in ONE pallas_call.  Both board args are aliased onto
+    the first two outputs (ping-pong pair); the final state is output
+    ``nlaunch % 2`` (b for odd, a for even), the other buffer holds
+    S_{nlaunch−1}.  ``skipped`` sums the per-launch stability flags —
+    the same telemetry series the per-launch form accumulated with
+    ``jnp.sum`` per launch."""
     h, wp = shape
     _require_adaptive_eligible(turns)
     plan = _frontier_plan(shape, turns, tile_cap)
@@ -808,40 +984,48 @@ def _build_launch_frontier(
     tile_h = _plan_tile(shape, turns, tile_cap)
     grid = h // tile_h
     kernel = partial(
-        _kernel_frontier,
+        _kernel_frontier_mega,
         tile_h=tile_h,
         pad=pad,
         grid=grid,
+        nlaunch=nlaunch,
         turns=turns,
         rule=rule,
         sub_rows=sub_rows,
     )
-    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    smem_i32 = lambda shp: pltpu.SMEM(shp, jnp.int32)  # noqa: E731
     return pl.pallas_call(
         kernel,
-        grid=(grid,),
+        grid=(nlaunch, grid),
         in_specs=[
-            smem,
-            smem,
-            smem,
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY), smem, smem, smem],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
         out_shape=[
             jax.ShapeDtypeStruct((h, wp), jnp.uint32),
-            jax.ShapeDtypeStruct((grid,), jnp.int32),
-            jax.ShapeDtypeStruct((grid,), jnp.int32),
-            jax.ShapeDtypeStruct((grid,), jnp.int32),
+            jax.ShapeDtypeStruct((h, wp), jnp.uint32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
         ],
-        input_output_aliases={4: 0},
+        input_output_aliases={0: 0, 1: 1},
         scratch_shapes=[
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # full buffer
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # merge buffer
+            # Interval + stability state, (parity row, stripe).
+            smem_i32((2, grid)), smem_i32((2, grid)),
+            smem_i32((2, grid)), smem_i32((2, grid)),
+            smem_i32((2, grid)),
+            smem_i32((1,)),  # skip accumulator
             pltpu.SemaphoreType.DMA((3,)),
         ],
-        compiler_params=_compiler_params(tile_h, pad, wp, True),
+        compiler_params=_compiler_params(
+            tile_h, pad, wp, True, sequential_grid=True
+        ),
         interpret=interpret,
     )
 
@@ -1126,33 +1310,14 @@ def _run_tiled(
         grid = shape[0] // tile_h
         fplan = _frontier_plan(shape, t, cap)
         if fplan is not None:
-            # Frontier-tracked kernel: per-stripe active-row intervals
-            # replace both the probe and the binary elision bitmap.
-            # Launch 1 starts from FULL intervals (everything computes,
-            # measuring exact intervals for launch 2 on).
-            call = _build_launch_frontier(shape, rule, t, ip, cap)
-            lo0 = jnp.arange(grid, dtype=jnp.int32) * tile_h
-            hi0 = lo0 + (tile_h - 1)
-            ps0 = jnp.zeros((grid,), jnp.int32)
-
-            def body(_, carry):
-                a, b, ps, lo, hi, sk = carry
-                nb1, st1, lo1, hi1 = call(ps, lo, hi, b, a)
-                nb2, st2, lo2, hi2 = call(st1, lo1, hi1, nb1, b)
-                return (
-                    nb1, nb2, st2, lo2, hi2,
-                    sk + jnp.sum(st1) + jnp.sum(st2),
-                )
-
-            a, board, ps, flo, fhi, skipped = jax.lax.fori_loop(
-                0,
-                full // 2,
-                body,
-                (jnp.zeros_like(board), board, ps0, lo0, hi0, skipped),
-            )
-            if full % 2:
-                board, st1, _, _ = call(ps, flo, fhi, board, a)
-                skipped = skipped + jnp.sum(st1)
+            # Frontier-tracked megakernel: the whole dispatch is ONE
+            # pallas_call; interval/skip state and the ping-pong buffer
+            # cycle live inside it (round 5 — the per-launch form paid
+            # ~33 µs of XLA dispatch overhead per launch).
+            call = _build_dispatch_frontier(shape, rule, t, full, ip, cap)
+            a, b, sk = call(board, jnp.zeros_like(board))
+            board = b if full % 2 else a
+            skipped = skipped + sk[0]
         else:
             call = _build_launch_adaptive(shape, rule, t, ip, cap)
             st0 = jnp.zeros((grid,), jnp.int32)
