@@ -335,28 +335,44 @@ def skip_plan(t: int) -> tuple[int, bool]:
 # (+38%), T=96 → 3,840 (flat).  The floor costs the transient active
 # phase ~8% extra halo redundancy ((512+96)/512 vs (512+48)/512), which
 # the settled phase repays permanently; only adaptive (skip_stable)
-# plans on tall boards are affected.
+# plans on tall boards that fall back to the PROBING kernel are
+# affected — frontier-eligible plans use the round-5 depths below.
 _SETTLED_T = 48
+# Frontier launch depths (round 5): with the megakernel the per-launch
+# fixed cost is tiny, so the depth optimum is set by the active-stripe
+# window compute — per generation ≈ (T+6)·S(T)/T with S = 4T + 96,
+# which favours SHALLOW launches.  Hardware sweeps on the settled
+# boards: 16384² (cap 1024) T=12/18/24/30/48 → 503/561/450/436/454k
+# gens/s; 65536² (cap 512) T=18/24/48 → 10.4/10.6/9.4k gens/s.
+_FRONTIER_T = 18
+_FRONTIER_T_TALL = 24
 
 
 def adaptive_launch_depth(
-    shape: tuple[int, int], turns: int, cap: int | None
+    shape: tuple[int, int], turns: int, cap: int | None, frontier: bool = True
 ) -> tuple[int, bool]:
     """(launch depth, adaptive?) for a skip_stable dispatch — THE one
     depth decision shared by the execution paths and the skip-fraction
     denominators (single- and sharded-device), so plan and telemetry can
-    never drift."""
+    never drift.  ``frontier=False`` is for callers whose executing
+    kernel is the probing form even when a frontier plan exists (the
+    shallow frontier depths are a measured REGRESSION there — the
+    probing kernel's probe share is 6/T of all generations): they keep
+    the round-4 depth policy."""
     t = launch_turns(shape, turns, cap)
     t, adaptive = skip_plan(t)
-    if (
-        adaptive
-        and t < _SETTLED_T
-        and shape[0] >= _TALL_ROWS
-        and turns >= _SETTLED_T
-        and _tile_for_pad(shape[0], shape[1], _round8(_SETTLED_T), cap)
-        is not None
-    ):
-        t = _SETTLED_T
+    if adaptive:
+        ft = _FRONTIER_T_TALL if shape[0] >= _TALL_ROWS else _FRONTIER_T
+        if frontier and turns >= ft and _frontier_plan(shape, ft, cap) is not None:
+            return ft, True
+        if (
+            t < _SETTLED_T
+            and shape[0] >= _TALL_ROWS
+            and turns >= _SETTLED_T
+            and _tile_for_pad(shape[0], shape[1], _round8(_SETTLED_T), cap)
+            is not None
+        ):
+            t = _SETTLED_T
     return t, adaptive
 
 
@@ -657,25 +673,38 @@ def _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sem):
 _EMPTY_LO = 1 << 30
 
 
+# Column-window width for the frontier kernel's column-confined compute
+# tier (round 5), in packed words on the lane axis.  Two 128-lane quanta:
+# window placement is 128-word quantized (Mosaic DMA offsets must sit on
+# the (8, 128) native tiling), so a two-quantum window covers any cluster
+# up to ~190 words wide no matter where it straddles a quantum boundary.
+_COL_WINDOW = 256
+
+
 def _frontier_plan(
     shape: tuple[int, int], turns: int, tile_cap: int | None
-) -> tuple[int, int] | None:
-    """(pad_f, sub_rows) for the frontier kernel, or None when the
-    geometry can't host it OR the probing kernel's per-active-stripe cost
-    is already lower.  tile_h is ALWAYS ``_plan_tile`` — the same grid as
-    the telemetry denominator — only the halo deepens to round8(turns+6).
+) -> tuple[int, int, int | None] | None:
+    """(pad_f, sub_rows, col_window) for the frontier kernel, or None
+    when the geometry can't host it (structural reasons only: no
+    tiling, halo deeper than the tile, VMEM, or a sub-window that
+    wouldn't fit).  tile_h is ALWAYS ``_plan_tile`` — the same grid as
+    the telemetry denominator — only the halo deepens to
+    round8(turns+6).  ``col_window`` is the static width (words) of the
+    column-confined compute tier, or None on boards too narrow for it
+    to pay (it must be a strict subset of the row).
 
-    The selection is a static cost model, validated on hardware at both
-    poles: per active stripe, frontier ≈ (T+6)·S_f row-gens (no probe,
-    but the sub-window carries t6 margins and the compute restarts at
-    gen 0), probing ≈ 6·h_ext + (T−6)·S_p (full-window probe, reused as
-    the first 6 generations).  Tall tiles (16384²: h_ext ≈ 1104) make
-    the probe dominant — frontier measured 613k vs 183k gens/s settled —
-    while short tiles (65536² cap 512: h_ext = 608) already had cheap
-    probes and frontier's wider windows LOSE skips (measured 3,373 vs
-    5,153; skip fraction 0.8313 vs 0.8828)."""
+    Round 4 declined short-tile geometries here by a probing-vs-frontier
+    cost model (the single-interval union collapsed the 65536² skip
+    cascade: 3,373 vs 5,153 gens/s).  Round 5 removed the decline: with
+    two tracked intervals, per-interval clamping, the column tier and
+    the megakernel, frontier measured faster at BOTH poles — settled
+    16384² 561k vs 436k (T swept), settled 65536² 10.6k vs 6.1k gens/s —
+    so the probing kernel is now only the structural fallback (geometry
+    can't host a frontier plan)."""
     h, wp = shape
-    tile_h = _plan_tile(shape, turns, tile_cap)
+    tile_h = _tile_for_pad(h, wp, _round8(turns), tile_cap)
+    if tile_h is None:
+        return None
     pad_f = _round8(turns + _SKIP_PERIOD)
     if pad_f > tile_h:
         return None
@@ -685,16 +714,8 @@ def _frontier_plan(
     sub_rows = _round8(4 * turns + 96)
     if sub_rows + 64 > h_ext_f:
         return None
-    pad_p = _round8(turns)
-    s_p = _window_rows(tile_h, pad_p, turns)
-    if s_p is not None:
-        frontier_cost = (turns + _SKIP_PERIOD) * sub_rows
-        probing_cost = _SKIP_PERIOD * (tile_h + 2 * pad_p) + (
-            turns - _SKIP_PERIOD
-        ) * s_p
-        if probing_cost <= frontier_cost:
-            return None
-    return pad_f, sub_rows
+    col_window = _COL_WINDOW if wp >= 2 * _COL_WINDOW else None
+    return pad_f, sub_rows, col_window
 
 
 def _hit_union(ivals, w_lo, w_hi, c_lo, c_hi, t6):
@@ -732,20 +753,29 @@ def _hit_union(ivals, w_lo, w_hi, c_lo, c_hi, t6):
     return hit, u_lo, u_hi
 
 
-def _measure2(gT, g6, base_row, m_lo, m_hi, frame_off):
-    """Exact new intervals: rows of the measure region where the
-    gen-(T+6) state differs from gen T, split into up to TWO disjoint
-    intervals at the midpoint of their span (round 5).  The split lets a
-    stripe carrying two separated clusters publish them separately
-    instead of as one stripe-wide union — the mechanism behind the
-    65536² skip-cascade collapse (BASELINE.md round-4 cap sweep).
-    Returns stripe-frame (lo0, hi0, lo1, hi1); empty = (_EMPTY_LO, −1);
-    interval 0 sits strictly below interval 1 when both are nonempty."""
+def _measure2(gT, g6, base_row, m_lo, m_hi, frame_off, col_off=0, col_valid=None):
+    """Exact new intervals: the rows AND word-columns of the measure
+    region where the gen-(T+6) state differs from gen T.  Rows split
+    into up to TWO disjoint intervals at the midpoint of their span
+    (round 5): the split lets a stripe carrying two separated clusters
+    publish them separately instead of as one stripe-wide union — the
+    mechanism behind the 65536² skip-cascade collapse (BASELINE.md
+    round-4 cap sweep).  ``col_valid`` restricts the column measure to a
+    static [lo, hi) window-local band (the column tier's validity
+    region); ``col_off`` translates to board words.  Returns
+    (lo0, hi0, lo1, hi1, clo, chi): stripe-frame rows, board-frame word
+    columns; empty = (_EMPTY_LO, −1); row interval 0 sits strictly below
+    interval 1 when both are nonempty."""
     diff = g6 ^ gT
     rows = jax.lax.broadcasted_iota(jnp.int32, gT.shape, 0) + base_row
     hot = (rows >= m_lo) & (rows <= m_hi) & (diff != 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, gT.shape, 1)
+    if col_valid is not None:
+        hot = hot & (cols >= col_valid[0]) & (cols < col_valid[1])
     lo = jnp.min(jnp.where(hot, rows, jnp.int32(_EMPTY_LO)))
     hi = jnp.max(jnp.where(hot, rows, jnp.int32(-_EMPTY_LO)))
+    clo = jnp.min(jnp.where(hot, cols, jnp.int32(_EMPTY_LO)))
+    chi = jnp.max(jnp.where(hot, cols, jnp.int32(-_EMPTY_LO)))
     # Midpoint split: a valid 2-interval cover for any threshold (every
     # active row lands in exactly one side); the midpoint separates the
     # common case — two compact clusters — whenever their gap spans it.
@@ -759,30 +789,52 @@ def _measure2(gT, g6, base_row, m_lo, m_hi, frame_off):
         jnp.where(empty, jnp.int32(-1), jnp.where(e1, hi, hi0) + frame_off),
         jnp.where(empty | e1, jnp.int32(_EMPTY_LO), lo1 + frame_off),
         jnp.where(empty | e1, jnp.int32(-1), hi + frame_off),
+        jnp.where(empty, jnp.int32(_EMPTY_LO), clo + col_off),
+        jnp.where(empty, jnp.int32(-1), chi + col_off),
     )
 
 
-def _frontier_body(tile, aux, merge, u_lo, u_hi, i, tile_h, pad, turns, rule, sub_rows):
+def _frontier_body(
+    tile, aux, merge, colwin, sems,
+    u_lo, u_hi, u_clo, u_chi,
+    i, tile_h, pad, turns, rule, sub_rows, col_window,
+):
     """The compute branch of the frontier kernels — everything between
     the window DMA-in and the routed DMA-out, factored out so the
-    sharded strip form can share it verbatim.  Derives the
-    recompute sub-window straight from the clamped interval union (no
-    probe), advances it T generations, then 6 more to measure the exact
-    new intervals.  Returns (route, lo0, hi0, lo1, hi1): route as in
-    :func:`_dma_route_out`, intervals in stripe-frame rows.
+    sharded strip form can share it verbatim.  Derives the recompute
+    sub-window straight from the clamped interval union (no probe),
+    advances it T generations, then 6 more to measure the exact new
+    intervals.  Returns (route, lo0, hi0, lo1, hi1, clo, chi): route as
+    in :func:`_dma_route_out`, row intervals in stripe-frame rows,
+    column interval in board words.
 
-    Soundness (unchanged from round 4, restated for the clamped union):
-    every active row reachable from this stripe's centre survives the
-    per-interval clamp (it is within t6 of a centreated row — see
-    ``_hit_union``), so centre rows farther than T from [u_lo, u_hi] are
-    T-pinned and keep their gen-0 value; the sub-window's validity
+    Three tiers, narrowest eligible wins:
+    - COLUMN window (round 5): when the column union + T+6-cell reach
+      fits the validity band of a static (sub_rows, col_window) window
+      at a 128-word-quantized lane offset, compute only that window —
+      residual clusters are a few words wide, so this cuts the VPU work
+      per active stripe by wp/col_window (4× at 16384², 8× at 65536²).
+    - ROW window: full width, as round 4.
+    - FULL window: the fallback that re-measures everything.
+
+    Soundness: every active row reachable from this stripe's centre
+    survives the per-interval clamp (it is within t6 of a centre row —
+    see ``_hit_union``), so centre rows farther than T from [u_lo, u_hi]
+    are T-pinned and keep their gen-0 value; the sub-window's validity
     region always covers the recompute region when ``windowed_ok``
-    (checked directly), and sub-window rows in the validity region are
+    (checked directly), and sub-window cells in the validity region are
     the TRUE gen-T state regardless of the intervals — their full light
     cone lies inside the window, which was loaded from the true gen-0
-    tile.  The measure region [d − t6, d + t6] ∩ centre covers every row
-    whose state can differ between gens T and T+6 (such a row is within
-    6 of a gen-T active row, itself within T of a gen-0 one)."""
+    tile.  The column tier adds the same argument on the lane axis: the
+    in-window lane rotate wraps at the window edge, so edge content is
+    garbage that penetrates ≤ 1 cell/generation — cells ≥ t6 cells
+    (≤ cw words) from the window edge are exact at gen T+6, and
+    ``col_ok`` requires the whole reach band [u_clo − cw, u_chi + cw]
+    to sit inside that validity region, which also keeps it ≥ t6 cells
+    from the board edge (no torus x-wrap can matter).  The measure
+    region [d − t6, d + t6] ∩ centre covers every row/column whose
+    state can differ between gens T and T+6 (such a cell is within 6 of
+    a gen-T active cell, itself within T of a gen-0 one)."""
     h_ext = tile_h + 2 * pad
     t6 = turns + _SKIP_PERIOD
     w_lo = i * tile_h - pad  # window top, stripe-frame rows
@@ -800,6 +852,9 @@ def _frontier_body(tile, aux, merge, u_lo, u_hi, i, tile_h, pad, turns, rule, su
     windowed_ok = (win_lo + t6 <= m_lo) & (m_hi < win_lo + sub_rows - t6)
     wp = tile.shape[1]
 
+    def measure_args():
+        return (win_lo, m_lo, m_hi, w_lo)
+
     def windowed():
         sub0 = tile[pl.ds(win_lo, sub_rows), :]
         gT = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), sub0)
@@ -809,7 +864,7 @@ def _frontier_body(tile, aux, merge, u_lo, u_hi, i, tile_h, pad, turns, rule, su
         merge[:] = tile[:]
         merge[pl.ds(win_lo, sub_rows), :] = fixed
         g6 = jax.lax.fori_loop(0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), gT)
-        return (jnp.int32(1),) + _measure2(gT, g6, win_lo, m_lo, m_hi, w_lo)
+        return (jnp.int32(1),) + _measure2(gT, g6, *measure_args())
 
     def full():
         gT = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), tile[:])
@@ -817,15 +872,68 @@ def _frontier_body(tile, aux, merge, u_lo, u_hi, i, tile_h, pad, turns, rule, su
         g6 = jax.lax.fori_loop(0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), gT)
         return (jnp.int32(2),) + _measure2(gT, g6, 0, m_lo, m_hi, w_lo)
 
-    return jax.lax.cond(windowed_ok, windowed, full)
+    def row_tiers():
+        return jax.lax.cond(windowed_ok, windowed, full)
+
+    if col_window is None:
+        return row_tiers()
+
+    cw = (t6 + 31) // 32  # reach/validity margin in words (≥ t6 cells)
+    need_lo = u_clo - cw
+    need_hi = u_chi + cw
+    # 128-word-quantized placement (cidx * 128: the multiplication form
+    # Mosaic can prove lane-tile-aligned); wp − col_window is a 128
+    # multiple because wp % 128 == 0 on every tiled board.
+    cidx = jnp.clip(need_lo - cw, 0, wp - col_window) // 128
+    win_c = cidx * 128
+    col_ok = (
+        windowed_ok
+        & (win_c + cw <= need_lo)
+        & (need_hi < win_c + col_window - cw)
+    )
+
+    def col_windowed():
+        c_in = pltpu.make_async_copy(
+            tile.at[pl.ds(win_lo, sub_rows), pl.ds(win_c, col_window)],
+            colwin.at[:],
+            sems.at[0],
+        )
+        c_in.start()
+        c_in.wait()
+        sub0 = colwin[:]
+        gT = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), sub0)
+        g6 = jax.lax.fori_loop(0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), gT)
+        k = jax.lax.broadcasted_iota(jnp.int32, (sub_rows, col_window), 0)
+        c = jax.lax.broadcasted_iota(jnp.int32, (sub_rows, col_window), 1)
+        valid = (
+            (k >= turns)
+            & (k < sub_rows - turns)
+            & (c >= cw)
+            & (c < col_window - cw)
+        )
+        colwin[:] = jnp.where(valid, gT, sub0)
+        merge[:] = tile[:]
+        c_out = pltpu.make_async_copy(
+            colwin.at[:],
+            merge.at[pl.ds(win_lo, sub_rows), pl.ds(win_c, col_window)],
+            sems.at[0],
+        )
+        c_out.start()
+        c_out.wait()
+        return (jnp.int32(1),) + _measure2(
+            gT, g6, *measure_args(),
+            col_off=win_c, col_valid=(cw, col_window - cw),
+        )
+
+    return jax.lax.cond(col_ok, col_windowed, row_tiers)
 
 
 def _kernel_frontier_mega(
     xa, xb, oa, ob, sk_ref,
-    tile, aux, merge,
-    ilo0, ihi0, ilo1, ihi1, ist,
+    tile, aux, merge, colwin,
+    ilo0, ihi0, ilo1, ihi1, iclo, ichi, ist,
     acc, sems,
-    *, tile_h, pad, grid, nlaunch, turns, rule, sub_rows,
+    *, tile_h, pad, grid, nlaunch, turns, rule, sub_rows, col_window,
 ):
     """The WHOLE adaptive dispatch as one kernel: grid (nlaunch, grid)
     executes launches in row-major order (dimension_semantics
@@ -873,10 +981,20 @@ def _kernel_frontier_mega(
     # halo comes from), so wrap handling is placement, not cyclic
     # interval arithmetic.
     ivals = []
+    u_clo = jnp.int32(_EMPTY_LO)
+    u_chi = jnp.int32(-_EMPTY_LO)
     for j, slot in ((left, -1), (i, 0), (right, 1)):
         off = (i + slot) * tile_h - j * tile_h
         ivals.append((ilo0[rd, j] + off, ihi0[rd, j] + off))
         ivals.append((ilo1[rd, j] + off, ihi1[rd, j] + off))
+        # Column union (board words, no frame shift): conservative — it
+        # unions every nonempty neighbour, even one whose rows were
+        # clamped away, which can only widen the column window.
+        ncl = iclo[rd, j]
+        nch = ichi[rd, j]
+        ne = ncl <= nch
+        u_clo = jnp.where(ne, jnp.minimum(u_clo, ncl), u_clo)
+        u_chi = jnp.where(ne, jnp.maximum(u_chi, nch), u_chi)
     hit, u_lo, u_hi = _hit_union(ivals, w_lo, w_hi, c_lo, c_hi, t6)
     # Launch 0: no tracked state yet — force the probing kernel's
     # "launch 1 computes everything" semantics with the maximal clamped
@@ -888,16 +1006,18 @@ def _kernel_frontier_mega(
     # Own skip flag from the previous launch (launch 0 never reads it).
     ps = ist[rd, i]
 
-    def put_state(st, lo0, hi0, lo1, hi1):
+    def put_state(st, lo0, hi0, lo1, hi1, clo, chi):
         ist[wr, i] = st
         ilo0[wr, i] = lo0
         ihi0[wr, i] = hi0
         ilo1[wr, i] = lo1
         ihi1[wr, i] = hi1
+        iclo[wr, i] = clo
+        ichi[wr, i] = chi
 
     @pl.when(jnp.logical_not(hit))
     def _():
-        put_state(1, _EMPTY_LO, -1, _EMPTY_LO, -1)
+        put_state(1, _EMPTY_LO, -1, _EMPTY_LO, -1, _EMPTY_LO, -1)
         acc[0] = acc[0] + 1
 
         @pl.when(ps == 0)
@@ -940,10 +1060,12 @@ def _kernel_frontier_mega(
         def _():
             _dma_window_in(ob, tile, i, left, right, tile_h, pad, sems)
 
-        route, lo0, hi0, lo1, hi1 = _frontier_body(
-            tile, aux, merge, u_lo, u_hi, i, tile_h, pad, turns, rule, sub_rows
+        route, lo0, hi0, lo1, hi1, clo, chi = _frontier_body(
+            tile, aux, merge, colwin, sems,
+            u_lo, u_hi, u_clo, u_chi,
+            i, tile_h, pad, turns, rule, sub_rows, col_window,
         )
-        put_state(0, lo0, hi0, lo1, hi1)
+        put_state(0, lo0, hi0, lo1, hi1, clo, chi)
 
         @pl.when(even)
         def _():
@@ -980,7 +1102,7 @@ def _build_dispatch_frontier(
     plan = _frontier_plan(shape, turns, tile_cap)
     if plan is None:
         raise ValueError(f"no frontier plan for {turns} turns on {shape}")
-    pad, sub_rows = plan
+    pad, sub_rows, col_window = plan
     tile_h = _plan_tile(shape, turns, tile_cap)
     grid = h // tile_h
     kernel = partial(
@@ -992,6 +1114,7 @@ def _build_dispatch_frontier(
         turns=turns,
         rule=rule,
         sub_rows=sub_rows,
+        col_window=col_window,
     )
     smem_i32 = lambda shp: pltpu.SMEM(shp, jnp.int32)  # noqa: E731
     return pl.pallas_call(
@@ -1016,7 +1139,11 @@ def _build_dispatch_frontier(
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # full buffer
             pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # merge buffer
+            pltpu.VMEM(
+                (sub_rows, col_window if col_window else _LANES), jnp.uint32
+            ),  # column-tier window (minimal dummy when the tier is off)
             # Interval + stability state, (parity row, stripe).
+            smem_i32((2, grid)), smem_i32((2, grid)),
             smem_i32((2, grid)), smem_i32((2, grid)),
             smem_i32((2, grid)), smem_i32((2, grid)),
             smem_i32((2, grid)),

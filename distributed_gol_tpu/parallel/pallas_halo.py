@@ -405,7 +405,10 @@ def adaptive_strip_launches(
     # every caller, not just ones that pre-resolve the cap.
     if tile_cap is None:
         tile_cap = default_skip_cap(strip[0])
-    t, adaptive = adaptive_launch_depth(strip, turns, tile_cap)
+    # frontier=False: the sharded path still runs the probing strip
+    # kernel, where the shallow frontier depths are a measured
+    # regression (see adaptive_launch_depth).
+    t, adaptive = adaptive_launch_depth(strip, turns, tile_cap, frontier=False)
     full, _ = divmod(turns, t)
     if not adaptive or not full:
         return 0
@@ -450,7 +453,9 @@ def make_superstep(
         strip = (h // ny, wp)
         if skip_stable:
             cap = raw_cap if raw_cap is not None else default_skip_cap(strip[0])
-            t, t_adaptive = adaptive_launch_depth(strip, turns, cap)
+            t, t_adaptive = adaptive_launch_depth(
+                strip, turns, cap, frontier=False
+            )
         else:
             cap = None
             t = launch_turns(strip, turns, None)  # clamps to _MAX_T internally

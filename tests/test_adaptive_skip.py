@@ -539,26 +539,34 @@ class TestActiveRowWindow:
         self._run_both(b, 48, cap=512)
 
 
-def test_settled_launch_depth_floor_for_tall_boards():
-    """Round-4 measured policy: adaptive plans on ≥32768-row boards floor
-    the launch depth at _SETTLED_T (48) — probe share and per-launch cost
-    are ∝ 1/T and dominate the settled regime (65536² measured: 2,780
-    gens/s at the cost model's T=24 vs 3,831 at T=48).  Short boards and
-    non-adaptive plans keep the pure cost-model depth, and the
-    skip-fraction denominator uses the same depth (one home)."""
+def test_adaptive_launch_depth_policy():
+    """Round-5 measured policy: frontier-eligible plans use the shallow
+    megakernel depths (_FRONTIER_T = 18, _FRONTIER_T_TALL = 24 — the
+    hardware sweep in ops/pallas_packed.py), because per-launch fixed
+    cost is tiny and active-window compute ∝ (T+6)·S(T)/T favours small
+    T.  Geometries with no frontier plan keep the round-4 behaviour:
+    cost-model depth floored at _SETTLED_T (48) on ≥32768-row boards
+    (probing kernel measured 2,780 gens/s at T=24 vs 3,831 at T=48).
+    The skip-fraction denominator uses the same depth (one home)."""
     tall = (65536, 2048)
     t, adaptive = pallas_packed.adaptive_launch_depth(tall, 960, 512)
-    assert adaptive and t == pallas_packed._SETTLED_T
-    # Same depth feeds the telemetry denominator.
+    assert adaptive and t == pallas_packed._FRONTIER_T_TALL
     grid = 65536 // pallas_packed._plan_tile(tall, t, 512)
     assert pallas_packed.adaptive_tile_launches(tall, 960, 512) == (960 // t) * grid
-    # Short board: cost-model depth, no floor.
     short = (16384, 512)
     t_s, ad_s = pallas_packed.adaptive_launch_depth(short, 960, 1024)
-    assert ad_s and t_s < pallas_packed._SETTLED_T == 48
-    # Dispatches shorter than the floor can't be deepened past the work.
-    t_tiny, _ = pallas_packed.adaptive_launch_depth(tall, 24, 512)
-    assert t_tiny <= 24
+    assert ad_s and t_s == pallas_packed._FRONTIER_T
+    # Dispatches shorter than the frontier depth can't be deepened past
+    # the work.
+    t_tiny, _ = pallas_packed.adaptive_launch_depth(tall, 12, 512)
+    assert t_tiny <= 12
+    # No frontier plan (narrow stripes would host one, so force the
+    # structural fallback): the _SETTLED_T floor for tall boards stands.
+    import unittest.mock as mock
+
+    with mock.patch.object(pallas_packed, "_frontier_plan", lambda *a: None):
+        t_fb, ad_fb = pallas_packed.adaptive_launch_depth(tall, 960, 512)
+        assert ad_fb and t_fb == pallas_packed._SETTLED_T
 
 
 class TestPingPongWriteElision:
@@ -611,3 +619,84 @@ class TestPingPongWriteElision:
         t, _ = pallas_packed.adaptive_launch_depth((self.HT, self.WT // 32), 960, 512)
         self._run_both(b, 4 * t)
         self._run_both(b, 5 * t)
+
+
+class TestColumnWindow:
+    """The column-confined compute tier (round 5): a stripe whose active
+    cells + T+6-cell reach fit a 256-word window at a 128-word-quantized
+    lane offset computes only that window.  Geometry: wp = 512 (the
+    16384² lane count) so the tier is a strict subset of the row
+    (``_frontier_plan`` gates it off for wp < 512).  Bit-identity vs the
+    XLA packed engine covers the fallback decisions implicitly — a wrong
+    ``col_ok`` either way still has to produce the exact board."""
+
+    HC, WC = 2048, 16384  # wp = 512, cap-512 stripes -> frontier + col tier
+
+    def _run_both(self, b, turns):
+        p = packed.pack(jnp.asarray(b))
+        got = pallas_packed.make_superstep(
+            CONWAY, interpret=True, skip_stable=True, skip_tile_cap=512
+        )(p, turns)
+        want = packed.superstep(p, CONWAY, turns)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def _board(self):
+        return np.zeros((self.HC, self.WC), dtype=np.uint8)
+
+    @staticmethod
+    def _glider(b, y, x):
+        for dy, dx in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+            b[y + dy, x + dx] = 255
+
+    def _t(self):
+        t, adaptive = pallas_packed.adaptive_launch_depth(
+            (self.HC, self.WC // 32), 960, 512
+        )
+        assert adaptive
+        return t
+
+    def test_tier_engages_for_this_geometry(self):
+        plan = pallas_packed._frontier_plan((self.HC, self.WC // 32), self._t(), 512)
+        assert plan is not None and plan[2] == 256
+        # ...and stays off on the narrow hermetic boards.
+        plan_narrow = pallas_packed._frontier_plan((2048, 128), self._t(), 512)
+        assert plan_narrow is not None and plan_narrow[2] is None
+
+    def test_mid_board_cluster_multi_launch(self):
+        b = self._board()
+        self._glider(b, 700, 8000)  # mid-stripe, mid-width
+        b[1500:1502, 2000:2002] = 255  # far ash in another stripe
+        self._run_both(b, 4 * self._t())
+
+    def test_cluster_straddles_column_quantum(self):
+        b = self._board()
+        # Active cells right on the 128-word (4096-cell) boundary: the
+        # 256-word window must cover both sides via floor placement.
+        self._glider(b, 600, 4090)
+        b[604:606, 4100:4102] = 255
+        self._run_both(b, 4 * self._t())
+
+    def test_cluster_at_board_edge_wrap_falls_back(self):
+        b = self._board()
+        # Activity within T+6 cells of the x-edge: col_ok must reject
+        # (the window can't see the torus wrap) and the row tier take it.
+        self._glider(b, 300, 2)
+        b[900:902, self.WC - 3 : self.WC - 1] = 255  # right edge too
+        self._run_both(b, 4 * self._t())
+
+    def test_two_clusters_same_stripe_distant_columns(self):
+        b = self._board()
+        # Two clusters ~300 words apart in ONE stripe: the column union
+        # exceeds the window validity band, so the tier must fall back
+        # (row tier) while neighbours still skip.
+        b[200:202, 1000:1002] = 255
+        self._glider(b, 260, 12000)
+        self._run_both(b, 4 * self._t())
+
+    def test_glider_walks_across_quantum_boundary(self):
+        b = self._board()
+        # A glider heading +x from just left of the 8192-cell boundary:
+        # successive launches re-place the column window as the tracked
+        # column interval drifts across the quantum edge.
+        self._glider(b, 1000, 8150)
+        self._run_both(b, 8 * self._t())
