@@ -45,6 +45,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_gol_tpu.models.life import CONWAY, LifeRule
 from distributed_gol_tpu.ops.pallas_packed import (
+    _EMPTY_LO,
     _LANES,
     _SKIP_PERIOD,
     _adaptive_eligible,
@@ -53,6 +54,9 @@ from distributed_gol_tpu.ops.pallas_packed import (
     _advance_window,
     _compiler_params,
     _dma_route_out,
+    _frontier_body,
+    _frontier_plan,
+    _hit_union,
     _require_adaptive_eligible,
     _route_active,
     _round8,
@@ -133,61 +137,182 @@ def _ext_kernel_adaptive(
 
     @pl.when(jnp.logical_not(elide))
     def _():
-        center = pltpu.make_async_copy(
-            local.at[pl.ds(i * tile_h, tile_h), :],
-            tile.at[pl.ds(pad, tile_h), :],
-            sems.at[0],
-        )
-        center.start()
-
-        # Halo copies: start inside the source-selecting branches, wait
-        # once after all starts — both branches of each pair move the
-        # same (pad, wp) extent to the same destination on the same
-        # semaphore, so a uniform wait descriptor overlaps all three
-        # DMAs (the single-device kernel's shape).
-        @pl.when(i == 0)
-        def _():
-            pltpu.make_async_copy(
-                north.at[:], tile.at[pl.ds(0, pad), :], sems.at[1]
-            ).start()
-
-        @pl.when(i > 0)
-        def _():
-            # (i-1)*tile_h + (tile_h - pad) == i*tile_h - pad, but in the
-            # multiplication-plus-8-multiple form Mosaic can prove
-            # 8-aligned (the subtraction form fails the divisibility
-            # check at compile time).
-            pltpu.make_async_copy(
-                local.at[pl.ds((i - 1) * tile_h + (tile_h - pad), pad), :],
-                tile.at[pl.ds(0, pad), :],
-                sems.at[1],
-            ).start()
-
-        @pl.when(i == grid - 1)
-        def _():
-            pltpu.make_async_copy(
-                south.at[:], tile.at[pl.ds(pad + tile_h, pad), :], sems.at[2]
-            ).start()
-
-        @pl.when(i < grid - 1)
-        def _():
-            pltpu.make_async_copy(
-                local.at[pl.ds((i + 1) * tile_h, pad), :],
-                tile.at[pl.ds(pad + tile_h, pad), :],
-                sems.at[2],
-            ).start()
-
-        pltpu.make_async_copy(
-            north.at[:], tile.at[pl.ds(0, pad), :], sems.at[1]
-        ).wait()
-        pltpu.make_async_copy(
-            south.at[:], tile.at[pl.ds(pad + tile_h, pad), :], sems.at[2]
-        ).wait()
-        center.wait()
-
+        _dma_strip_window_in(local, north, south, tile, i, grid, tile_h, pad, sems)
         route, stable = _route_active(tile, aux, merge, tile_h, pad, turns, rule)
         st_ref[i] = stable
         _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sems.at[0])
+
+
+def _dma_strip_window_in(local, north, south, tile, i, grid, tile_h, pad, sems):
+    """Assemble tile ``i``'s halo-extended window from the device strip
+    and the ppermute'd neighbour boundaries — one home for the adaptive
+    and frontier strip kernels (the sharded counterpart of
+    ``pallas_packed._dma_window_in``)."""
+    center = pltpu.make_async_copy(
+        local.at[pl.ds(i * tile_h, tile_h), :],
+        tile.at[pl.ds(pad, tile_h), :],
+        sems.at[0],
+    )
+    center.start()
+
+    # Halo copies: start inside the source-selecting branches, wait
+    # once after all starts — both branches of each pair move the
+    # same (pad, wp) extent to the same destination on the same
+    # semaphore, so a uniform wait descriptor overlaps all three
+    # DMAs (the single-device kernel's shape).
+    @pl.when(i == 0)
+    def _():
+        pltpu.make_async_copy(
+            north.at[:], tile.at[pl.ds(0, pad), :], sems.at[1]
+        ).start()
+
+    @pl.when(i > 0)
+    def _():
+        # (i-1)*tile_h + (tile_h - pad) == i*tile_h - pad, but in the
+        # multiplication-plus-8-multiple form Mosaic can prove
+        # 8-aligned (the subtraction form fails the divisibility
+        # check at compile time).
+        pltpu.make_async_copy(
+            local.at[pl.ds((i - 1) * tile_h + (tile_h - pad), pad), :],
+            tile.at[pl.ds(0, pad), :],
+            sems.at[1],
+        ).start()
+
+    @pl.when(i == grid - 1)
+    def _():
+        pltpu.make_async_copy(
+            south.at[:], tile.at[pl.ds(pad + tile_h, pad), :], sems.at[2]
+        ).start()
+
+    @pl.when(i < grid - 1)
+    def _():
+        pltpu.make_async_copy(
+            local.at[pl.ds((i + 1) * tile_h, pad), :],
+            tile.at[pl.ds(pad + tile_h, pad), :],
+            sems.at[2],
+        ).start()
+
+    pltpu.make_async_copy(
+        north.at[:], tile.at[pl.ds(0, pad), :], sems.at[1]
+    ).wait()
+    pltpu.make_async_copy(
+        south.at[:], tile.at[pl.ds(pad + tile_h, pad), :], sems.at[2]
+    ).wait()
+    center.wait()
+
+
+def _ext_kernel_frontier(
+    ps_ref, lo0e, hi0e, lo1e, hi1e, cloe, chie,
+    local, north, south, dst_prev, o_hbm,
+    st_ref, nlo0, nhi0, nlo1, nhi1, nclo, nchi,
+    tile, aux, merge, colwin, sems,
+    *, tile_h, pad, grid, turns, rule, sub_rows, col_window,
+):
+    """The frontier strip launch (round 5): the sharded counterpart of
+    ``pallas_packed._kernel_frontier_mega``, sharing its whole compute
+    branch (``_frontier_body``) — only the I/O differs.  One launch per
+    call: the T-deep halo exchange between launches is the XLA-level
+    ``ppermute`` in ``make_superstep``, and the tracked intervals ride
+    the SAME exchange as extended arrays.
+
+    ``lo0e``…``chie`` (SMEM, int32[grid + 2]) are the previous launch's
+    per-tile intervals EXTENDED with the neighbour strips' edge-tile
+    entries, pre-translated into THIS strip's row frame by the caller
+    (the north neighbour's strip-local row r is this strip's row
+    r − h_loc, so its entries arrive shifted by −h_loc; south by +h_loc;
+    column entries are board-global words and ship unshifted).  Index
+    k holds tile k − 1's intervals, so tile i's window sources are
+    exactly entries [i, i+1, i+2] — the same adjacency layout as the
+    round-3 bitmap extension in ``_ext_kernel_adaptive``.
+
+    ``ps_ref`` (int32[grid]) is the previous launch's OWN stability
+    bitmap (no exchange: only the copy-through decision reads it), and
+    ``dst_prev`` — the strip from two launches ago — is aliased onto
+    ``o_hbm``: the ping-pong write-elision contract of the adaptive
+    strip kernel, unchanged."""
+    del dst_prev  # same memory as o_hbm (aliased); contents ARE the output
+    i = pl.program_id(0)
+    t6 = turns + _SKIP_PERIOD
+    w_lo = i * tile_h - pad
+    w_hi = (i + 1) * tile_h + pad - 1
+    c_lo = i * tile_h
+    c_hi = (i + 1) * tile_h - 1
+
+    ivals = []
+    u_clo = jnp.int32(_EMPTY_LO)
+    u_chi = jnp.int32(-_EMPTY_LO)
+    for k in (i, i + 1, i + 2):
+        ivals.append((lo0e[k], hi0e[k]))
+        ivals.append((lo1e[k], hi1e[k]))
+        ncl = cloe[k]
+        nch = chie[k]
+        ne = ncl <= nch
+        u_clo = jnp.where(ne, jnp.minimum(u_clo, ncl), u_clo)
+        u_chi = jnp.where(ne, jnp.maximum(u_chi, nch), u_chi)
+    hit, u_lo, u_hi = _hit_union(ivals, w_lo, w_hi, c_lo, c_hi, t6)
+
+    @pl.when(jnp.logical_not(hit))
+    def _():
+        st_ref[i] = 1
+        nlo0[i] = _EMPTY_LO
+        nhi0[i] = -1
+        nlo1[i] = _EMPTY_LO
+        nhi1[i] = -1
+        nclo[i] = _EMPTY_LO
+        nchi[i] = -1
+
+        @pl.when(ps_ref[i] == 0)
+        def _():
+            # Skipped, but not twice in a row: the output buffer holds
+            # S_{k-2} ≠ S_k — copy the unchanged centre across.
+            c_in = pltpu.make_async_copy(
+                local.at[pl.ds(i * tile_h, tile_h), :],
+                tile.at[pl.ds(pad, tile_h), :],
+                sems.at[0],
+            )
+            c_in.start()
+            c_in.wait()
+            c_out = pltpu.make_async_copy(
+                tile.at[pl.ds(pad, tile_h), :],
+                o_hbm.at[pl.ds(i * tile_h, tile_h), :],
+                sems.at[0],
+            )
+            c_out.start()
+            c_out.wait()
+
+    @pl.when(hit)
+    def _():
+        st_ref[i] = 0
+        _dma_strip_window_in(local, north, south, tile, i, grid, tile_h, pad, sems)
+        route, lo0, hi0, lo1, hi1, clo, chi = _frontier_body(
+            tile, aux, merge, colwin, sems,
+            u_lo, u_hi, u_clo, u_chi,
+            i, tile_h, pad, turns, rule, sub_rows, col_window,
+        )
+        nlo0[i] = lo0
+        nhi0[i] = hi0
+        nlo1[i] = lo1
+        nhi1[i] = hi1
+        nclo[i] = clo
+        nchi[i] = chi
+        _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sems.at[0])
+
+
+def _adaptive_strip_plan(
+    strip: tuple[int, int], turns: int, raw_cap: int | None
+) -> tuple[int, int, bool, tuple | None]:
+    """(cap, t, adaptive, frontier_plan) for a skip_stable dispatch on a
+    strip — THE one decision shared by ``make_superstep`` (execution)
+    and ``launch_plan`` (the dryrun/BASELINE publication), so the
+    published plan can never drift from the executing one (the same
+    convention as ``_strip_plan_tile``).  A non-None plan means the
+    frontier strip kernel runs; the depth policy only returns its
+    shallow frontier depths when the plan exists, so the two cannot
+    desync."""
+    cap = raw_cap if raw_cap is not None else default_skip_cap(strip[0])
+    t, adaptive = adaptive_launch_depth(strip, turns, cap)
+    fplan = _frontier_plan(strip, t, cap) if adaptive else None
+    return cap, t, adaptive, fplan
 
 
 def _strip_plan_tile(
@@ -262,6 +387,64 @@ def _build_ext_launch_adaptive(
 
 
 @functools.lru_cache(maxsize=None)
+def _build_ext_launch_frontier(
+    strip: tuple[int, int],
+    rule: LifeRule,
+    turns: int,
+    interpret: bool,
+    tile_cap: int | None,
+):
+    """The frontier strip launch as ``(ps, lo0e, hi0e, lo1e, hi1e, cloe,
+    chie, local, north, south, dst_prev) -> (strip, st, nlo0, nhi0,
+    nlo1, nhi1, nclo, nchi)`` with the six interval arrays extended
+    (int32[grid + 2], neighbour edge-tile entries pre-translated by the
+    caller) and ``dst_prev`` ALIASED onto the strip output — the
+    ping-pong write-elision contract (see ``_ext_kernel_frontier``):
+    callers alternate two buffers and start each dispatch from full
+    intervals + a zero bitmap."""
+    h_loc, wp = strip
+    _require_adaptive_eligible(turns)
+    plan = _frontier_plan(strip, turns, tile_cap)
+    if plan is None:
+        raise ValueError(f"no frontier plan for {turns} turns on strip {strip}")
+    pad, sub_rows, col_window = plan
+    tile_h = _strip_plan_tile(strip, turns, tile_cap)
+    grid = h_loc // tile_h
+    kernel = partial(
+        _ext_kernel_frontier,
+        tile_h=tile_h,
+        pad=pad,
+        grid=grid,
+        turns=turns,
+        rule=rule,
+        sub_rows=sub_rows,
+        col_window=col_window,
+    )
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    any_ = pl.BlockSpec(memory_space=pl.ANY)
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[smem] * 7 + [any_] * 4,
+        out_specs=[any_] + [smem] * 7,
+        out_shape=[jax.ShapeDtypeStruct((h_loc, wp), jnp.uint32)]
+        + [jax.ShapeDtypeStruct((grid,), jnp.int32)] * 7,
+        input_output_aliases={10: 0},
+        scratch_shapes=[
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # full buffer
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # merge buffer
+            pltpu.VMEM(
+                (sub_rows, col_window if col_window else _LANES), jnp.uint32
+            ),  # column-tier window (minimal dummy when the tier is off)
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        compiler_params=_compiler_params(tile_h, pad, wp, True),
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=None)
 def _build_ext_launch(
     strip: tuple[int, int],
     rule: LifeRule,
@@ -327,6 +510,11 @@ def launch_plan(
     t = launch_turns(strip, turns, skip_tile_cap)
     pad = _round8(t)
     tile_h = _tile_for_pad(strip[0], wp, pad, skip_tile_cap)
+    # The adaptive tier this strip would run under skip_stable: the
+    # round-5 frontier strip kernel when a plan exists at the adaptive
+    # depth (its intervals add 6 int32 scalars per edge tile to the
+    # exchange — noise next to the pad-row halo), else the probing form.
+    _, t_a, adaptive, fplan = _adaptive_strip_plan(strip, turns, skip_tile_cap)
     return {
         "t": t,
         "pad": pad,
@@ -334,6 +522,15 @@ def launch_plan(
         "grid": strip[0] // tile_h,
         # 2 directions x pad rows x wp words x 4 bytes, per device per launch
         "halo_bytes": 2 * pad * wp * 4,
+        "adaptive_t": t_a if adaptive else None,
+        "frontier": None
+        if fplan is None
+        else {
+            "pad": fplan[0],
+            "sub_rows": fplan[1],
+            "col_window": fplan[2],
+            "halo_bytes": 2 * fplan[0] * wp * 4,
+        },
     }
 
 
@@ -405,10 +602,7 @@ def adaptive_strip_launches(
     # every caller, not just ones that pre-resolve the cap.
     if tile_cap is None:
         tile_cap = default_skip_cap(strip[0])
-    # frontier=False: the sharded path still runs the probing strip
-    # kernel, where the shallow frontier depths are a measured
-    # regression (see adaptive_launch_depth).
-    t, adaptive = adaptive_launch_depth(strip, turns, tile_cap, frontier=False)
+    t, adaptive = adaptive_launch_depth(strip, turns, tile_cap)
     full, _ = divmod(turns, t)
     if not adaptive or not full:
         return 0
@@ -452,14 +646,14 @@ def make_superstep(
         h, wp = board.shape
         strip = (h // ny, wp)
         if skip_stable:
-            cap = raw_cap if raw_cap is not None else default_skip_cap(strip[0])
-            t, t_adaptive = adaptive_launch_depth(
-                strip, turns, cap, frontier=False
+            cap, t, t_adaptive, fplan = _adaptive_strip_plan(
+                strip, turns, raw_cap
             )
         else:
             cap = None
             t = launch_turns(strip, turns, None)  # clamps to _MAX_T internally
             t_adaptive = False
+            fplan = None
         full, rem = divmod(turns, t)
 
         def make_step(tt: int, adaptive_ok: bool = False):
@@ -523,11 +717,104 @@ def make_superstep(
 
             return step
 
+        def make_step_frontier(tt: int):
+            # The frontier halo is DEEPER than the probing one:
+            # round8(tt + 6), so gen tt+6 is valid on the whole centre
+            # for the interval measure — the ppermute extent must match
+            # the kernel's plan pad, not the probing round8(tt).
+            pad = _frontier_plan(strip, tt, cap)[0]
+            call = _build_ext_launch_frontier(strip, rule, tt, ip, cap)
+            h_loc = strip[0]
+
+            @partial(
+                jax.shard_map,
+                mesh=mesh,
+                in_specs=(P("y"),) * 7 + (BOARD_SPEC, BOARD_SPEC),
+                out_specs=(BOARD_SPEC,) + (P("y"),) * 7,
+                check_vma=False,
+            )
+            def step(ps, l0, h0, l1, h1, cl, ch, local, prev):
+                # Edge-tile intervals ride the same ppermute as the halo
+                # rows; row entries are translated into the receiving
+                # strip's frame (the north neighbour's strip row r is
+                # this strip's row r − h_loc), column entries are
+                # board-global words and ship unshifted.  Empty
+                # intervals survive translation: lo > hi is preserved
+                # by adding the same offset to both.  The six edge
+                # scalars ship STACKED — one (6,) ppermute per
+                # direction, not twelve 4-byte collectives per launch.
+                shift = jnp.array(
+                    [h_loc] * 4 + [0, 0], dtype=jnp.int32
+                )
+                arrs = (l0, h0, l1, h1, cl, ch)
+                edge_n = jnp.stack([a[-1] for a in arrs])
+                edge_s = jnp.stack([a[0] for a in arrs])
+                from_n = lax.ppermute(
+                    edge_n, "y", _shift_perm(ny, forward=True)
+                ) - shift
+                from_s = lax.ppermute(
+                    edge_s, "y", _shift_perm(ny, forward=False)
+                ) + shift
+                args = [
+                    jnp.concatenate([from_n[k:k + 1], a, from_s[k:k + 1]])
+                    for k, a in enumerate(arrs)
+                ]
+                north = lax.ppermute(
+                    local[-pad:, :], "y", _shift_perm(ny, forward=True)
+                )
+                south = lax.ppermute(
+                    local[:pad, :], "y", _shift_perm(ny, forward=False)
+                )
+                return call(ps, *args, local, north, south, prev)
+
+            return step
+
         # The helper's flag IS the decision (same-plan contract); only the
         # non-skip path, which never consulted the helper, derives none.
         adaptive_t = skip_stable and t_adaptive
         skipped = jnp.int32(0)
-        if adaptive_t and full:
+        if adaptive_t and full and fplan is not None:
+            # Frontier strip kernel (round 5): tracked intervals replace
+            # the probe + bitmap; state is carried across launches in the
+            # XLA loop and exchanged at strip edges with the halo rows.
+            # Launch 1 starts from FULL row intervals + full column
+            # interval (everything computes, measuring exact state for
+            # launch 2 on), mirroring the megakernel's forced launch 0.
+            tile_h = _strip_plan_tile(strip, t, cap)
+            grid = strip[0] // tile_h
+            step_t = make_step_frontier(t)
+            lo0 = jnp.tile(jnp.arange(grid, dtype=jnp.int32) * tile_h, ny)
+            hi0 = lo0 + (tile_h - 1)
+            e_lo = jnp.full((ny * grid,), _EMPTY_LO, jnp.int32)
+            e_hi = jnp.full((ny * grid,), -1, jnp.int32)
+            cl0 = jnp.zeros((ny * grid,), jnp.int32)
+            ch0 = jnp.full((ny * grid,), wp - 1, jnp.int32)
+            ps0 = jnp.zeros((ny * grid,), jnp.int32)
+
+            def fbody(_, carry):
+                a, b, ps, l0, h0, l1, h1, cl, ch, sk = carry
+                r1 = step_t(ps, l0, h0, l1, h1, cl, ch, b, a)
+                nb1, st1 = r1[0], r1[1]
+                r2 = step_t(st1, *r1[2:], nb1, b)
+                nb2, st2 = r2[0], r2[1]
+                return (nb1, nb2, st2) + tuple(r2[2:]) + (
+                    sk + jnp.sum(st1) + jnp.sum(st2),
+                )
+
+            out = jax.lax.fori_loop(
+                0,
+                full // 2,
+                fbody,
+                (jnp.zeros_like(board), board, ps0, lo0, hi0,
+                 e_lo, e_hi, cl0, ch0, skipped),
+            )
+            a, board, ps = out[0], out[1], out[2]
+            skipped = out[-1]
+            if full % 2:
+                r = step_t(ps, *out[3:-1], board, a)
+                board = r[0]
+                skipped = skipped + jnp.sum(r[1])
+        elif adaptive_t and full:
             grid = strip[0] // _strip_plan_tile(strip, t, cap)
             step_t = make_step(t, adaptive_ok=True)
             # Bitmap zeroed per dispatch: launch 1 probes every tile, so

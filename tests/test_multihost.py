@@ -130,3 +130,13 @@ def test_cli_multihost_run(tmp_path):
     want = open("/root/reference/check/images/64x64x100.pgm", "rb").read()
     assert got == want
     assert not list(outs[1].iterdir()), "follower wrote files"
+
+
+def test_two_process_frontier_parity(tmp_path):
+    """Round-5 frontier strip kernel across a process-spanning mesh:
+    skip_stable + superstep=0 on 512-row strips (frontier plan engaged),
+    multi-dispatch, bit-identical to a single-device run (see
+    multihost_worker.frontier_main) — VERDICT round-4 'next' item 6."""
+    out = tmp_path / "out"
+    out.mkdir()
+    _launch_workers(tmp_path, "frontier", extra=(str(out),))

@@ -164,3 +164,94 @@ def test_sharded_ping_pong_multi_launch_elision(rng, mesh_shape):
             p.shape, mesh_shape, turns, 64
         )
         assert total > 0 and 0 < int(skipped) <= total
+
+
+class TestShardedFrontier:
+    """Frontier strip kernel (round 5): tracked row/column intervals ride
+    the same ``ppermute`` as the halo rows (edge-tile entries translated
+    into the receiving strip's frame), replacing the probe + bitmap on
+    sharded meshes too.  Bit-identity vs the XLA packed engine across
+    meshes, both launch parities, and the remainder split — the VERDICT
+    round-4 'next' item 1 done-criteria."""
+
+    H, W = 4096, 128  # (2,1)-mesh strips host the frontier plan
+
+    def _run(self, board_np, mesh_shape, turns):
+        mesh = make_mesh(mesh_shape)
+        p = packed.pack(jnp.asarray(board_np))
+        pb = jax.device_put(np.asarray(p), packed_sharding(mesh))
+        out, sk = pallas_halo.make_superstep(
+            mesh, CONWAY, skip_stable=True, with_stats=True
+        )(pb, turns)
+        return np.asarray(packed.unpack(out)), int(sk)
+
+    def _board(self):
+        b = np.zeros((self.H, self.W), dtype=np.uint8)
+        # Glider heading for the strip seam at H/2, ash elsewhere, and a
+        # pulsar (period 3) that must still be skip-proved; most stripes
+        # stay empty so skips + elisions actually exercise.
+        for dy, dx in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+            b[2030 + dy, 60 + dx] = 255
+        b[100:102, 20:22] = 255
+        seg = [2, 3, 4, 8, 9, 10]
+        for c in seg:
+            for r in (0, 5, 7, 12):
+                b[3000 + r, 40 + c] = 255
+                b[3000 + c, 40 + r] = 255
+        return b
+
+    def _check(self, mesh_shape, turns):
+        b = self._board()
+        ref = np.asarray(
+            packed.unpack(
+                packed.superstep(packed.pack(jnp.asarray(b)), CONWAY, turns)
+            )
+        )
+        got, sk = self._run(b, mesh_shape, turns)
+        assert np.array_equal(got, ref), (
+            f"diverged on mesh {mesh_shape} at turns={turns}"
+        )
+        return sk
+
+    def test_plan_engages(self):
+        from distributed_gol_tpu.ops import pallas_packed as pp
+
+        strip = (self.H // 2, self.W // 32)
+        t, adaptive = pp.adaptive_launch_depth(strip, 960, 1024)
+        assert adaptive and t == pp._FRONTIER_T
+        assert pp._frontier_plan(strip, t, 1024) is not None
+
+    def test_even_and_odd_launch_parity_2dev(self):
+        sk = self._check((2, 1), 4 * 18)  # final board in the launch-2 buffer
+        assert sk > 0  # empty stripes skipped
+        self._check((2, 1), 5 * 18)  # ...and in the other one
+
+    def test_remainder_split_and_tail(self):
+        self._check((2, 1), 4 * 18 + 12)  # period-multiple remainder launch
+        self._check((2, 1), 4 * 18 + 7)  # + 1-gen full-compute tail
+
+    def test_4dev_single_tile_strips(self):
+        # 1024-row strips at the default cap: grid == 1 per device, so a
+        # tile's left AND right window sources are its own neighbours'
+        # edge entries — the pure cross-strip adjacency case.
+        self._check((4, 1), 4 * 18)
+        self._check((4, 1), 5 * 18)
+
+    def test_seam_glider_long_run(self):
+        # Enough launches for the glider to cross the strip seam and for
+        # settled stripes to reach write-elision on both buffers.
+        self._check((2, 1), 10 * 18)
+
+    def test_shallow_depths_need_deeper_halo(self):
+        # t=6/t=12 dispatches: round8(t) != round8(t+6), so the ppermute
+        # extent must follow the frontier plan's deeper pad — at t=18
+        # the two coincide (24), which once masked exactly this bug.
+        b = self._board()
+        for turns in (6, 8, 12):
+            ref = np.asarray(
+                packed.unpack(
+                    packed.superstep(packed.pack(jnp.asarray(b)), CONWAY, turns)
+                )
+            )
+            got, _ = self._run(b, (2, 1), turns)
+            assert np.array_equal(got, ref), f"diverged at turns={turns}"
