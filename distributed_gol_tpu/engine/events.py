@@ -285,6 +285,61 @@ class EventQueue(queue.Queue):
             return TurnComplete(item.first)
         return item
 
+    def get_many(
+        self, max_n: int = 65536, block: bool = True, timeout: float | None = None
+    ):
+        """Up to ``max_n`` events in one call — the batched drain (round
+        5).  Compressed turn ranges come back COMPRESSED, as the public
+        :class:`TurnsCompleted` batch event, instead of being expanded
+        one :class:`TurnComplete` per generation: Python object creation
+        measures ~0.8 µs each on this class of host, which caps a
+        per-turn drain near 1.2M turns/s however it is batched — keeping
+        the run form removes the per-turn cost entirely while preserving
+        exact ordering and turn accounting (ranges tile the stream with
+        no gaps or overlaps; every other event type is returned as-is,
+        in place).  Consumers that need the reference-exact per-turn
+        objects keep calling :meth:`get`.
+
+        Blocking applies to the FIRST event only (per ``block`` /
+        ``timeout``, raising ``queue.Empty`` like ``get``); the rest are
+        whatever is available without waiting.  The list ends early at a
+        ``None`` stream sentinel, which is included for the caller to
+        see.  The one-``task_done``-per-returned-event pattern keeps
+        working (a returned run counts as one)."""
+        out: list = []
+        while len(out) < max_n:
+            exp = self._expand
+            if exp is not None:
+                t, last = exp
+                self._expand = None
+                out.append(
+                    TurnsCompleted(completed_turns=last, first_turn=t)
+                    if last > t
+                    else TurnComplete(t)
+                )
+                # The originating get() pre-paid one surplus per expanded
+                # event; collapsing the tail into ONE event must leave
+                # exactly one consumer task_done mapping to the real one.
+                self._surplus_dones -= last - t
+                continue
+            try:
+                item = super().get(block and not out, timeout if not out else None)
+            except queue.Empty:
+                if not out:
+                    raise  # same contract as get() on an empty stream
+                break
+            if type(item) is _TurnRange:
+                out.append(
+                    TurnsCompleted(
+                        completed_turns=item.last, first_turn=item.first
+                    )
+                )
+            else:
+                out.append(item)
+                if item is None:
+                    break
+        return out
+
     def task_done(self) -> None:
         # One underlying entry backs a whole expanded range: absorb the
         # per-event surplus so `get(); ...; task_done()` consumers and

@@ -718,23 +718,29 @@ def _frontier_plan(
     return pad_f, sub_rows, col_window
 
 
-def _hit_union(ivals, w_lo, w_hi, c_lo, c_hi, t6):
+def _hit_union(ivals, cvals, w_lo, w_hi, c_lo, c_hi, t6):
     """Fold a neighbourhood's tracked intervals (scalar (lo, hi) pairs
-    already translated into this stripe's row frame) into the skip
-    decision and the clamped recompute union.
+    already translated into this stripe's row frame, plus the (clo, chi)
+    column pairs in board words) into the skip decision and the clamped
+    recompute unions — ONE home, so the single-device megakernel and the
+    sharded strip kernel cannot drift.
 
-    ``hit``: some interval (+6-row pin margin) reaches the window — the
-    exact complement of the skip proof's "no activity near the window".
-    ``(u_lo, u_hi)``: union of the intervals intersected with the reach
-    band [c_lo − t6, c_hi + t6].  Activity farther than t6 = T+6 rows
-    from every centre row can neither change the centre within T
+    ``hit``: some row interval (+6-row pin margin) reaches the window —
+    the exact complement of the skip proof's "no activity near the
+    window".
+    ``(u_lo, u_hi)``: union of the row intervals intersected with the
+    reach band [c_lo − t6, c_hi + t6].  Activity farther than t6 = T+6
+    rows from every centre row can neither change the centre within T
     generations nor seed a new active measurable at gen T+6, so it is
     dropped PER INTERVAL before the union (round 5) — clamping the union
     afterwards (round 4) kept phantom rows between a far cluster and the
     band edge.  ``hit`` with an empty union is legal (activity within the
     pad-rounding sliver of the window but outside the band): the compute
     branch then recomputes nothing and measures an empty region, which
-    is sound — see ``_frontier_body``."""
+    is sound — see ``_frontier_body``.
+    ``(u_clo, u_chi)``: plain union of the nonempty column pairs —
+    conservative (a neighbour whose rows were clamped away still widens
+    it, which can only widen the column window)."""
     hit = jnp.bool_(False)
     u_lo = jnp.int32(_EMPTY_LO)
     u_hi = jnp.int32(-_EMPTY_LO)
@@ -750,7 +756,13 @@ def _hit_union(ivals, w_lo, w_hi, c_lo, c_hi, t6):
         keep = nonempty & (clo <= chi)
         u_lo = jnp.where(keep, jnp.minimum(u_lo, clo), u_lo)
         u_hi = jnp.where(keep, jnp.maximum(u_hi, chi), u_hi)
-    return hit, u_lo, u_hi
+    u_clo = jnp.int32(_EMPTY_LO)
+    u_chi = jnp.int32(-_EMPTY_LO)
+    for cl, ch in cvals:
+        ne = cl <= ch
+        u_clo = jnp.where(ne, jnp.minimum(u_clo, cl), u_clo)
+        u_chi = jnp.where(ne, jnp.maximum(u_chi, ch), u_chi)
+    return hit, u_lo, u_hi, u_clo, u_chi
 
 
 def _measure2(gT, g6, base_row, m_lo, m_hi, frame_off, col_off=0, col_valid=None):
@@ -981,21 +993,15 @@ def _kernel_frontier_mega(
     # halo comes from), so wrap handling is placement, not cyclic
     # interval arithmetic.
     ivals = []
-    u_clo = jnp.int32(_EMPTY_LO)
-    u_chi = jnp.int32(-_EMPTY_LO)
+    cvals = []
     for j, slot in ((left, -1), (i, 0), (right, 1)):
         off = (i + slot) * tile_h - j * tile_h
         ivals.append((ilo0[rd, j] + off, ihi0[rd, j] + off))
         ivals.append((ilo1[rd, j] + off, ihi1[rd, j] + off))
-        # Column union (board words, no frame shift): conservative — it
-        # unions every nonempty neighbour, even one whose rows were
-        # clamped away, which can only widen the column window.
-        ncl = iclo[rd, j]
-        nch = ichi[rd, j]
-        ne = ncl <= nch
-        u_clo = jnp.where(ne, jnp.minimum(u_clo, ncl), u_clo)
-        u_chi = jnp.where(ne, jnp.maximum(u_chi, nch), u_chi)
-    hit, u_lo, u_hi = _hit_union(ivals, w_lo, w_hi, c_lo, c_hi, t6)
+        cvals.append((iclo[rd, j], ichi[rd, j]))
+    hit, u_lo, u_hi, u_clo, u_chi = _hit_union(
+        ivals, cvals, w_lo, w_hi, c_lo, c_hi, t6
+    )
     # Launch 0: no tracked state yet — force the probing kernel's
     # "launch 1 computes everything" semantics with the maximal clamped
     # union (windowed_ok then fails, so the full branch measures the

@@ -239,17 +239,14 @@ def _ext_kernel_frontier(
     c_hi = (i + 1) * tile_h - 1
 
     ivals = []
-    u_clo = jnp.int32(_EMPTY_LO)
-    u_chi = jnp.int32(-_EMPTY_LO)
+    cvals = []
     for k in (i, i + 1, i + 2):
         ivals.append((lo0e[k], hi0e[k]))
         ivals.append((lo1e[k], hi1e[k]))
-        ncl = cloe[k]
-        nch = chie[k]
-        ne = ncl <= nch
-        u_clo = jnp.where(ne, jnp.minimum(u_clo, ncl), u_clo)
-        u_chi = jnp.where(ne, jnp.maximum(u_chi, nch), u_chi)
-    hit, u_lo, u_hi = _hit_union(ivals, w_lo, w_hi, c_lo, c_hi, t6)
+        cvals.append((cloe[k], chie[k]))
+    hit, u_lo, u_hi, u_clo, u_chi = _hit_union(
+        ivals, cvals, w_lo, w_hi, c_lo, c_hi, t6
+    )
 
     @pl.when(jnp.logical_not(hit))
     def _():

@@ -39,15 +39,21 @@ def _print_event(event) -> None:
 
 def run_headless(params: Params, events: queue.Queue) -> FinalTurnComplete | None:
     """Drain the stream, printing telemetry; returns the final event.
-    Equivalent of the reference's -noVis drain loop (``main.go:56-67``)."""
+    Equivalent of the reference's -noVis drain loop (``main.go:56-67``).
+    On an :class:`EventQueue` the drain is batched (``get_many``): turn
+    runs stay compressed as ``TurnsCompleted`` — both turn forms print
+    nothing, so the visible output is unchanged while the drain stops
+    costing one Python object per generation."""
     final = None
+    get_many = getattr(events, "get_many", None)
     while True:
-        e = events.get()
-        if e is None:
-            return final
-        if isinstance(e, FinalTurnComplete):
-            final = e
-        _print_event(e)
+        batch = get_many() if get_many is not None else [events.get()]
+        for e in batch:
+            if e is None:
+                return final
+            if isinstance(e, FinalTurnComplete):
+                final = e
+            _print_event(e)
 
 
 def run_terminal(

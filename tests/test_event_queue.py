@@ -118,3 +118,86 @@ class TestEventQueueStreamParity:
         plain = _comparable(_stream(queue.Queue(), turns=1000, cycle_check=4))
         fast = _comparable(_stream(EventQueue(), turns=1000, cycle_check=4))
         assert plain == fast
+
+
+class TestGetMany:
+    """Batched drain (round 5): ``get_many`` keeps turn runs compressed as
+    public ``TurnsCompleted`` events — exact ordering and turn accounting
+    with no per-generation object — while plain ``get`` users keep the
+    reference-exact per-turn stream."""
+
+    def test_runs_stay_compressed_in_order(self):
+        from distributed_gol_tpu.engine.events import TurnsCompleted
+
+        q = EventQueue()
+        q.put_turns(1, 1)
+        q.put(AliveCellsCount(1, 7))
+        q.put_turns(2, 100)
+        q.put(FinalTurnComplete(100, []))
+        q.put(None)
+        got = q.get_many()
+        assert got == [
+            TurnComplete(1),
+            AliveCellsCount(1, 7),
+            TurnsCompleted(completed_turns=100, first_turn=2),
+            FinalTurnComplete(100, []),
+            None,
+        ]
+
+    def test_max_n_and_nonblocking_tail(self):
+        q = EventQueue()
+        for t in range(5):
+            q.put_turns(10 * t, 10 * t + 9)
+        got = q.get_many(max_n=3)
+        assert len(got) == 3 and got[0].first_turn == 0
+        rest = q.get_many(max_n=100, block=False)
+        assert len(rest) == 2 and rest[-1].completed_turns == 49
+
+    def test_empty_raises_like_get(self):
+        q = EventQueue()
+        with pytest.raises(queue.Empty):
+            q.get_many(block=False)
+        with pytest.raises(queue.Empty):
+            q.get_many(timeout=0.01)
+
+    def test_mixed_get_then_get_many_collapses_leftover(self):
+        from distributed_gol_tpu.engine.events import TurnsCompleted
+
+        q = EventQueue()
+        q.put_turns(0, 9)
+        q.put(None)
+        first = q.get()
+        assert first == TurnComplete(0)
+        got = q.get_many()
+        assert got == [TurnsCompleted(completed_turns=9, first_turn=1), None]
+
+    def test_task_done_join_with_batched_consumer(self):
+        import threading
+
+        q = EventQueue()
+        q.put_turns(0, 99)
+        q.put(AliveCellsCount(99, 1))
+        q.put_turns(100, 100)
+        done = threading.Event()
+
+        def consumer():
+            n = 0
+            while n < 3:
+                for e in q.get_many():
+                    q.task_done()
+                    n += 1
+            done.set()
+
+        threading.Thread(target=consumer, daemon=True).start()
+        q.join()  # returns only if task_done bookkeeping balances
+        assert done.wait(5)
+
+    def test_mixed_get_and_task_done_then_batch_join(self):
+        q = EventQueue()
+        q.put_turns(0, 9)
+        q.get()  # expands one of ten
+        q.task_done()
+        rest = q.get_many()
+        assert len(rest) == 1
+        q.task_done()
+        q.join()  # the collapsed tail maps to exactly one real task_done
