@@ -1,0 +1,152 @@
+"""Hardware-compile every shipped kernel plan geometry — without running it.
+
+Interpret mode cannot catch the Mosaic divisibility class of regressions:
+``x & ~7`` index forms compile happily in interpret mode and fail only on
+real hardware (the recorded round-4 rule — multiplication forms like
+``idx8 * 8`` are the only ones whose 8-alignment Mosaic can prove), so the
+hermetic suite structurally cannot gate kernel index arithmetic.  This
+tool closes the hole cheaply (round-4 verdict, weak-5): it AOT-compiles
+(``jit.lower().compile()``) each shipped plan on the attached TPU.
+Compilation IS the gate — no board data is materialised, so even the
+65536² geometries gate in ~10 s each (cached across a process).
+
+Coverage:
+- Single-device supersteps at both headline boards, with turn counts
+  chosen so ONE lowering contains every launch form of the dispatch
+  (frontier megakernel + period-multiple probing remainder + full-compute
+  tail; and the plain non-adaptive kernel).
+- The sharded strip kernels (frontier / probing-adaptive / plain) at
+  every (ny, 1) strip geometry ``dryrun_multichip`` plans — compiled
+  DIRECTLY as strip-shaped pallas_calls, no device mesh needed, which is
+  what lets one chip gate multi-chip Mosaic lowering.
+
+Usage: ``python tools/hw_compile_gate.py`` (exit 1 on any failure), or
+``from tools.hw_compile_gate import run_gate`` (bench.py records the
+result in its JSON artifact every round).
+
+Reference analog: ``content/ReporGuidanceCollated.md:60-83`` (the bench
+protocol's "prove it compiles on the real target" discipline).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _configs():
+    """(label, build_and_lower) pairs for every shipped plan geometry."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_gol_tpu.models.life import CONWAY
+    from distributed_gol_tpu.ops import pallas_packed as pp
+    from distributed_gol_tpu.parallel import pallas_halo as ph
+
+    def superstep(shape, skip, turns):
+        def lower():
+            run = pp.make_superstep(CONWAY, skip_stable=skip)
+            run.lower(
+                jax.ShapeDtypeStruct(shape, jnp.uint32), turns=turns
+            ).compile()
+        return lower
+
+    def strip(kind, shape, turns):
+        def lower():
+            cap = pp.default_skip_cap(shape[0])
+            i32 = lambda n: jax.ShapeDtypeStruct((n,), jnp.int32)  # noqa: E731
+            b = jax.ShapeDtypeStruct(shape, jnp.uint32)
+            if kind == "frontier":
+                call = ph._build_ext_launch_frontier(shape, CONWAY, turns, False, cap)
+                grid = shape[0] // ph._strip_plan_tile(shape, turns, cap)
+                pad = pp._frontier_plan(shape, turns, cap)[0]
+                h = jax.ShapeDtypeStruct((pad, shape[1]), jnp.uint32)
+                args = [i32(grid)] + [i32(grid + 2)] * 6 + [b, h, h, b]
+            elif kind == "adaptive":
+                call = ph._build_ext_launch_adaptive(shape, CONWAY, turns, False, cap)
+                grid = shape[0] // ph._strip_plan_tile(shape, turns, cap)
+                pad = pp._round8(turns)
+                h = jax.ShapeDtypeStruct((pad, shape[1]), jnp.uint32)
+                args = [i32(grid + 2), b, h, h, b]
+            else:  # plain
+                call = ph._build_ext_launch(shape, CONWAY, turns, False)
+                pad = pp._round8(turns)
+                ext = jax.ShapeDtypeStruct((shape[0] + 2 * pad, shape[1]), jnp.uint32)
+                args = [ext]
+            jax.jit(call).lower(*args).compile()
+        return lower
+
+    cfgs = []
+    for size, wp in ((16384, 512), (65536, 2048)):
+        shape = (size, wp)
+        t_f, _ = pp.adaptive_launch_depth(
+            shape, 10**6, pp.default_skip_cap(size)
+        )
+        # One adaptive lowering holds the megakernel + the probing
+        # remainder launch + the full-compute tail: T*5 + 6 + 5.
+        cfgs.append(
+            (f"{size}^2 adaptive T={t_f}+rem", superstep(shape, True, t_f * 5 + 11))
+        )
+        cfgs.append((f"{size}^2 plain", superstep(shape, False, 128)))
+        for ny in (2, 4, 8):
+            s = (size // ny, wp)
+            scap = pp.default_skip_cap(s[0])
+            t_s, adaptive = pp.adaptive_launch_depth(s, 10**6, scap)
+            if adaptive and pp._frontier_plan(s, t_s, scap) is not None:
+                cfgs.append((f"strip {s} frontier T={t_s}", strip("frontier", s, t_s)))
+            if adaptive:
+                cfgs.append((f"strip {s} probing T=18", strip("adaptive", s, 18)))
+        # One plain strip form per size covers the non-adaptive sharded path.
+        cfgs.append((f"strip {(size // 4, wp)} plain T=16", strip("plain", (size // 4, wp), 16)))
+    return cfgs
+
+
+def run_gate(log=print, core: bool = False) -> dict:
+    """Compile every config; returns {"ok": n, "failed": [labels]} — the
+    line bench.py folds into its JSON artifact.  ``core=True`` gates the
+    subset bench.py's own measurements never compile (the sharded strip
+    kernels + the 65536² adaptive form) so the per-round bench cost
+    stays ~90 s; the full set is this tool's CLI."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {"ok": 0, "failed": [], "skipped": "no TPU attached"}
+    cfgs = _configs()
+    if core:
+        keep = ("strip (8192, 512) frontier", "strip (32768, 2048) frontier",
+                "65536^2 adaptive")
+        cfgs = [(l, f) for l, f in cfgs if l.startswith(keep)]
+        if len(cfgs) != len(keep):
+            # The filter failing to find its configs IS a gate failure —
+            # it means a planning change removed a geometry the gate
+            # exists to cover (or a label changed); reporting ok=0 with
+            # no failures would read as a clean pass.
+            return {
+                "ok": 0,
+                "failed": [f"core filter matched {len(cfgs)}/{len(keep)} configs"],
+            }
+    ok, failed = 0, []
+    for label, lower in cfgs:
+        t0 = time.perf_counter()
+        try:
+            lower()
+            ok += 1
+            log(f"  hw-gate {label}: ok ({time.perf_counter() - t0:.1f}s)")
+        except Exception as e:  # noqa: BLE001 — the gate must enumerate all
+            failed.append(label)
+            log(f"  hw-gate {label}: FAILED — {type(e).__name__}: {e}")
+    return {"ok": ok, "failed": failed}
+
+
+def main():
+    res = run_gate()
+    print(res)
+    if res.get("failed"):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
