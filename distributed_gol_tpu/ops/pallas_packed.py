@@ -847,6 +847,68 @@ def _measure2(gT, g6, base_row, m_lo, m_hi, frame_off, col_off=0, col_valid=None
     )
 
 
+def _frontier_placement(u_lo, u_hi, i, tile_h, pad, turns, sub_rows):
+    """Row sub-window placement + eligibility from the clamped union —
+    ONE home shared by ``_frontier_body`` and the megakernel's rectangle
+    routing, so the two can never disagree about which tier a stripe
+    takes.  Offsets are ``idx8 * 8`` multiplication forms so Mosaic can
+    statically prove the dynamic sublane alignment (clip/and-mask forms
+    lose the proof).  Eligibility = exact coverage: the whole measure
+    region (a superset of the centre's recompute region) must land in
+    the sub-window's gen-(T+6) validity region
+    [win_lo + t6, win_lo + S − t6)."""
+    h_ext = tile_h + 2 * pad
+    t6 = turns + _SKIP_PERIOD
+    w_lo = i * tile_h - pad
+    d_lo = u_lo - w_lo  # window-frame coords
+    d_hi = u_hi - w_lo
+    m_lo = jnp.maximum(d_lo - t6, pad)
+    m_hi = jnp.minimum(d_hi + t6, pad + tile_h - 1)
+    idx8 = jnp.clip(d_lo - 2 * turns - 16, 0, h_ext - sub_rows) // 8
+    win_lo = idx8 * 8
+    windowed_ok = (win_lo + t6 <= m_lo) & (m_hi < win_lo + sub_rows - t6)
+    return win_lo, m_lo, m_hi, windowed_ok
+
+
+def _col_placement(u_clo, u_chi, turns, col_window, wp):
+    """Column-window placement + eligibility (see ``_frontier_body``'s
+    soundness notes): 128-word-quantized lane offset (``cidx * 128``
+    carries the Mosaic lane-tile alignment proof), and ``col_ok``
+    requires the whole reach band inside the window's validity region —
+    which also keeps it ≥ t6 cells from the board edge, so the torus
+    x-wrap can never matter.  Returns (win_c, col_ok, cw)."""
+    t6 = turns + _SKIP_PERIOD
+    cw = (t6 + 31) // 32  # reach/validity margin in words (≥ t6 cells)
+    need_lo = u_clo - cw
+    need_hi = u_chi + cw
+    cidx = jnp.clip(need_lo - cw, 0, wp - col_window) // 128
+    win_c = cidx * 128
+    col_ok = (win_c + cw <= need_lo) & (need_hi < win_c + col_window - cw)
+    return win_c, col_ok, cw
+
+
+def _col_compute(sub0, turns, rule, cw, col_window, sub_rows):
+    """T + 6 generations of a column window plus the valid-cell merge —
+    the ONE compute body shared by the megakernel's rectangle route and
+    the classic column tier (the sharded strip kernel's form), so the
+    two can never diverge.  Returns (gT, g6, merged) where ``merged``
+    equals S_{l+1} on every centre cell of the window: validity-region
+    cells are the true gen-T state (full light cone inside the window),
+    the rest are T-pinned copies of the gen-0 input (soundness notes in
+    :func:`_frontier_body`)."""
+    gT = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), sub0)
+    g6 = jax.lax.fori_loop(0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), gT)
+    k = jax.lax.broadcasted_iota(jnp.int32, (sub_rows, col_window), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (sub_rows, col_window), 1)
+    valid = (
+        (k >= turns)
+        & (k < sub_rows - turns)
+        & (c >= cw)
+        & (c < col_window - cw)
+    )
+    return gT, g6, jnp.where(valid, gT, sub0)
+
+
 def _frontier_body(
     tile, aux, merge, colwin, sems,
     u_lo, u_hi, u_clo, u_chi,
@@ -888,21 +950,11 @@ def _frontier_body(
     region [d − t6, d + t6] ∩ centre covers every row/column whose
     state can differ between gens T and T+6 (such a cell is within 6 of
     a gen-T active cell, itself within T of a gen-0 one)."""
-    h_ext = tile_h + 2 * pad
     t6 = turns + _SKIP_PERIOD
     w_lo = i * tile_h - pad  # window top, stripe-frame rows
-    d_lo = u_lo - w_lo  # window-frame coords
-    d_hi = u_hi - w_lo
-    m_lo = jnp.maximum(d_lo - t6, pad)
-    m_hi = jnp.minimum(d_hi + t6, pad + tile_h - 1)
-    # Expressed as idx8 * 8 so Mosaic can statically prove the dynamic
-    # sublane offset is 8-aligned (clip/and-mask forms lose the proof).
-    idx8 = jnp.clip(d_lo - 2 * turns - 16, 0, h_ext - sub_rows) // 8
-    win_lo = idx8 * 8
-    # Eligibility = exact coverage: the whole measure region (a superset
-    # of the centre's recompute region) must land in the sub-window's
-    # gen-(T+6) validity region [win_lo + t6, win_lo + S − t6).
-    windowed_ok = (win_lo + t6 <= m_lo) & (m_hi < win_lo + sub_rows - t6)
+    win_lo, m_lo, m_hi, windowed_ok = _frontier_placement(
+        u_lo, u_hi, i, tile_h, pad, turns, sub_rows
+    )
     wp = tile.shape[1]
 
     def measure_args():
@@ -931,19 +983,8 @@ def _frontier_body(
     if col_window is None:
         return row_tiers()
 
-    cw = (t6 + 31) // 32  # reach/validity margin in words (≥ t6 cells)
-    need_lo = u_clo - cw
-    need_hi = u_chi + cw
-    # 128-word-quantized placement (cidx * 128: the multiplication form
-    # Mosaic can prove lane-tile-aligned); wp − col_window is a 128
-    # multiple because wp % 128 == 0 on every tiled board.
-    cidx = jnp.clip(need_lo - cw, 0, wp - col_window) // 128
-    win_c = cidx * 128
-    col_ok = (
-        windowed_ok
-        & (win_c + cw <= need_lo)
-        & (need_hi < win_c + col_window - cw)
-    )
+    win_c, c_ok, cw = _col_placement(u_clo, u_chi, turns, col_window, wp)
+    col_ok = windowed_ok & c_ok
 
     def col_windowed():
         c_in = pltpu.make_async_copy(
@@ -953,18 +994,10 @@ def _frontier_body(
         )
         c_in.start()
         c_in.wait()
-        sub0 = colwin[:]
-        gT = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), sub0)
-        g6 = jax.lax.fori_loop(0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), gT)
-        k = jax.lax.broadcasted_iota(jnp.int32, (sub_rows, col_window), 0)
-        c = jax.lax.broadcasted_iota(jnp.int32, (sub_rows, col_window), 1)
-        valid = (
-            (k >= turns)
-            & (k < sub_rows - turns)
-            & (c >= cw)
-            & (c < col_window - cw)
+        gT, g6, merged = _col_compute(
+            colwin[:], turns, rule, cw, col_window, sub_rows
         )
-        colwin[:] = jnp.where(valid, gT, sub0)
+        colwin[:] = merged
         merge[:] = tile[:]
         c_out = pltpu.make_async_copy(
             colwin.at[:],
@@ -984,7 +1017,8 @@ def _frontier_body(
 def _kernel_frontier_mega(
     xa, xb, oa, ob, sk_ref,
     tile, aux, merge, colwin,
-    ilo0, ihi0, ilo1, ihi1, iclo, ichi, ist,
+    ilo0, ihi0, ilo1, ihi1, iclo, ichi,
+    rr8, rn8, rc128, rn128,
     acc, sems,
     *, tile_h, pad, grid, nlaunch, turns, rule, sub_rows, col_window,
 ):
@@ -994,21 +1028,43 @@ def _kernel_frontier_mega(
     interval/skip state across launches and the two HBM board refs
     ping-pong by launch parity.
 
-    Buffer protocol: ``oa`` holds S_0 on entry (aliased input board);
-    launch l reads the board written at l−1 (``oa`` for even l) and
-    writes the buffer last written at l−2 (``ob`` for even l) — an
-    elided stripe's rows there already hold S_{l−2} == S_l, the round-4
-    ping-pong invariant, now without the two-launch XLA unroll.  Launch
-    0 computes every stripe (forced full union), so ``ob`` is fully
-    defined before any elision.  The final board sits in ``ob`` when
-    nlaunch is odd, ``oa`` when even — the builder's caller selects.
+    Buffer protocol (round 5, rectangle writes): launch l reads the
+    board written at l−1 (``oa`` for even l, holding S_l's input) and
+    writes into the buffer last written at l−2.  Each stripe publishes
+    its CHANGE RECTANGLE C_l — the region where S_{l+1} may differ from
+    S_l, clipped to its own centre — and each launch writes exactly
+    C_{l−1} ∪ C_l: outside that union the write buffer's S_{l−2}
+    content already equals S_l (S_l vs S_{l−1} differ only inside
+    C_l ⊆ the union; S_{l−1} vs S_{l−2} only inside C_{l−1}).  A
+    skipped stripe has C_l = ∅ and only copies C_{l−1} across (read →
+    write buffer); skipped twice, C_{l−1} is empty too and the stripe
+    does NOTHING — the round-4 write elision, now emerging from the
+    rect protocol instead of a separate flag.  Rectangles are stored in
+    CHUNK UNITS (8-row / 128-lane quanta) and reconstructed as
+    ``idx * quantum`` so Mosaic's alignment proofs survive the SMEM
+    round-trip.  Launch 0 computes every stripe (forced full union), so
+    both buffers are fully defined before any elision; the final board
+    sits in ``ob`` when nlaunch is odd, ``oa`` when even.
 
-    State protocol: the interval/stability scratches are (2, grid),
-    row l%2 written by launch l, neighbours read from row (l+1)%2 —
-    so a stripe never reads a neighbour's CURRENT-launch value no
-    matter the grid order within one launch.  (The HBM board refs
-    can't be indexed dynamically, hence their pl.when parity blocks;
-    SMEM can, hence one array each.)"""
+    Compute routing: a stripe whose row window AND column window are
+    eligible and whose row window does not straddle the torus seam
+    takes the RECTANGLE route — it DMAs only the (sub_rows, col_window)
+    window straight from the read buffer (the round-4 form round-tripped
+    the whole (tile_h + 2·pad) × wp window through VMEM: ~4.2 MB per
+    active stripe per launch at 16384² for ~170 KB of real work),
+    computes, and writes back the window ∩ centre.  Everything it
+    writes equals S_l: validity-region cells are the true gen-T state,
+    and cells outside it are T-pinned copies of the gen-0 input.  Other
+    stripes fall back to the classic whole-window path (row-window /
+    full tiers via ``_frontier_body``), which writes the whole centre —
+    a superset of any C_{l−1} ⊆ centre, so the union obligation holds
+    there for free.
+
+    State protocol: all scratches are (2, grid), row l%2 written by
+    launch l, neighbours read from row (l+1)%2 — so a stripe never
+    reads a neighbour's CURRENT-launch value no matter the grid order
+    within one launch.  (The HBM board refs can't be indexed
+    dynamically, hence the pl.when parity blocks around every DMA.)"""
     del xa, xb  # same memory as oa/ob (aliased); contents ARE the boards
     l = pl.program_id(0)
     i = pl.program_id(1)
@@ -1019,6 +1075,7 @@ def _kernel_frontier_mega(
     w_hi = (i + 1) * tile_h + pad - 1
     c_lo = i * tile_h
     c_hi = (i + 1) * tile_h - 1
+    wp = tile.shape[1]
     wr = jax.lax.rem(l, 2)
     rd = 1 - wr
     even = wr == 0
@@ -1050,54 +1107,209 @@ def _kernel_frontier_mega(
     hit = hit | first
     u_lo = jnp.where(first, c_lo - t6, u_lo)
     u_hi = jnp.where(first, c_hi + t6, u_hi)
-    # Own skip flag from the previous launch (launch 0 never reads it).
-    ps = ist[rd, i]
+    # Own change-rect from the previous launch (launch 0 never uses it:
+    # the skip and rectangle branches are unreachable under the forced
+    # full union, and the classic branch writes the whole centre).
+    p_r8 = rr8[rd, i]
+    p_n8 = rn8[rd, i]
+    p_c128 = rc128[rd, i]
+    p_n128 = rn128[rd, i]
 
-    def put_state(st, lo0, hi0, lo1, hi1, clo, chi):
-        ist[wr, i] = st
+    def put_state(lo0, hi0, lo1, hi1, clo, chi, r8, n8, c128, n128):
         ilo0[wr, i] = lo0
         ihi0[wr, i] = hi0
         ilo1[wr, i] = lo1
         ihi1[wr, i] = hi1
         iclo[wr, i] = clo
         ichi[wr, i] = chi
+        rr8[wr, i] = r8
+        rn8[wr, i] = n8
+        rc128[wr, i] = c128
+        rn128[wr, i] = n128
+
+    def copy_rect(src, dst, r8, n8, c128, n128):
+        """read→write copy of a chunked change-rect, staged through the
+        ``tile`` scratch.  Fast paths cover the two rect shapes the
+        protocol actually publishes — (sub_rows, col_window) from the
+        rectangle route and (tile_h, wp) from the classic route — with
+        one DMA pair each; clipped rects (cluster near a stripe edge)
+        take an 8-row chunk loop."""
+        row0 = r8 * 8
+        col0 = c128 * 128
+
+        def pair(shape_rows, shape_cols, s_row, d_row, c0):
+            c_in = pltpu.make_async_copy(
+                src.at[pl.ds(s_row, shape_rows), pl.ds(c0, shape_cols)],
+                tile.at[pl.ds(0, shape_rows), pl.ds(0, shape_cols)],
+                sems.at[0],
+            )
+            c_in.start()
+            c_in.wait()
+            c_out = pltpu.make_async_copy(
+                tile.at[pl.ds(0, shape_rows), pl.ds(0, shape_cols)],
+                dst.at[pl.ds(d_row, shape_rows), pl.ds(c0, shape_cols)],
+                sems.at[0],
+            )
+            c_out.start()
+            c_out.wait()
+
+        # The protocol only ever publishes two rect shapes: the rectangle
+        # route's (sub_rows, col_window) and the classic route's
+        # (tile_h, wp) — with the column tier off, just the latter.
+        shapes = [(tile_h, wp)]
+        if col_window is not None:
+            shapes.insert(0, (sub_rows, col_window))
+        fast = jnp.bool_(False)
+        for srows, scols in shapes:
+            match = (n8 == srows // 8) & (n128 == scols // 128)
+            fast = fast | match
+
+            @pl.when(match)
+            def _(srows=srows, scols=scols):
+                pair(srows, scols, row0, row0, col0)
+
+        @pl.when(jnp.logical_not(fast))
+        def _():
+            # Clipped rect (cluster near a stripe edge): 8-row chunks.
+            for _, scols in shapes:
+                @pl.when(n128 == scols // 128)
+                def _(scols=scols):
+                    def chunk(k, _):
+                        pair(8, scols, (r8 + k) * 8, (r8 + k) * 8, col0)
+                        return 0
+
+                    jax.lax.fori_loop(0, n8, chunk, 0)
 
     @pl.when(jnp.logical_not(hit))
     def _():
-        put_state(1, _EMPTY_LO, -1, _EMPTY_LO, -1, _EMPTY_LO, -1)
+        put_state(
+            _EMPTY_LO, -1, _EMPTY_LO, -1, _EMPTY_LO, -1, 0, 0, 0, 0
+        )
         acc[0] = acc[0] + 1
 
-        @pl.when(ps == 0)
+        @pl.when(p_n8 > 0)
         def _():
-            # Skipped, but not twice in a row: the write buffer holds
-            # S_{l−2} ≠ S_l, so the unchanged centre must still be
-            # copied across (VMEM round-trip; elision proper starts the
-            # next launch).
-            def copy_centre(src, dst):
-                c_in = pltpu.make_async_copy(
-                    src.at[pl.ds(i * tile_h, tile_h), :],
-                    tile.at[pl.ds(pad, tile_h), :],
-                    sems.at[0],
-                )
-                c_in.start()
-                c_in.wait()
-                c_out = pltpu.make_async_copy(
-                    tile.at[pl.ds(pad, tile_h), :],
-                    dst.at[pl.ds(i * tile_h, tile_h), :],
-                    sems.at[0],
-                )
-                c_out.start()
-                c_out.wait()
-
+            # Skipped, but the previous launch changed something: the
+            # write buffer holds S_{l−2} there; copy S_{l−1} (== S_l on
+            # a skipped stripe) across.  Elision proper starts the next
+            # launch, when the published rect is empty.
             @pl.when(even)
             def _():
-                copy_centre(oa, ob)
+                copy_rect(oa, ob, p_r8, p_n8, p_c128, p_n128)
 
             @pl.when(jnp.logical_not(even))
             def _():
-                copy_centre(ob, oa)
+                copy_rect(ob, oa, p_r8, p_n8, p_c128, p_n128)
 
-    @pl.when(hit)
+    win_lo, m_lo, m_hi, windowed_ok = _frontier_placement(
+        u_lo, u_hi, i, tile_h, pad, turns, sub_rows
+    )
+    # Window top in board rows.  The natural form w_lo + win_lo contains
+    # the `i*tile_h - pad` subtraction whose 8-divisibility Mosaic cannot
+    # prove (the recorded round-4 rule — hardware-only failure); keep the
+    # arithmetic in 8-row CHUNK units and multiply once, which carries
+    # the proof through every slice offset derived from it.
+    g8 = i * (tile_h // 8) - pad // 8 + win_lo // 8
+    g_lo = g8 * 8
+    if col_window is not None:
+        win_c, c_ok, cw = _col_placement(u_clo, u_chi, turns, col_window, wp)
+        rect_ok = (
+            hit
+            & windowed_ok
+            & c_ok
+            & (g_lo >= 0)
+            & (g_lo + sub_rows <= grid * tile_h)
+        )
+    else:
+        rect_ok = jnp.bool_(False)
+
+    if col_window is not None:
+        @pl.when(rect_ok)
+        def _():
+            @pl.when(even)
+            def _():
+                c = pltpu.make_async_copy(
+                    oa.at[pl.ds(g_lo, sub_rows), pl.ds(win_c, col_window)],
+                    colwin.at[:],
+                    sems.at[0],
+                )
+                c.start()
+                c.wait()
+
+            @pl.when(jnp.logical_not(even))
+            def _():
+                c = pltpu.make_async_copy(
+                    ob.at[pl.ds(g_lo, sub_rows), pl.ds(win_c, col_window)],
+                    colwin.at[:],
+                    sems.at[0],
+                )
+                c.start()
+                c.wait()
+
+            gT, g6, merged = _col_compute(
+                colwin[:], turns, rule, cw, col_window, sub_rows
+            )
+            colwin[:] = merged
+            lo0, hi0, lo1, hi1, clo, chi = _measure2(
+                gT, g6, win_lo, m_lo, m_hi, w_lo,
+                col_off=win_c, col_valid=(cw, col_window - cw),
+            )
+            # Change-rect = window ∩ own centre, in chunk units (the //8
+            # floors are exact: both bounds are 8-aligned).
+            r8 = jnp.maximum(g_lo, c_lo) // 8
+            n8 = jnp.minimum(g_lo + sub_rows, c_lo + tile_h) // 8 - r8
+            put_state(
+                lo0, hi0, lo1, hi1, clo, chi,
+                r8, n8, win_c // 128, col_window // 128,
+            )
+
+            def write_out(src_board, dst):
+                @pl.when(p_n8 > 0)
+                def _():
+                    copy_rect(src_board, dst, p_r8, p_n8, p_c128, p_n128)
+
+                # C_l write AFTER the C_{l−1} copy: where they overlap
+                # the computed S_l values must win.
+                full_span = n8 == sub_rows // 8
+
+                @pl.when(full_span)
+                def _():
+                    c = pltpu.make_async_copy(
+                        colwin.at[:],
+                        dst.at[
+                            pl.ds(g_lo, sub_rows), pl.ds(win_c, col_window)
+                        ],
+                        sems.at[0],
+                    )
+                    c.start()
+                    c.wait()
+
+                @pl.when(jnp.logical_not(full_span))
+                def _():
+                    def chunk(kk, _):
+                        c = pltpu.make_async_copy(
+                            colwin.at[pl.ds((r8 + kk - g8) * 8, 8), :],
+                            dst.at[
+                                pl.ds((r8 + kk) * 8, 8),
+                                pl.ds(win_c, col_window),
+                            ],
+                            sems.at[0],
+                        )
+                        c.start()
+                        c.wait()
+                        return 0
+
+                    jax.lax.fori_loop(0, n8, chunk, 0)
+
+            @pl.when(even)
+            def _():
+                write_out(oa, ob)
+
+            @pl.when(jnp.logical_not(even))
+            def _():
+                write_out(ob, oa)
+
+    @pl.when(hit & jnp.logical_not(rect_ok))
     def _():
         @pl.when(even)
         def _():
@@ -1107,12 +1319,20 @@ def _kernel_frontier_mega(
         def _():
             _dma_window_in(ob, tile, i, left, right, tile_h, pad, sems)
 
+        # Classic whole-window path: row-window / full tiers only (the
+        # column tier lives in the rectangle route; a wrap-straddling
+        # cluster that fails rect_ok gets the row tier's full width).
         route, lo0, hi0, lo1, hi1, clo, chi = _frontier_body(
             tile, aux, merge, colwin, sems,
             u_lo, u_hi, u_clo, u_chi,
-            i, tile_h, pad, turns, rule, sub_rows, col_window,
+            i, tile_h, pad, turns, rule, sub_rows, None,
         )
-        put_state(0, lo0, hi0, lo1, hi1, clo, chi)
+        # Whole centre written ⇒ the change-rect is the whole stripe
+        # (⊇ any C_{l−1}, so the union obligation holds for free).
+        put_state(
+            lo0, hi0, lo1, hi1, clo, chi,
+            c_lo // 8, tile_h // 8, 0, wp // 128,
+        )
 
         @pl.when(even)
         def _():
@@ -1189,11 +1409,12 @@ def _build_dispatch_frontier(
             pltpu.VMEM(
                 (sub_rows, col_window if col_window else _LANES), jnp.uint32
             ),  # column-tier window (minimal dummy when the tier is off)
-            # Interval + stability state, (parity row, stripe).
+            # Interval state (6) + change-rect state (4), (parity, stripe).
             smem_i32((2, grid)), smem_i32((2, grid)),
             smem_i32((2, grid)), smem_i32((2, grid)),
             smem_i32((2, grid)), smem_i32((2, grid)),
-            smem_i32((2, grid)),
+            smem_i32((2, grid)), smem_i32((2, grid)),
+            smem_i32((2, grid)), smem_i32((2, grid)),
             smem_i32((1,)),  # skip accumulator
             pltpu.SemaphoreType.DMA((3,)),
         ],

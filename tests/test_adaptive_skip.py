@@ -726,3 +726,42 @@ def test_vmem_budget_platform_derivation(monkeypatch):
         assert pp._vmem_budget() == 50 << 20
     finally:
         pp._vmem_physical.cache_clear()
+
+
+class TestRectRoute:
+    """Rectangle-I/O route edge cases (round 5): clusters at stripe
+    boundaries force CLIPPED change-rects (the 8-row chunk write path),
+    and clusters whose window would cross the torus seam must fall back
+    to the classic whole-window path (rect_ok gates on board rows).
+    Shares TestColumnWindow's geometry via its helpers (not subclassing,
+    which would re-run the parent's cases)."""
+
+    HC, WC = TestColumnWindow.HC, TestColumnWindow.WC
+    _run_both = TestColumnWindow._run_both
+    _board = TestColumnWindow._board
+    _glider = staticmethod(TestColumnWindow._glider)
+    _t = TestColumnWindow._t
+
+    def test_cluster_at_stripe_boundary_clips_rect(self):
+        b = self._board()
+        # Stripe boundary at row 1024 (cap 512 -> 512-row stripes... the
+        # cap-512 grid puts boundaries every 512 rows): activity at
+        # 1020-1030 spans one, so each stripe's window clips to its
+        # centre and the chunked write path runs.
+        self._glider(b, 1018, 7000)
+        b[1030:1032, 7010:7012] = 255
+        self._run_both(b, 4 * self._t())
+
+    def test_cluster_near_board_top_falls_back(self):
+        b = self._board()
+        # Window would start above row 0: rect_ok false, classic path.
+        self._glider(b, 2, 9000)
+        b[self.HC - 4 : self.HC - 2, 11000:11002] = 255  # and bottom
+        self._run_both(b, 4 * self._t())
+
+    def test_settledish_multidispatch(self):
+        b = self._board()
+        b[700:702, 8000:8002] = 255  # block (stripe 1)
+        self._glider(b, 1500, 3000)  # glider (stripe 2)
+        for turns in (2 * self._t(), 5 * self._t()):
+            self._run_both(b, turns)
