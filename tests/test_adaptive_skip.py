@@ -765,3 +765,22 @@ class TestRectRoute:
         self._glider(b, 1500, 3000)  # glider (stripe 2)
         for turns in (2 * self._t(), 5 * self._t()):
             self._run_both(b, turns)
+
+
+def test_megakernel_nondefault_depths(monkeypatch):
+    """The megakernel at forced launch depths either side of the shipped
+    _FRONTIER_T: pad/validity margins and the t6 measure depth are all
+    T-derived, so a depth-dependent arithmetic slip (cf. the sharded
+    halo-depth bug the T=18 coincidence masked) must fail here.  Reuses
+    TestColumnWindow's geometry/helpers (the suite this scenario
+    belongs to)."""
+    tc = TestColumnWindow()
+    b = tc._board()
+    tc._glider(b, 700, 8000)
+    b[1500:1502, 2000:2002] = 255
+    for t in (12, 24):
+        monkeypatch.setattr(
+            pallas_packed, "adaptive_launch_depth",
+            lambda s, turns, c, frontier=True, _t=t: (_t, True),
+        )
+        tc._run_both(b, 4 * t)
