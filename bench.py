@@ -198,6 +198,116 @@ def bench_config(
     return gps, gps * size * size
 
 
+def bench_sharded(
+    size: int,
+    mesh_ny: int,
+    reps: int = 5,
+    kturns: int = 1024,
+    burnin: int = 0,
+    skip_stable: bool = True,
+    in_kernel: bool | None = None,
+    target_seconds: float = 0.7,
+) -> dict:
+    """The sharded pallas-packed tier on an (ny, 1) mesh: per-rep rates
+    with {reps, median, spread} — the round-6 artifact row for the
+    in-kernel ICI exchange tier (ISSUE 1).  ``spread`` is (max − min) /
+    median over the timed reps.  Returns the record dict (also logs it)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_gol_tpu.models.life import CONWAY
+    from distributed_gol_tpu.ops import packed
+    from distributed_gol_tpu.parallel import pallas_halo
+    from distributed_gol_tpu.parallel.mesh import make_mesh
+    from distributed_gol_tpu.parallel.packed_halo import packed_sharding
+
+    from distributed_gol_tpu.ops import pallas_packed
+
+    mesh = make_mesh((mesh_ny, 1))
+    strip = (size // mesh_ny, size // 32)
+    use_ici, reason = pallas_halo.ici_tier_policy(
+        mesh,
+        in_kernel=in_kernel,
+        # The strip geometry gates the record too (as Backend does): the
+        # artifact row must never claim a tier the dispatches didn't run.
+        strip=strip,
+        tile_cap=pallas_packed.default_skip_cap(strip[0]),
+    )
+    tier = "ici-megakernel" if use_ici else "ppermute"
+    log(f"  sharded ({mesh_ny},1) tier={tier} ({reason})")
+    board = jnp.asarray(make_board(size))
+    p = packed.pack(board)
+    pb = jax.device_put(np.asarray(p), packed_sharding(mesh))
+    run = pallas_halo.make_superstep(
+        mesh, CONWAY, skip_stable=skip_stable, in_kernel=in_kernel
+    )
+
+    t0 = time.perf_counter()
+    pb = run(pb, kturns)
+    _sync(pb)
+    log(f"  compile+first sharded superstep: {time.perf_counter() - t0:.2f}s")
+
+    def calibrate(pb, label=""):
+        # The growth ladder of bench_config.calibrate_depth: the timed
+        # number must measure the device, not the per-dispatch tunnel.
+        nonlocal kturns
+        for _ in range(3):
+            t0 = time.perf_counter()
+            pb = run(pb, kturns)
+            _sync(pb)
+            dt = time.perf_counter() - t0
+            if dt >= target_seconds / 2:
+                break
+            kturns = min(int(kturns * target_seconds / max(dt, 1e-3)), 1 << 20)
+            log(
+                f"  calibrate sharded{label}: dispatch {dt * 1e3:.0f} ms "
+                f"-> kturns {kturns}"
+            )
+            pb = run(pb, kturns)  # compile + warm the new depth
+            _sync(pb)
+        return pb
+
+    pb = calibrate(pb)
+    if burnin:
+        done = 0
+        t0 = time.perf_counter()
+        while done < burnin:
+            pb = run(pb, kturns)
+            done += kturns
+        _sync(pb)
+        log(f"  sharded burn-in: {done} gens in {time.perf_counter() - t0:.1f}s")
+        if skip_stable:
+            # The adaptive tier is several times faster on the settled
+            # board than on the fresh soup the first ladder timed, so its
+            # dispatches are now too shallow and per-launch overhead
+            # dominates — re-deepen in the regime actually measured (the
+            # same settled re-pass as bench_config; round-2 verdict).
+            pb = calibrate(pb, label="[settled]")
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        pb = run(pb, kturns)
+        _sync(pb)
+        rates.append(kturns / (time.perf_counter() - t0))
+    rates.sort()
+    median = rates[len(rates) // 2]
+    record = {
+        "mesh": [mesh_ny, 1],
+        "size": size,
+        "tier": tier,
+        "tier_policy": reason,
+        "skip_stable": skip_stable,
+        "kturns": kturns,
+        "burnin": burnin,
+        "reps": reps,
+        "median": median,
+        "spread": (rates[-1] - rates[0]) / median if median else None,
+        "rates": rates,
+    }
+    log(f"  sharded record: {json.dumps(record)}")
+    return record
+
+
 def budget_for(size: int) -> float:
     """Wall-clock seconds for one controller-path measurement: must cover
     the fresh jit compile (~20-40 s at 16384² on this rig) plus a usable
@@ -548,6 +658,22 @@ def main():
         action="store_true",
         help="skip the nested config-4 (65536²) settled record",
     )
+    ap.add_argument(
+        "--sharded-mesh",
+        type=int,
+        default=0,
+        metavar="NY",
+        help="also record the sharded pallas-packed tier on an (NY, 1) "
+        "mesh ({reps, median, spread}; the round-6 in-kernel ICI tier "
+        "when policy selects it, ppermute otherwise)",
+    )
+    ap.add_argument(
+        "--force-ppermute",
+        action="store_true",
+        help="force the ppermute strip form for --sharded-mesh (the "
+        "in-kernel tier's documented escape hatch; DGOL_ICI=0 is the "
+        "env spelling)",
+    )
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -612,6 +738,17 @@ def main():
         # settled number is machine-captured every round, not only via
         # tools/bench_65536.py.
         record["config4_65536"] = measure_65536(dev)
+    if args.sharded_mesh:
+        record["sharded"] = bench_sharded(
+            size,
+            args.sharded_mesh,
+            reps=max(args.reps, 5),
+            kturns=args.kturns,
+            burnin=args.burnin
+            or (default_burnin(size) if dev.platform != "cpu" else 0),
+            skip_stable=True,
+            in_kernel=False if args.force_ppermute else None,
+        )
     print(json.dumps(record))
 
 

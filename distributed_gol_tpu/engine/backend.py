@@ -34,6 +34,10 @@ class Backend:
         self.params = params
         self.table = jnp.asarray(params.rule.table)
         self._viewer_fns = {}  # fused per-turn step+count+view dispatches
+        # Sharded pallas-packed exchange tier + the policy that picked it
+        # (None off that engine/mesh); see pallas_halo.ici_tier_policy.
+        self.sharded_tier = None
+        self.sharded_tier_policy = None
         shape = (params.image_height, params.image_width)
         ny, nx = params.mesh_shape
         if params.image_height % ny or params.image_width % nx:
@@ -113,8 +117,13 @@ class Backend:
                 from distributed_gol_tpu.ops import pallas_packed
                 from distributed_gol_tpu.parallel import pallas_halo
 
-                # T-deep halos: one ppermute exchange per launch buys T
-                # generations — the sharded form of temporal blocking.
+                # T-deep halos: one exchange per launch buys T generations
+                # — the sharded form of temporal blocking.  The adaptive
+                # path may run the round-6 IN-KERNEL ICI exchange tier
+                # (whole launch chunks in one pallas_call per device,
+                # remote-DMA halos); when it does not, the ppermute strip
+                # form is a POLICY outcome, recorded here and never warned
+                # about — both tiers are bit-identical.
                 if params.skip_stable_requested():
                     # Live skip telemetry, same contract as single-device:
                     # the per-launch bitmap is summed on device (one
@@ -125,6 +134,23 @@ class Backend:
                             params.image_height // params.mesh_shape[0]
                         )
                     )
+                    # Tier record: mesh policy AND strip-geometry
+                    # capability (the megakernel rides the frontier plan),
+                    # so this cannot claim in-kernel on a strip with no
+                    # plan; it describes deep dispatches (shallow ones run
+                    # the ppermute remainder forms under either tier).
+                    use_ici, reason = pallas_halo.ici_tier_policy(
+                        self.mesh,
+                        strip=(
+                            params.image_height // ny,
+                            params.image_width // 32,
+                        ),
+                        tile_cap=self._skip_cap,
+                    )
+                    self.sharded_tier = (
+                        "ici-megakernel" if use_ici else "ppermute"
+                    )
+                    self.sharded_tier_policy = reason
                     self._skip_fn = pallas_halo.make_superstep_bytes(
                         self.mesh,
                         params.rule,
@@ -135,6 +161,11 @@ class Backend:
                     self._skip_stats = []
                     self._superstep = self._skip_superstep
                 else:
+                    self.sharded_tier = "ppermute"
+                    self.sharded_tier_policy = (
+                        "plain (non-adaptive) path: the in-kernel tier "
+                        "rides the frontier kernel, which needs skip_stable"
+                    )
                     self._superstep = pallas_halo.make_superstep_bytes(
                         self.mesh,
                         params.rule,

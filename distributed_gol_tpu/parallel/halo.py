@@ -32,6 +32,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_gol_tpu.ops.stencil import apply_rule
+from distributed_gol_tpu.utils.compat import axis_size, shard_map
 
 BOARD_SPEC = P("y", "x")
 
@@ -45,8 +46,8 @@ def _shift_perm(axis_size: int, forward: bool) -> list[tuple[int, int]]:
 
 def _exchange_and_extend(local: jax.Array) -> jax.Array:
     """(h, w) block -> (h+2, w+2) block with halo ring from torus neighbours."""
-    ny = lax.axis_size("y")
-    nx = lax.axis_size("x")
+    ny = axis_size("y")
+    nx = axis_size("x")
     # Row halos: my last row is my south neighbour's top halo.
     from_north = lax.ppermute(local[-1:, :], "y", _shift_perm(ny, forward=True))
     from_south = lax.ppermute(local[:1, :], "y", _shift_perm(ny, forward=False))
@@ -79,7 +80,7 @@ def sharded_step(mesh: Mesh):
 
     @jax.jit
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(BOARD_SPEC, P()),
         out_specs=BOARD_SPEC,
@@ -96,7 +97,7 @@ def sharded_superstep(mesh: Mesh):
     @partial(jax.jit, static_argnames=("turns",))
     def run(board, table, turns: int):
         @partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(BOARD_SPEC, P()),
             out_specs=BOARD_SPEC,
@@ -123,7 +124,7 @@ def sharded_steps_with_counts(mesh: Mesh):
     @partial(jax.jit, static_argnames=("turns",))
     def run(board, table, turns: int):
         @partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(BOARD_SPEC, P()),
             out_specs=(BOARD_SPEC, P()),

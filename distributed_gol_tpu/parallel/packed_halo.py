@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_gol_tpu.models.life import LifeRule
+from distributed_gol_tpu.utils.compat import shard_map
 from distributed_gol_tpu.ops.packed import _maj, apply_rule_planes
 from distributed_gol_tpu.parallel.halo import (
     BOARD_SPEC,
@@ -82,7 +83,7 @@ def sharded_superstep(mesh: Mesh, rule: LifeRule):
 
     @partial(jax.jit, static_argnames=("turns",))
     def run(board, turns: int):
-        @partial(jax.shard_map, mesh=mesh, in_specs=BOARD_SPEC, out_specs=BOARD_SPEC)
+        @partial(shard_map, mesh=mesh, in_specs=BOARD_SPEC, out_specs=BOARD_SPEC)
         def inner(local):
             return lax.fori_loop(0, turns, lambda _, b: _local_step(b, rule), local)
 
@@ -96,7 +97,7 @@ def _counting_scan(mesh: Mesh, rule: LifeRule, dtype, turns: int):
     drivers: (packed board) -> (packed board, int[turns] global counts)."""
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=BOARD_SPEC,
         out_specs=(BOARD_SPEC, P()),

@@ -29,11 +29,21 @@ Correctness structure:
 Bit-identity vs the XLA packed halo engine (itself gated against the
 golden oracles) is test-gated on virtual CPU meshes and on hardware via
 ``bench.py --verify``.
+
+Round 6 adds the IN-KERNEL ICI exchange tier for the adaptive frontier
+path: whole launch chunks run as one ``pallas_call`` per device with the
+halo rows and interval state exchanged by ``pltpu.make_async_remote_copy``
+inside the kernel (section marker "in-kernel ICI exchange tier" below) —
+the ppermute strip form above remains the always-correct fallback,
+selected by policy (``ici_tier_policy``) when the in-kernel tier is
+unavailable.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+import os
 from functools import partial
 
 import jax
@@ -52,11 +62,17 @@ from distributed_gol_tpu.ops.pallas_packed import (
     adaptive_launch_depth,
     default_skip_cap,
     _advance_window,
+    _col_compute,
+    _col_placement,
     _compiler_params,
+    _copy_rect,
     _dma_route_out,
     _frontier_body,
+    _frontier_placement,
     _frontier_plan,
     _hit_union,
+    _measure2,
+    _nlaunch_chunks,
     _require_adaptive_eligible,
     _route_active,
     _round8,
@@ -65,6 +81,7 @@ from distributed_gol_tpu.ops.pallas_packed import (
     launch_turns,
 )
 from distributed_gol_tpu.parallel.halo import BOARD_SPEC, _shift_perm
+from distributed_gol_tpu.utils.compat import axis_size, shard_map
 
 
 def supports(pshape: tuple[int, int], mesh_shape: tuple[int, int]) -> bool:
@@ -137,7 +154,9 @@ def _ext_kernel_adaptive(
 
     @pl.when(jnp.logical_not(elide))
     def _():
-        _dma_strip_window_in(local, north, south, tile, i, grid, tile_h, pad, sems)
+        _dma_strip_window_in(
+            local, north.at[:], south.at[:], tile, i, grid, tile_h, pad, sems
+        )
         route, stable = _route_active(tile, aux, merge, tile_h, pad, turns, rule)
         st_ref[i] = stable
         _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sems.at[0])
@@ -145,9 +164,15 @@ def _ext_kernel_adaptive(
 
 def _dma_strip_window_in(local, north, south, tile, i, grid, tile_h, pad, sems):
     """Assemble tile ``i``'s halo-extended window from the device strip
-    and the ppermute'd neighbour boundaries — one home for the adaptive
-    and frontier strip kernels (the sharded counterpart of
-    ``pallas_packed._dma_window_in``)."""
+    and the neighbour boundary sources — one home for the adaptive and
+    frontier strip kernels AND the in-kernel exchange megakernel (the
+    sharded counterpart of ``pallas_packed._dma_window_in``).
+
+    ``north``/``south`` are the (pad, wp) edge-halo SOURCES as sliceable
+    ref handles: the ppermute output buffers (``ref.at[:]``, classic
+    strip kernels) or the exchanged VMEM slot windows
+    (``halo.at[pl.ds(slot * pad, pad), :]``, the in-kernel tier) — the
+    window assembly is otherwise identical, so it must not fork."""
     center = pltpu.make_async_copy(
         local.at[pl.ds(i * tile_h, tile_h), :],
         tile.at[pl.ds(pad, tile_h), :],
@@ -163,7 +188,7 @@ def _dma_strip_window_in(local, north, south, tile, i, grid, tile_h, pad, sems):
     @pl.when(i == 0)
     def _():
         pltpu.make_async_copy(
-            north.at[:], tile.at[pl.ds(0, pad), :], sems.at[1]
+            north, tile.at[pl.ds(0, pad), :], sems.at[1]
         ).start()
 
     @pl.when(i > 0)
@@ -181,7 +206,7 @@ def _dma_strip_window_in(local, north, south, tile, i, grid, tile_h, pad, sems):
     @pl.when(i == grid - 1)
     def _():
         pltpu.make_async_copy(
-            south.at[:], tile.at[pl.ds(pad + tile_h, pad), :], sems.at[2]
+            south, tile.at[pl.ds(pad + tile_h, pad), :], sems.at[2]
         ).start()
 
     @pl.when(i < grid - 1)
@@ -193,10 +218,10 @@ def _dma_strip_window_in(local, north, south, tile, i, grid, tile_h, pad, sems):
         ).start()
 
     pltpu.make_async_copy(
-        north.at[:], tile.at[pl.ds(0, pad), :], sems.at[1]
+        north, tile.at[pl.ds(0, pad), :], sems.at[1]
     ).wait()
     pltpu.make_async_copy(
-        south.at[:], tile.at[pl.ds(pad + tile_h, pad), :], sems.at[2]
+        south, tile.at[pl.ds(pad + tile_h, pad), :], sems.at[2]
     ).wait()
     center.wait()
 
@@ -280,7 +305,9 @@ def _ext_kernel_frontier(
     @pl.when(hit)
     def _():
         st_ref[i] = 0
-        _dma_strip_window_in(local, north, south, tile, i, grid, tile_h, pad, sems)
+        _dma_strip_window_in(
+            local, north.at[:], south.at[:], tile, i, grid, tile_h, pad, sems
+        )
         route, lo0, hi0, lo1, hi1, clo, chi = _frontier_body(
             tile, aux, merge, colwin, sems,
             u_lo, u_hi, u_clo, u_chi,
@@ -293,6 +320,596 @@ def _ext_kernel_frontier(
         nclo[i] = clo
         nchi[i] = chi
         _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sems.at[0])
+
+
+# -- in-kernel ICI exchange tier (round 6) -----------------------------------
+#
+# The ppermute strip form above pays one XLA dispatch per launch: every T
+# generations the program returns to XLA for a `lax.ppermute`, and the
+# measured per-launch dispatch cost (~33 µs, BASELINE.md round 5) caps the
+# settled (1,1)-mesh 16384² run at 397k gens/s while the single-device
+# megakernel does ~1.07M on the same board.  This tier moves the exchange
+# INSIDE the kernel: the whole dispatch chunk is ONE pallas_call per device
+# (grid = (nlaunch, stripes), sequential), and between launches each device
+# ships its `round8(T+6)` boundary rows plus the (6,) frontier-interval
+# state of its edge stripes to its mesh neighbours with
+# ``pltpu.make_async_remote_copy`` (``DeviceIdType.MESH``) — send/recv DMA
+# semaphores, ping-pong (launch-parity) halo slots, and one barrier
+# rendezvous before the first remote write.
+#
+# Exchange protocol, per launch l (prologue at grid step (l, 0)):
+#
+#   1. l == 0 (remote build): neighbour barrier — both neighbours must have
+#      entered this kernel before our first message lands in their scratch.
+#      l > 0: wait the previous launch's 4 sends — launch l writes the
+#      buffer launch l−1 read, i.e. the buffer those sends sourced.
+#   2. Start 4 sends from the read buffer (it holds S_l everywhere) and
+#      the state slabs published at launch l−1: board-top→north's south
+#      halo, board-bottom→south's north halo, top-stripe state→north,
+#      bottom-stripe state→south.  All land in the receiver's slot l%2.
+#   3. Wait the 4 matching recvs before any stripe reads a halo/slab.
+#
+# Slot-reuse soundness (slot p = l%2, reused at l+2): my reads of slot p
+# during launch l happen before my prologue l+1 sends (sequential grid);
+# the neighbour's launch-l+1 compute waits on those sends arriving; its
+# l+2 send — the next writer of my slot p — comes after that compute.  So
+# every write of slot p happens-after the previous read of slot p, with
+# the recv-semaphore signal as the cross-device edge.  Devices stay within
+# one launch of each other at the exchange points (each prologue waits for
+# the neighbour's same-launch message), and each (direction, kind) channel
+# has at most one outstanding message because a sender waits its own send
+# semaphore before the next same-channel send.
+#
+# ny == 1 (the (1,1) mesh — the strip IS the torus) runs the SAME kernel
+# built with plain ``make_async_copy`` loopback transfers: the torus wrap
+# halo is the device's own opposite edge, so the exchange degenerates to
+# local copies through the same slot buffers, and the whole launch
+# sequencing/state protocol runs hermetically in interpret mode.  Only the
+# literal remote-DMA lowering is hardware-only; `tools/hw_compile_gate.py`
+# AOT-compiles those geometries on the attached chip.
+#
+# The interval state crosses the wire as an (8, 128) int32 SLAB per edge
+# stripe (row k = scalar k broadcast over lanes): Mosaic has no scalar
+# VMEM stores and no SMEM remote DMA contract, but vector fills, sublane-
+# aligned slab DMAs, and per-row max-reductions all lower everywhere.
+
+_STATE_SLAB = 8  # slab rows: 6 interval scalars + padding to the 8-row tile
+
+
+def _encode_state6(vals):
+    """Six int32 scalars -> (8, 128) int32 slab, row k = scalar k broadcast
+    across lanes — the remote-DMA-able form of a stripe's interval state."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (_STATE_SLAB, _LANES), 0)
+    out = jnp.zeros((_STATE_SLAB, _LANES), jnp.int32)
+    for k, v in enumerate(vals):
+        out = jnp.where(rows == k, v, out)
+    return out
+
+
+def _decode_state6(slab):
+    """(8, 128) int32 slab -> six scalars.  Every lane of a row holds the
+    same value, so a per-row max-reduction recovers it exactly — the one
+    vector→scalar path Mosaic lowers on every generation."""
+    return [jnp.max(slab[k : k + 1, :]) for k in range(6)]
+
+
+def _kernel_frontier_mega_strip(
+    ids_ref, xa, xb, oa, ob, sk_ref,
+    tile, aux, merge, colwin,
+    nhalo, shalo, tstate, bstate, nstate, sstate,
+    ilo0, ihi0, ilo1, ihi1, iclo, ichi,
+    rr8, rn8, rc128, rn128,
+    acc, sems, xsems,
+    *, tile_h, pad, grid, nlaunch, turns, rule, sub_rows, col_window, remote,
+):
+    """The sharded strip dispatch as ONE kernel — the strip-shaped form of
+    ``pallas_packed._kernel_frontier_mega`` whose between-launch halo and
+    interval-state exchange runs INSIDE the kernel (protocol at the top of
+    this section).  ``ids_ref`` (SMEM int32[3]) carries the mesh
+    coordinates of the north/south neighbours plus this device's x coord
+    — computed by the shard_map wrapper so the kernel also AOT-compiles
+    standalone (the hardware compile gate's requirement).  ``remote``
+    selects real ``make_async_remote_copy`` exchange (ny > 1 on ICI) vs
+    loopback ``make_async_copy`` through the same slot buffers (ny == 1 —
+    the torus self-exchange, which is also the hermetic interpret-mode
+    form).  Everything else — ping-pong aliased HBM boards, SMEM interval
+    and change-rect state by launch parity, rectangle/classic/skip
+    routing — is the single-device megakernel's protocol verbatim."""
+    del xa, xb  # same memory as oa/ob (aliased); contents ARE the boards
+    l = pl.program_id(0)
+    i = pl.program_id(1)
+    t6 = turns + _SKIP_PERIOD
+    h_loc = grid * tile_h
+    w_lo = i * tile_h - pad
+    w_hi = (i + 1) * tile_h + pad - 1
+    c_lo = i * tile_h
+    c_hi = (i + 1) * tile_h - 1
+    wp = tile.shape[1]
+    wr = jax.lax.rem(l, 2)
+    rd = 1 - wr
+    even = wr == 0
+    first = l == 0
+    slot = wr  # exchange-slot parity of this launch
+
+    @pl.when(first & (i == 0))
+    def _():
+        acc[0] = 0
+
+    def mk_exchange(rd_board, k):
+        """Transfer k of the launch's exchange: 0 board-up, 1 board-down,
+        2 state-up, 3 state-down.  'Up' ships my top edge to the north
+        neighbour (arriving as ITS south halo / south state slab)."""
+        srcs = (
+            rd_board.at[pl.ds(0, pad), :],
+            rd_board.at[pl.ds(h_loc - pad, pad), :],
+            tstate.at[pl.ds(rd * _STATE_SLAB, _STATE_SLAB), :],
+            bstate.at[pl.ds(rd * _STATE_SLAB, _STATE_SLAB), :],
+        )
+        dsts = (
+            shalo.at[pl.ds(slot * pad, pad), :],
+            nhalo.at[pl.ds(slot * pad, pad), :],
+            sstate.at[pl.ds(slot * _STATE_SLAB, _STATE_SLAB), :],
+            nstate.at[pl.ds(slot * _STATE_SLAB, _STATE_SLAB), :],
+        )
+        if not remote:
+            return pltpu.make_async_copy(srcs[k], dsts[k], xsems.at[k])
+        return pltpu.make_async_remote_copy(
+            src_ref=srcs[k],
+            dst_ref=dsts[k],
+            send_sem=xsems.at[k],
+            recv_sem=xsems.at[4 + k],
+            device_id=(ids_ref[k % 2], ids_ref[2]),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+
+    def prologue(rd_board):
+        if remote:
+            @pl.when(first)
+            def _():
+                # Rendezvous with both neighbours before the first remote
+                # write lands in their scratch (see protocol notes).
+                bar = pltpu.get_barrier_semaphore()
+                for k in (0, 1):
+                    pltpu.semaphore_signal(
+                        bar,
+                        inc=1,
+                        device_id=(ids_ref[k], ids_ref[2]),
+                        device_id_type=pltpu.DeviceIdType.MESH,
+                    )
+                pltpu.semaphore_wait(bar, 2)
+
+            @pl.when(jnp.logical_not(first))
+            def _():
+                # Launch l overwrites the buffer launch l−1's sends read.
+                for k in range(4):
+                    mk_exchange(rd_board, k).wait_send()
+
+            for k in range(4):
+                mk_exchange(rd_board, k).start()
+            for k in range(4):
+                mk_exchange(rd_board, k).wait_recv()
+        else:
+            # Loopback (ny == 1): the torus halo is this device's own
+            # opposite edge; same slots, plain copies, waited in place.
+            ops = [mk_exchange(rd_board, k) for k in range(4)]
+            for op in ops:
+                op.start()
+            for op in ops:
+                op.wait()
+
+    @pl.when(i == 0)
+    def _():
+        @pl.when(even)
+        def _():
+            prologue(oa)
+
+        @pl.when(jnp.logical_not(even))
+        def _():
+            prologue(ob)
+
+    # Neighbour interval sources: interior stripes read the previous
+    # launch's SMEM state rows; edge stripes decode the exchanged slabs,
+    # translated into this strip's frame (the north neighbour's strip row
+    # r is this strip's row r − h_loc, south +h_loc; empty intervals
+    # survive translation — lo > hi is offset-invariant; column entries
+    # are board-global words and ship unshifted).
+    n_dec = _decode_state6(nstate[pl.ds(slot * _STATE_SLAB, _STATE_SLAB), :])
+    s_dec = _decode_state6(sstate[pl.ds(slot * _STATE_SLAB, _STATE_SLAB), :])
+    edge_n = i == 0
+    edge_s = i == grid - 1
+    iprev = jnp.maximum(i - 1, 0)  # clamped: the edge case reads the slab
+    inext = jnp.minimum(i + 1, grid - 1)
+
+    def north(ref, k):
+        return jnp.where(edge_n, n_dec[k] - h_loc, ref[rd, iprev])
+
+    def south(ref, k):
+        return jnp.where(edge_s, s_dec[k] + h_loc, ref[rd, inext])
+
+    ivals = [
+        (north(ilo0, 0), north(ihi0, 1)),
+        (north(ilo1, 2), north(ihi1, 3)),
+        (ilo0[rd, i], ihi0[rd, i]),
+        (ilo1[rd, i], ihi1[rd, i]),
+        (south(ilo0, 0), south(ihi0, 1)),
+        (south(ilo1, 2), south(ihi1, 3)),
+    ]
+    cvals = [
+        (jnp.where(edge_n, n_dec[4], iclo[rd, iprev]),
+         jnp.where(edge_n, n_dec[5], ichi[rd, iprev])),
+        (iclo[rd, i], ichi[rd, i]),
+        (jnp.where(edge_s, s_dec[4], iclo[rd, inext]),
+         jnp.where(edge_s, s_dec[5], ichi[rd, inext])),
+    ]
+    hit, u_lo, u_hi, u_clo, u_chi = _hit_union(
+        ivals, cvals, w_lo, w_hi, c_lo, c_hi, t6
+    )
+    # Launch 0 of a chunk: no tracked state yet — force the full union
+    # (the megakernel's probe-everything launch; exact intervals are
+    # measured for launch 1 on).
+    hit = hit | first
+    u_lo = jnp.where(first, c_lo - t6, u_lo)
+    u_hi = jnp.where(first, c_hi + t6, u_hi)
+    p_r8 = rr8[rd, i]
+    p_n8 = rn8[rd, i]
+    p_c128 = rc128[rd, i]
+    p_n128 = rn128[rd, i]
+
+    def put_state(lo0, hi0, lo1, hi1, clo, chi, r8, n8, c128, n128):
+        ilo0[wr, i] = lo0
+        ihi0[wr, i] = hi0
+        ilo1[wr, i] = lo1
+        ihi1[wr, i] = hi1
+        iclo[wr, i] = clo
+        ichi[wr, i] = chi
+        rr8[wr, i] = r8
+        rn8[wr, i] = n8
+        rc128[wr, i] = c128
+        rn128[wr, i] = n128
+        # Edge stripes also publish the slab the next launch's exchange
+        # ships to the neighbours (both slabs on a one-stripe strip).
+        vec = _encode_state6((lo0, hi0, lo1, hi1, clo, chi))
+
+        @pl.when(edge_n)
+        def _():
+            tstate[pl.ds(wr * _STATE_SLAB, _STATE_SLAB), :] = vec
+
+        @pl.when(edge_s)
+        def _():
+            bstate[pl.ds(wr * _STATE_SLAB, _STATE_SLAB), :] = vec
+
+    def copy_rect(src, dst, r8, n8, c128, n128):
+        _copy_rect(
+            src, dst, tile, sems.at[0], r8, n8, c128, n128,
+            tile_h=tile_h, wp=wp, sub_rows=sub_rows, col_window=col_window,
+        )
+
+    @pl.when(jnp.logical_not(hit))
+    def _():
+        put_state(_EMPTY_LO, -1, _EMPTY_LO, -1, _EMPTY_LO, -1, 0, 0, 0, 0)
+        acc[0] = acc[0] + 1
+
+        @pl.when(p_n8 > 0)
+        def _():
+            # Skipped, but the previous launch changed something: copy
+            # S_{l−1} (== S_l on a skipped stripe) across the ping-pong.
+            @pl.when(even)
+            def _():
+                copy_rect(oa, ob, p_r8, p_n8, p_c128, p_n128)
+
+            @pl.when(jnp.logical_not(even))
+            def _():
+                copy_rect(ob, oa, p_r8, p_n8, p_c128, p_n128)
+
+    win_lo, m_lo, m_hi, windowed_ok = _frontier_placement(
+        u_lo, u_hi, i, tile_h, pad, turns, sub_rows
+    )
+    # Window top in strip rows, kept in 8-row chunk units so Mosaic's
+    # divisibility proof survives (the recorded round-4 rule).
+    g8 = i * (tile_h // 8) - pad // 8 + win_lo // 8
+    g_lo = g8 * 8
+    if col_window is not None:
+        win_c, c_ok, cw = _col_placement(u_clo, u_chi, turns, col_window, wp)
+        # The rectangle route must stay inside the LOCAL strip: an edge
+        # window reaching into the halo takes the classic route, whose
+        # assembled window carries the exchanged rows.
+        rect_ok = (
+            hit
+            & windowed_ok
+            & c_ok
+            & (g_lo >= 0)
+            & (g_lo + sub_rows <= h_loc)
+        )
+    else:
+        rect_ok = jnp.bool_(False)
+
+    if col_window is not None:
+        @pl.when(rect_ok)
+        def _():
+            @pl.when(even)
+            def _():
+                c = pltpu.make_async_copy(
+                    oa.at[pl.ds(g_lo, sub_rows), pl.ds(win_c, col_window)],
+                    colwin.at[:],
+                    sems.at[0],
+                )
+                c.start()
+                c.wait()
+
+            @pl.when(jnp.logical_not(even))
+            def _():
+                c = pltpu.make_async_copy(
+                    ob.at[pl.ds(g_lo, sub_rows), pl.ds(win_c, col_window)],
+                    colwin.at[:],
+                    sems.at[0],
+                )
+                c.start()
+                c.wait()
+
+            gT, g6, merged = _col_compute(
+                colwin[:], turns, rule, cw, col_window, sub_rows
+            )
+            colwin[:] = merged
+            lo0, hi0, lo1, hi1, clo, chi = _measure2(
+                gT, g6, win_lo, m_lo, m_hi, w_lo,
+                col_off=win_c, col_valid=(cw, col_window - cw),
+            )
+            r8 = jnp.maximum(g_lo, c_lo) // 8
+            n8 = jnp.minimum(g_lo + sub_rows, c_lo + tile_h) // 8 - r8
+            put_state(
+                lo0, hi0, lo1, hi1, clo, chi,
+                r8, n8, win_c // 128, col_window // 128,
+            )
+
+            def write_out(src_board, dst):
+                @pl.when(p_n8 > 0)
+                def _():
+                    copy_rect(src_board, dst, p_r8, p_n8, p_c128, p_n128)
+
+                full_span = n8 == sub_rows // 8
+
+                @pl.when(full_span)
+                def _():
+                    c = pltpu.make_async_copy(
+                        colwin.at[:],
+                        dst.at[
+                            pl.ds(g_lo, sub_rows), pl.ds(win_c, col_window)
+                        ],
+                        sems.at[0],
+                    )
+                    c.start()
+                    c.wait()
+
+                @pl.when(jnp.logical_not(full_span))
+                def _():
+                    def chunk(kk, _):
+                        c = pltpu.make_async_copy(
+                            colwin.at[pl.ds((r8 + kk - g8) * 8, 8), :],
+                            dst.at[
+                                pl.ds((r8 + kk) * 8, 8),
+                                pl.ds(win_c, col_window),
+                            ],
+                            sems.at[0],
+                        )
+                        c.start()
+                        c.wait()
+                        return 0
+
+                    jax.lax.fori_loop(0, n8, chunk, 0)
+
+            @pl.when(even)
+            def _():
+                write_out(oa, ob)
+
+            @pl.when(jnp.logical_not(even))
+            def _():
+                write_out(ob, oa)
+
+    @pl.when(hit & jnp.logical_not(rect_ok))
+    def _():
+        # Edge-halo sources are the exchanged slot windows; the window
+        # assembly itself is the classic strip kernels' (shared helper).
+        n_src = nhalo.at[pl.ds(slot * pad, pad), :]
+        s_src = shalo.at[pl.ds(slot * pad, pad), :]
+
+        @pl.when(even)
+        def _():
+            _dma_strip_window_in(
+                oa, n_src, s_src, tile, i, grid, tile_h, pad, sems
+            )
+
+        @pl.when(jnp.logical_not(even))
+        def _():
+            _dma_strip_window_in(
+                ob, n_src, s_src, tile, i, grid, tile_h, pad, sems
+            )
+
+        route, lo0, hi0, lo1, hi1, clo, chi = _frontier_body(
+            tile, aux, merge, colwin, sems,
+            u_lo, u_hi, u_clo, u_chi,
+            i, tile_h, pad, turns, rule, sub_rows, None,
+        )
+        put_state(
+            lo0, hi0, lo1, hi1, clo, chi,
+            c_lo // 8, tile_h // 8, 0, wp // 128,
+        )
+
+        @pl.when(even)
+        def _():
+            _dma_route_out(route, tile, merge, aux, ob, i, tile_h, pad, sems.at[0])
+
+        @pl.when(jnp.logical_not(even))
+        def _():
+            _dma_route_out(route, tile, merge, aux, oa, i, tile_h, pad, sems.at[0])
+
+    @pl.when((l == nlaunch - 1) & (i == grid - 1))
+    def _():
+        sk_ref[0] = acc[0]
+        if remote:
+            # The final launch's sends source the read buffer; they must
+            # clear before the kernel (and the buffer's XLA lifetime) ends.
+            @pl.when(even)
+            def _():
+                for k in range(4):
+                    mk_exchange(oa, k).wait_send()
+
+            @pl.when(jnp.logical_not(even))
+            def _():
+                for k in range(4):
+                    mk_exchange(ob, k).wait_send()
+
+
+@functools.lru_cache(maxsize=12)
+def _build_dispatch_frontier_strip(
+    strip: tuple[int, int],
+    rule: LifeRule,
+    turns: int,
+    nlaunch: int,
+    interpret: bool,
+    tile_cap: int | None,
+    remote: bool,
+):
+    """The in-kernel-exchange strip megakernel as ``(ids, board,
+    scratch_board) -> (board_a, board_b, skipped)`` — ``nlaunch`` launches
+    of ``turns`` generations in ONE pallas_call per device, halos and
+    interval state exchanged inside (``_kernel_frontier_mega_strip``).
+    ``ids`` is int32[3]: north neighbour y, south neighbour y, own x mesh
+    coordinate (ignored by the ``remote=False`` loopback build).  Board
+    args alias the first two outputs (ping-pong pair); the final state is
+    output ``nlaunch % 2``.  Callers pass only ``_NLAUNCH_CANON`` values
+    for ``nlaunch`` (the bounded-compile-cache contract of
+    ``_nlaunch_chunks``)."""
+    h_loc, wp = strip
+    _require_adaptive_eligible(turns)
+    plan = _frontier_plan(strip, turns, tile_cap)
+    if plan is None:
+        raise ValueError(f"no frontier plan for {turns} turns on strip {strip}")
+    pad, sub_rows, col_window = plan
+    tile_h = _strip_plan_tile(strip, turns, tile_cap)
+    grid = h_loc // tile_h
+    kernel = partial(
+        _kernel_frontier_mega_strip,
+        tile_h=tile_h,
+        pad=pad,
+        grid=grid,
+        nlaunch=nlaunch,
+        turns=turns,
+        rule=rule,
+        sub_rows=sub_rows,
+        col_window=col_window,
+        remote=remote,
+    )
+    smem_i32 = lambda shp: pltpu.SMEM(shp, jnp.int32)  # noqa: E731
+    params = _compiler_params(tile_h, pad, wp, True, sequential_grid=True)
+    if remote:
+        # The neighbour barrier uses the global barrier semaphore, which
+        # Mosaic only allocates for kernels carrying a collective_id.
+        params = dataclasses.replace(params, collective_id=7)
+    return pl.pallas_call(
+        kernel,
+        grid=(nlaunch, grid),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h_loc, wp), jnp.uint32),
+            jax.ShapeDtypeStruct((h_loc, wp), jnp.uint32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ],
+        input_output_aliases={1: 0, 2: 1},
+        scratch_shapes=[
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # full buffer
+            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),  # merge buffer
+            pltpu.VMEM(
+                (sub_rows, col_window if col_window else _LANES), jnp.uint32
+            ),  # column-tier window (minimal dummy when the tier is off)
+            # Exchange slots: ping-pong (launch-parity) halo rows + the
+            # four interval-state slabs (published top/bottom, received
+            # north/south).
+            pltpu.VMEM((2 * pad, wp), jnp.uint32),  # nhalo
+            pltpu.VMEM((2 * pad, wp), jnp.uint32),  # shalo
+            pltpu.VMEM((2 * _STATE_SLAB, _LANES), jnp.int32),  # tstate
+            pltpu.VMEM((2 * _STATE_SLAB, _LANES), jnp.int32),  # bstate
+            pltpu.VMEM((2 * _STATE_SLAB, _LANES), jnp.int32),  # nstate
+            pltpu.VMEM((2 * _STATE_SLAB, _LANES), jnp.int32),  # sstate
+            # Interval state (6) + change-rect state (4), (parity, stripe).
+            smem_i32((2, grid)), smem_i32((2, grid)),
+            smem_i32((2, grid)), smem_i32((2, grid)),
+            smem_i32((2, grid)), smem_i32((2, grid)),
+            smem_i32((2, grid)), smem_i32((2, grid)),
+            smem_i32((2, grid)), smem_i32((2, grid)),
+            smem_i32((1,)),  # skip accumulator
+            pltpu.SemaphoreType.DMA((3,)),
+            pltpu.SemaphoreType.DMA((8,)),  # exchange: 4 send + 4 recv
+        ],
+        compiler_params=params,
+        interpret=interpret,
+    )
+
+
+def ici_tier_policy(
+    mesh: Mesh,
+    interpret: bool | None = None,
+    in_kernel: bool | None = None,
+    strip: tuple[int, int] | None = None,
+    tile_cap: int | None = None,
+) -> tuple[bool, str]:
+    """Whether the sharded adaptive path runs the in-kernel ICI exchange
+    tier, with the POLICY reason when it does not.  A False here is a
+    deliberate policy outcome — recorded by the Backend
+    (``sharded_tier_policy``) and printed by ``dryrun_multichip`` — NOT a
+    capability downgrade: the ppermute strip form is bit-identical and
+    remains the always-correct fallback, so no warning is ever emitted.
+
+    ``in_kernel``: ``False`` forces the ppermute form (the documented
+    escape hatch; env ``DGOL_ICI=0`` is the CLI-reachable spelling);
+    ``True`` overrides the env switch but never capability — a mesh the
+    tier cannot serve still falls back, with the reason recorded.
+
+    ``strip`` (the per-device (h_loc, wp) packed strip, with
+    ``tile_cap``): also checks the GEOMETRY can host the tier — the
+    megakernel rides the frontier plan, probed here at the deep-dispatch
+    depth (the hw-gate convention), so a Backend's recorded tier cannot
+    claim in-kernel on a strip that has no plan.  A True verdict still
+    describes deep dispatches only: a dispatch too shallow for even one
+    adaptive launch runs the ppermute remainder forms regardless of
+    tier."""
+    ip = _use_interpret() if interpret is None else interpret
+    ny = mesh.shape["y"]
+    if in_kernel is False:
+        return False, "forced-ppermute (in_kernel=False)"
+    if strip is not None:
+        _, _, adaptive, fplan = _adaptive_strip_plan(strip, 10**6, tile_cap)
+        if not adaptive or fplan is None:
+            return False, (
+                f"no frontier plan for strip {strip}: the in-kernel tier "
+                "rides the frontier megakernel (ppermute probing/plain "
+                "forms run instead)"
+            )
+    if in_kernel is not True and os.environ.get("DGOL_ICI", "").lower() in (
+        "0", "off", "false",
+    ):
+        return False, "forced-ppermute (DGOL_ICI=0)"
+    if ip and ny > 1:
+        return False, (
+            "interpret-mode multi-device: no remote-DMA emulation "
+            "(hermetic coverage runs the ny==1 loopback build; hardware "
+            "lowering is gated by tools/hw_compile_gate.py)"
+        )
+    if ny > 1 and len({d.process_index for d in mesh.devices.flat}) > 1:
+        return False, (
+            "multi-host mesh: the exchange crosses DCN, remote DMA is "
+            "ICI-only (parallel/multihost.py keeps the ppermute form)"
+        )
+    return True, "in-kernel"
 
 
 def _adaptive_strip_plan(
@@ -573,7 +1190,7 @@ def halo_bytes_2d_model(
 def _extend_rows(local: jax.Array, pad: int) -> jax.Array:
     """(h_loc, wp) strip -> (h_loc + 2·pad, wp) with pad boundary rows from
     the ring neighbours (self-send on a 1-sized axis = the torus wrap)."""
-    ny = lax.axis_size("y")
+    ny = axis_size("y")
     from_north = lax.ppermute(local[-pad:, :], "y", _shift_perm(ny, forward=True))
     from_south = lax.ppermute(local[:pad, :], "y", _shift_perm(ny, forward=False))
     return jnp.concatenate([from_north, local, from_south], axis=0)
@@ -613,10 +1230,17 @@ def make_superstep(
     skip_stable: bool = False,
     skip_tile_cap: int | None = None,
     with_stats: bool = False,
+    in_kernel: bool | None = None,
 ):
     """``(packed, turns) -> packed`` on the mesh: turns split into launches
     of T = ``launch_turns(strip, turns)`` generations; each launch is one
-    ppermute halo exchange + one pallas_call per device.
+    ppermute halo exchange + one pallas_call per device — except on the
+    adaptive frontier path, where ``ici_tier_policy`` may select the
+    round-6 IN-KERNEL exchange tier: whole canonical launch chunks run as
+    ONE pallas_call per device with the halo rows and interval state
+    exchanged by remote DMA inside the kernel
+    (``_kernel_frontier_mega_strip``).  ``in_kernel`` forces the tier
+    (``False`` = always ppermute; ``None`` = policy).
 
     ``skip_stable``: the exact period-6 activity skip of the single-device
     kernel, per strip tile, INCLUDING its frontier-aware probe elision
@@ -670,7 +1294,7 @@ def make_superstep(
                 )
 
                 @partial(
-                    jax.shard_map,
+                    shard_map,
                     mesh=mesh,
                     in_specs=BOARD_SPEC,
                     out_specs=BOARD_SPEC,
@@ -684,7 +1308,7 @@ def make_superstep(
             call = _build_ext_launch_adaptive(strip, rule, tt, ip, cap)
 
             @partial(
-                jax.shard_map,
+                shard_map,
                 mesh=mesh,
                 in_specs=(P("y"), BOARD_SPEC, BOARD_SPEC),
                 out_specs=(BOARD_SPEC, P("y")),
@@ -724,7 +1348,7 @@ def make_superstep(
             h_loc = strip[0]
 
             @partial(
-                jax.shard_map,
+                shard_map,
                 mesh=mesh,
                 in_specs=(P("y"),) * 7 + (BOARD_SPEC, BOARD_SPEC),
                 out_specs=(BOARD_SPEC,) + (P("y"),) * 7,
@@ -766,11 +1390,72 @@ def make_superstep(
 
             return step
 
+        def make_dispatch_ici(tt: int, nl: int):
+            # One in-kernel-exchange chunk: nl launches in one pallas_call
+            # per device.  ny == 1 builds the loopback form (the torus
+            # self-exchange — also the hermetic interpret-mode build).
+            call = _build_dispatch_frontier_strip(
+                strip, rule, tt, nl, ip, cap, ny > 1
+            )
+
+            @partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=(BOARD_SPEC, BOARD_SPEC),
+                out_specs=(BOARD_SPEC, BOARD_SPEC, P("y")),
+                check_vma=False,
+            )
+            def step(local, prev):
+                my = lax.axis_index("y")
+                ids = jnp.stack(
+                    [
+                        lax.rem(my + ny - 1, ny),
+                        lax.rem(my + 1, ny),
+                        lax.axis_index("x"),
+                    ]
+                ).astype(jnp.int32)
+                return call(ids, local, prev)
+
+            return step
+
         # The helper's flag IS the decision (same-plan contract); only the
         # non-skip path, which never consulted the helper, derives none.
         adaptive_t = skip_stable and t_adaptive
         skipped = jnp.int32(0)
-        if adaptive_t and full and fplan is not None:
+        # use_ici already conjoins the adaptive/frontier-plan capability
+        # with the mesh policy; the dispatch branch below only adds the
+        # "at least one full launch" requirement.
+        use_ici = (
+            adaptive_t
+            and fplan is not None
+            and ici_tier_policy(mesh, ip, in_kernel)[0]
+        )
+        if full and use_ici:
+            # In-kernel ICI exchange tier (round 6): the dispatch runs as
+            # canonical launch chunks (the bounded-compile-cache contract
+            # shared with pallas_packed._run_tiled), each chunk one
+            # pallas_call per device with halos + interval state exchanged
+            # inside the kernel; the sub-chunk tail runs the per-launch
+            # probing ppermute form, mirroring the single-device loose
+            # tail.
+            tile_h = _strip_plan_tile(strip, t, cap)
+            grid = strip[0] // tile_h
+            chunks, loose = _nlaunch_chunks(full)
+            a = jnp.zeros_like(board)
+            for c in chunks:
+                step_c = make_dispatch_ici(t, c)
+                na, nb, sk = step_c(board, a)
+                board, a = (nb, na) if c % 2 else (na, nb)
+                skipped = skipped + jnp.sum(sk)
+            if loose:
+                step_l = make_step(t, adaptive_ok=True)
+                st = jnp.zeros((ny * grid,), jnp.int32)
+                prev = a
+                for _ in range(loose):
+                    nb, nst = step_l(st, board, prev)
+                    board, prev, st = nb, board, nst
+                    skipped = skipped + jnp.sum(nst)
+        elif adaptive_t and full and fplan is not None:
             # Frontier strip kernel (round 5): tracked intervals replace
             # the probe + bitmap; state is carried across launches in the
             # XLA loop and exchanged at strip edges with the halo rows.
@@ -864,15 +1549,16 @@ def make_superstep_bytes(
     skip_stable: bool = False,
     skip_tile_cap: int | None = None,
     with_stats: bool = False,
+    in_kernel: bool | None = None,
 ):
     """``(board_u8, turns) -> board_u8`` engine-layer drop-in: pack/unpack
     inside the jit, pinned to the mesh sharding so packing stays local.
-    ``with_stats`` mirrors :func:`make_superstep`."""
+    ``with_stats`` / ``in_kernel`` mirror :func:`make_superstep`."""
     from distributed_gol_tpu.ops.packed import pack, unpack
     from distributed_gol_tpu.parallel.packed_halo import packed_sharding
 
     inner = make_superstep(
-        mesh, rule, interpret, skip_stable, skip_tile_cap, with_stats
+        mesh, rule, interpret, skip_stable, skip_tile_cap, with_stats, in_kernel
     )
 
     @partial(jax.jit, static_argnames=("turns",))

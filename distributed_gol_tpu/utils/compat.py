@@ -1,0 +1,44 @@
+"""JAX API compatibility: one home for names that moved across releases.
+
+The package targets the current JAX API (``jax.shard_map`` with
+``check_vma``, ``pltpu.CompilerParams``); older releases still in the
+supported floor ship the same functionality under the pre-rename names
+(``jax.experimental.shard_map.shard_map`` with ``check_rep``,
+``pltpu.TPUCompilerParams``).  Every call site imports from here so the
+version probe happens once, not per module.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+# pltpu.CompilerParams (current) was pltpu.TPUCompilerParams before the
+# Pallas TPU params rename; the fields used here (vmem_limit_bytes,
+# dimension_semantics, collective_id) exist under both names.
+CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+# lax.axis_size (current) did not exist before the shard_map graduation;
+# psum of a constant 1 over the axis is the same static value there.
+axis_size = getattr(jax.lax, "axis_size", None) or (
+    lambda name: jax.lax.psum(1, name)
+)
+
+_new_shard_map = getattr(jax, "shard_map", None)
+
+if _new_shard_map is not None:
+    shard_map = _new_shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    @functools.wraps(_old_shard_map)
+    def shard_map(f=None, /, **kwargs):
+        """``jax.shard_map`` signature on the pre-graduation API: the
+        replication checker kwarg was called ``check_rep`` there."""
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if f is None:
+            return functools.partial(shard_map, **kwargs)
+        return _old_shard_map(f, **kwargs)

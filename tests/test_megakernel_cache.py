@@ -1,0 +1,108 @@
+"""Megakernel compile-cache discipline + platform-table loudness.
+
+Round-6 satellites: the frontier megakernel's launch count used to be a
+raw ``lru_cache`` key, so the controller's doubling dispatch calibration
+compiled a fresh ~10 s Mosaic kernel per depth and the cache grew without
+bound; dispatches now decompose into canonical chunk lengths
+(``_NLAUNCH_CANON``).  And a TPU generation missing from the VMEM table
+must say so once instead of silently running the v5e-tuned plan.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_gol_tpu.models.life import CONWAY
+from distributed_gol_tpu.ops import packed, pallas_packed as pp
+
+
+class TestNlaunchChunks:
+    def test_exact_cover_and_canonical_membership(self):
+        for full in list(range(0, 70)) + [127, 512, 513, 2900, 10_000]:
+            chunks, loose = pp._nlaunch_chunks(full)
+            assert sum(chunks) + loose == full
+            assert set(chunks) <= set(pp._NLAUNCH_CANON)
+            assert 0 <= loose < min(pp._NLAUNCH_CANON)
+
+    def test_doubling_sequence_bounded_compiles(self):
+        # The controller's calibration shape: dispatch depth doubling from
+        # 1 launch to 4096.  However far it grows, the megakernel compile
+        # set stays within the canonical sizes (<= 3 distinct).
+        seen = set()
+        for k in range(13):  # 1, 2, 4, ..., 4096
+            chunks, loose = pp._nlaunch_chunks(1 << k)
+            seen.update(chunks)
+        assert len(seen) <= 3
+        assert seen <= set(pp._NLAUNCH_CANON)
+
+    def test_chunks_are_even(self):
+        # Even chunk lengths keep each chunk's final board in output a —
+        # the buffer-threading invariant the dispatch loops lean on.
+        assert all(c % 2 == 0 for c in pp._NLAUNCH_CANON)
+
+    @pytest.mark.slow
+    def test_dispatch_ladder_compiles_at_most_three_megakernels(self):
+        """An adaptive/doubling dispatch sequence (the calibration ladder)
+        hits ≤ 3 distinct megakernel compiles — measured at the cache, on
+        real dispatches of the single-device engine, with bit-identity
+        against the XLA packed engine as the side oracle."""
+        shape = (512, 128)  # (H, wp): hosts a frontier plan at T=18
+        t, adaptive = pp.adaptive_launch_depth(shape, 10**6, 1024)
+        assert adaptive and pp._frontier_plan(shape, t, 1024) is not None
+        rng = np.random.default_rng(5)
+        board = np.zeros((512, 4096), dtype=np.uint8)
+        board[40:44, 100:140] = np.where(
+            rng.random((4, 40)) < 0.5, 255, 0
+        )
+        p = packed.pack(jnp.asarray(board))
+        run = pp.make_superstep(CONWAY, skip_stable=True)
+        before = pp._build_dispatch_frontier.cache_info()
+        state = np.asarray(p)
+        total = 0
+        for k in range(7):  # full = 1, 2, 4, ..., 64 launches
+            turns = t * (1 << k)
+            state = np.asarray(run(jnp.asarray(state), turns))
+            total += turns
+        after = pp._build_dispatch_frontier.cache_info()
+        assert after.misses - before.misses <= 3
+        ref = np.asarray(packed.superstep(p, CONWAY, total))
+        assert np.array_equal(state, ref)
+
+
+class TestUnknownDeviceKindWarning:
+    def _fake_tpu(self, monkeypatch, kind):
+        class Dev:
+            device_kind = kind
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(jax, "devices", lambda: [Dev()])
+
+    def test_unknown_kind_warns_once_and_uses_baseline(self, monkeypatch):
+        self._fake_tpu(monkeypatch, "TPU v9 hypothetical")
+        pp._vmem_physical.cache_clear()
+        try:
+            with pytest.warns(RuntimeWarning, match="BASELINE.md"):
+                assert pp._vmem_physical() == pp._VMEM_BASELINE
+            # lru_cache makes the warning once-per-process: a second call
+            # never re-enters the body.
+            import warnings as _w
+
+            with _w.catch_warnings():
+                _w.simplefilter("error")
+                assert pp._vmem_physical() == pp._VMEM_BASELINE
+        finally:
+            pp._vmem_physical.cache_clear()
+
+    def test_known_kind_stays_silent(self, monkeypatch):
+        self._fake_tpu(monkeypatch, "TPU v5 lite")
+        pp._vmem_physical.cache_clear()
+        try:
+            import warnings as _w
+
+            with _w.catch_warnings():
+                _w.simplefilter("error")
+                assert pp._vmem_physical() == pp._VMEM_BY_KIND["TPU v5 lite"]
+        finally:
+            pp._vmem_physical.cache_clear()

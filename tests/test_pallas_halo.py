@@ -255,3 +255,188 @@ class TestShardedFrontier:
             )
             got, _ = self._run(b, (2, 1), turns)
             assert np.array_equal(got, ref), f"diverged at turns={turns}"
+
+
+class TestInKernelICI:
+    """Round-6 in-kernel ICI exchange tier: whole launch chunks run as ONE
+    pallas_call per device, halo rows + the (6,) interval state exchanged
+    inside the kernel (``_kernel_frontier_mega_strip``).  Hermetic
+    coverage is the ny == 1 LOOPBACK build — the torus self-exchange runs
+    the full launch/slot/state sequencing with local copies, so interpret
+    mode exercises everything except the literal remote-DMA lowering
+    (gated on hardware by ``tools/hw_compile_gate.py``).  Bit-identity
+    oracle: the single-device megakernel path of the XLA-gated packed
+    engine."""
+
+    H, W = 4096, 128
+
+    def _board(self):
+        b = np.zeros((self.H, self.W), dtype=np.uint8)
+        for dy, dx in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+            b[2030 + dy, 60 + dx] = 255
+        b[100:102, 20:22] = 255
+        seg = [2, 3, 4, 8, 9, 10]
+        for c in seg:
+            for r in (0, 5, 7, 12):
+                b[3000 + r, 40 + c] = 255
+                b[3000 + c, 40 + r] = 255
+        return b
+
+    def _run11(self, board_np, turns, **kw):
+        mesh = make_mesh((1, 1))
+        p = packed.pack(jnp.asarray(board_np))
+        pb = jax.device_put(np.asarray(p), packed_sharding(mesh))
+        out, sk = pallas_halo.make_superstep(
+            mesh, CONWAY, skip_stable=True, with_stats=True, **kw
+        )(pb, turns)
+        return np.asarray(packed.unpack(out)), int(sk)
+
+    def test_policy_loopback_always_available(self):
+        assert pallas_halo.ici_tier_policy(make_mesh((1, 1))) == (
+            True,
+            "in-kernel",
+        )
+
+    def test_policy_interpret_multidevice_falls_back(self):
+        # POLICY-classed (non-warning) downgrade: interpret mode has no
+        # remote-DMA emulation, the ppermute strip form stays selected.
+        # interpret=True pins the branch under test so the assertion also
+        # holds on a real multi-device TPU rig (where the tier would
+        # legitimately engage).
+        use, reason = pallas_halo.ici_tier_policy(
+            make_mesh((2, 1)), interpret=True
+        )
+        assert not use and "interpret" in reason
+
+    def test_policy_forced_ppermute(self):
+        use, reason = pallas_halo.ici_tier_policy(
+            make_mesh((1, 1)), in_kernel=False
+        )
+        assert not use and "forced" in reason
+
+    def test_policy_env_escape_hatch(self, monkeypatch):
+        monkeypatch.setenv("DGOL_ICI", "0")
+        use, reason = pallas_halo.ici_tier_policy(make_mesh((1, 1)))
+        assert not use and "DGOL_ICI" in reason
+        # An explicit in_kernel=True outranks the env switch.
+        assert pallas_halo.ici_tier_policy(make_mesh((1, 1)), in_kernel=True)[0]
+
+    @pytest.mark.parametrize("turns", [4 * 18, 5 * 18, 4 * 18 + 12, 4 * 18 + 7])
+    def test_loopback_bit_identity_parities_and_remainders(self, turns):
+        b = self._board()
+        ref = np.asarray(
+            packed.unpack(
+                packed.superstep(packed.pack(jnp.asarray(b)), CONWAY, turns)
+            )
+        )
+        got, _ = self._run11(b, turns)
+        assert np.array_equal(got, ref), f"diverged at turns={turns}"
+
+    def test_loopback_megakernel_chunks_long_run(self):
+        # full = 12 launches -> one 8-launch megakernel chunk + 4 loose
+        # probing launches + no remainder: the chunk seam (state restarts,
+        # buffer threading) and the mixed-tier dispatch both covered.
+        from distributed_gol_tpu.ops.pallas_packed import _nlaunch_chunks
+
+        assert _nlaunch_chunks(12) == ([8], 4)
+        b = self._board()
+        turns = 12 * 18
+        ref = np.asarray(
+            packed.unpack(
+                packed.superstep(packed.pack(jnp.asarray(b)), CONWAY, turns)
+            )
+        )
+        got, sk = self._run11(b, turns)
+        assert np.array_equal(got, ref)
+        assert sk > 0  # ash stripes skipped inside the megakernel
+
+    def test_loopback_equals_forced_ppermute(self):
+        b = self._board()
+        got_ici, _ = self._run11(b, 6 * 18)
+        got_pp, _ = self._run11(b, 6 * 18, in_kernel=False)
+        assert np.array_equal(got_ici, got_pp)
+
+    def test_backend_records_tier_policy(self):
+        from distributed_gol_tpu.engine.backend import Backend
+        from distributed_gol_tpu.engine.params import Params
+
+        common = dict(
+            turns=64,
+            image_width=4096,
+            skip_stable=True,
+            superstep=64,
+        )
+        b = Backend(
+            Params(
+                **common,
+                image_height=256,
+                mesh_shape=(1, 1),
+                engine="pallas-packed",
+            )
+        )
+        # (1, 1) runs the single-device engine; the sharded tier record
+        # only exists on real meshes.
+        assert b.sharded_tier is None
+        # Strips tall enough for a frontier plan: on interpret rigs the
+        # multi-device policy reason is the fallback — classed, recorded,
+        # never warned; on a real multi-device TPU the tier legitimately
+        # engages and the record must say so (Backend has no interpret
+        # knob, so the expectation follows the backend).
+        from distributed_gol_tpu.ops.pallas_packed import _use_interpret
+
+        b2 = Backend(
+            Params(
+                **common,
+                image_height=4096,
+                mesh_shape=(2, 1),
+                engine="pallas-packed",
+            )
+        )
+        assert b2.engine_used == "pallas-packed"
+        if _use_interpret():
+            assert b2.sharded_tier == "ppermute"
+            assert "interpret" in b2.sharded_tier_policy
+        else:
+            assert b2.sharded_tier == "ici-megakernel"
+        # Strips too short to host the frontier plan: the record must NOT
+        # claim the in-kernel tier (review finding, round 6) — geometry
+        # outranks mesh policy.
+        b3 = Backend(
+            Params(
+                **common,
+                image_height=256,
+                mesh_shape=(2, 1),
+                engine="pallas-packed",
+            )
+        )
+        assert b3.sharded_tier == "ppermute"
+        assert "no frontier plan" in b3.sharded_tier_policy
+
+    def test_remote_build_traces_hermetically(self):
+        # The remote-DMA form cannot RUN off-TPU, but its whole kernel
+        # body abstract-evals during pallas_call tracing — remote-copy
+        # descriptors, send/recv semaphore plumbing, the barrier signals —
+        # so Python-level regressions in the remote branch are caught
+        # hermetically; the Mosaic-lowering half is tools/hw_compile_gate.
+        call = pallas_halo._build_dispatch_frontier_strip(
+            (2048, 512), CONWAY, 18, 8, False, 1024, True
+        )
+        ids = jax.ShapeDtypeStruct((3,), jnp.int32)
+        b = jax.ShapeDtypeStruct((2048, 512), jnp.uint32)
+        jax.make_jaxpr(call)(ids, b, b)
+
+    def test_golden_512_in_kernel_tier(self, input_images, golden_images):
+        """512²×100 through the in-kernel tier matches the reference's
+        golden board — the same oracle as ``gol_test.go``, on the (1,1)
+        loopback build (the hermetic form of the tier)."""
+        from distributed_gol_tpu.engine.pgm import read_pgm
+
+        board = read_pgm(input_images / "512x512.pgm")
+        golden = read_pgm(golden_images / "512x512x100.pgm")
+        mesh = make_mesh((1, 1))
+        p = packed.pack(jnp.asarray(board))
+        pb = jax.device_put(np.asarray(p), packed_sharding(mesh))
+        out, _ = pallas_halo.make_superstep(
+            mesh, CONWAY, skip_stable=True, with_stats=True, in_kernel=True
+        )(pb, 100)
+        assert np.array_equal(np.asarray(packed.unpack(out)), golden)

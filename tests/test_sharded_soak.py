@@ -1,0 +1,65 @@
+"""Sharded-tier 10k-turn discipline: the (1,1)-mesh 512² alive-count soak.
+
+The single-device engines carry 10k-turn CSV soaks
+(``tests/test_run_counts.py``, ``tools/hw_soak.py``); the sharded
+pallas-packed tier had none — and round 6 added a second sharded
+execution tier (the in-kernel ICI exchange megakernel), so BOTH tiers now
+walk the reference's full 512² count series
+(``/root/reference/check/alive/512x512.csv``, turns 1..10000) at dispatch
+boundaries chosen to exercise megakernel chunks, the loose probing tail,
+the remainder split, and both launch parities.  Interpret-mode on CPU
+rigs (the (1,1) loopback build IS the hermetic form of the in-kernel
+tier); ``bench.py --verify`` covers hardware.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_gol_tpu.models.life import CONWAY
+from distributed_gol_tpu.ops import packed
+from distributed_gol_tpu.parallel import pallas_halo
+from distributed_gol_tpu.parallel.mesh import make_mesh
+from distributed_gol_tpu.parallel.packed_halo import packed_sharding
+
+from tests.test_run_counts import read_alive_csv
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("in_kernel", [True, False], ids=["ici", "ppermute"])
+def test_sharded_512_alive_count_soak(input_images, golden_alive, in_kernel):
+    expected = read_alive_csv(golden_alive / "512x512.csv")
+    from distributed_gol_tpu.engine.pgm import read_pgm
+
+    board = read_pgm(input_images / "512x512.pgm")
+    mesh = make_mesh((1, 1))
+    use, reason = pallas_halo.ici_tier_policy(mesh, in_kernel=in_kernel)
+    assert use is in_kernel, reason
+    p = packed.pack(jnp.asarray(board))
+    pb = jax.device_put(np.asarray(p), packed_sharding(mesh))
+    run = pallas_halo.make_superstep(
+        mesh, CONWAY, skip_stable=True, in_kernel=in_kernel
+    )
+    # 977-turn dispatches: full = 54 launches at T=18 → six 8-launch
+    # megakernel chunks + 6 loose probing launches + a 5-turn remainder
+    # (split into its period-multiple part + tail) — every dispatch
+    # crosses every execution path of the tier.
+    turn = 0
+    step = 977
+    while turn < 10_000:
+        k = min(step, 10_000 - turn)
+        pb = run(pb, k)
+        turn += k
+        count = int(np.count_nonzero(np.asarray(packed.unpack(pb))))
+        assert count == expected[turn], (
+            f"tier={'ici' if in_kernel else 'ppermute'} turn {turn}: "
+            f"{count} != {expected[turn]}"
+        )
+    # The settled period-2 tail (count_test.go:45-51): 5565 even / 5567
+    # odd from turn 10000 on.
+    pb = run(pb, 1)
+    assert int(np.count_nonzero(np.asarray(packed.unpack(pb)))) == 5567
+    pb = run(pb, 1)
+    assert int(np.count_nonzero(np.asarray(packed.unpack(pb)))) == 5565

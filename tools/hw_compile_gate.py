@@ -59,6 +59,17 @@ def _configs():
             cap = pp.default_skip_cap(shape[0])
             i32 = lambda n: jax.ShapeDtypeStruct((n,), jnp.int32)  # noqa: E731
             b = jax.ShapeDtypeStruct(shape, jnp.uint32)
+            if kind in ("ici", "ici-loopback"):
+                # In-kernel ICI exchange megakernel (round 6): the kernel
+                # takes neighbour mesh coords as an SMEM input instead of
+                # calling axis_index, exactly so this gate can AOT-compile
+                # the remote-DMA lowering standalone — interpret mode
+                # structurally cannot reach it (no remote-DMA emulation).
+                call = ph._build_dispatch_frontier_strip(
+                    shape, CONWAY, turns, 8, False, cap, kind == "ici"
+                )
+                jax.jit(call).lower(i32(3), b, b).compile()
+                return
             if kind == "frontier":
                 call = ph._build_ext_launch_frontier(shape, CONWAY, turns, False, cap)
                 grid = shape[0] // ph._strip_plan_tile(shape, turns, cap)
@@ -97,8 +108,24 @@ def _configs():
             t_s, adaptive = pp.adaptive_launch_depth(s, 10**6, scap)
             if adaptive and pp._frontier_plan(s, t_s, scap) is not None:
                 cfgs.append((f"strip {s} frontier T={t_s}", strip("frontier", s, t_s)))
+                # The round-6 in-kernel remote-DMA exchange form of the
+                # same geometry — the one lowering class interpret mode
+                # can never gate.
+                cfgs.append((f"strip {s} ici T={t_s}", strip("ici", s, t_s)))
             if adaptive:
                 cfgs.append((f"strip {s} probing T=18", strip("adaptive", s, 18)))
+        # The (1,1)-mesh loopback build of the in-kernel tier at the full
+        # board shape (the sharded-flagship headline config of round 6).
+        t_l, adaptive_l = pp.adaptive_launch_depth(
+            shape, 10**6, pp.default_skip_cap(size)
+        )
+        if adaptive_l and pp._frontier_plan(
+            shape, t_l, pp.default_skip_cap(size)
+        ) is not None:
+            cfgs.append(
+                (f"strip {shape} ici-loopback T={t_l}",
+                 strip("ici-loopback", shape, t_l))
+            )
         # One plain strip form per size covers the non-adaptive sharded path.
         cfgs.append((f"strip {(size // 4, wp)} plain T=16", strip("plain", (size // 4, wp), 16)))
     return cfgs
@@ -117,7 +144,8 @@ def run_gate(log=print, core: bool = False) -> dict:
     cfgs = _configs()
     if core:
         keep = ("strip (8192, 512) frontier", "strip (32768, 2048) frontier",
-                "65536^2 adaptive")
+                "strip (8192, 512) ici", "strip (32768, 2048) ici",
+                "strip (16384, 512) ici-loopback", "65536^2 adaptive")
         cfgs = [(l, f) for l, f in cfgs if l.startswith(keep)]
         if len(cfgs) != len(keep):
             # The filter failing to find its configs IS a gate failure —

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from distributed_gol_tpu.utils.compat import CompilerParams
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
@@ -77,7 +79,7 @@ def measure_vpu_peak(
     call = pl.pallas_call(
         partial(_chain_kernel, iters=iters, chains=chains),
         out_shape=[jax.ShapeDtypeStruct(shape, jnp.uint32)] * chains,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=100 << 20),
+        compiler_params=CompilerParams(vmem_limit_bytes=100 << 20),
     )
     run = jax.jit(lambda *a: call(*a))
     _sync(run(c1, c2, *xs)[0])  # compile + warm
