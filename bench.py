@@ -335,6 +335,8 @@ def bench_controller_path(
     skip_stable: bool = False,
     skip_tile_cap: int = 0,
     steady_frac: float = 0.6,
+    params_overrides: dict | None = None,
+    backend_factory=None,
 ) -> tuple[float, int]:
     """Throughput of the full product surface — ``gol.run()`` with a live
     consumer draining the event queue — NOT the bench harness's bare
@@ -383,6 +385,10 @@ def bench_controller_path(
         # budget) and the 'q'-bounded window would be empty.
         cycle_check=0,
     )
+    if params_overrides:
+        from dataclasses import replace
+
+        params = replace(params, **params_overrides)
     from distributed_gol_tpu.engine.events import EventQueue
 
     # EventQueue = the product fast path the CLI uses: per-turn streams are
@@ -423,7 +429,13 @@ def bench_controller_path(
 
     timer = threading.Thread(target=quit_later, daemon=True)
     timer.start()
-    run(params, events, keys, session=Session())
+    run(
+        params,
+        events,
+        keys,
+        session=Session(),
+        backend=backend_factory(params) if backend_factory else None,
+    )
     consumer.join(timeout=300)
     if consumer.is_alive():
         log("  WARNING: event consumer still draining; results may be skewed")
@@ -443,6 +455,83 @@ def bench_controller_path(
         f"steady {gps:,.0f} gens/s"
     )
     return gps, window[-1][0]
+
+
+def bench_faults(size: int, plan_spec: str, budget_seconds: float = 8.0) -> dict:
+    """``--faults PLAN``: the fault-tolerance overhead record (ISSUE 2).
+
+    Two controller-path measurements of the same config: bare, and with
+    the retry/backoff/watchdog/checkpoint machinery ARMED and the
+    dispatches routed through ``testing.faults.FaultInjectionBackend``
+    driving ``PLAN`` (the fault-plan JSON schema of docs/API.md — inline
+    text or a file path).  With the empty plan (``{}``) the second run
+    injects nothing, so ``overhead_frac`` is the clean-path cost of the
+    machinery itself — the acceptance target is "within bench noise"."""
+    from distributed_gol_tpu.engine.backend import Backend
+    from distributed_gol_tpu.testing.faults import FaultInjectionBackend, FaultPlan
+
+    plan = FaultPlan.from_json(plan_spec)
+    armed = dict(
+        retry_limit=3,
+        retry_backoff_seconds=0.05,
+        dispatch_deadline_seconds=30.0,
+        # The cadence check runs every resolve; an hour between saves
+        # means the measurement times the machinery, not checkpoint IO.
+        checkpoint_every_seconds=3600.0,
+    )
+    # Pilot run to size a FIXED superstep: the adaptive ladder's
+    # wall-clock-driven sizing is the dominant run-to-run noise on a CPU
+    # rig (±30% measured), which would drown the few-percent-at-most
+    # signal this record exists to capture.
+    pilot_gps, _ = bench_controller_path(size, budget_seconds=budget_seconds / 2)
+    superstep = superstep_for(max(pilot_gps, 1.0))
+
+    backends: list = []
+
+    def factory(params):
+        backend = FaultInjectionBackend(Backend(params), plan)
+        backends.append(backend)
+        return backend
+
+    # Interleaved A/B at the fixed superstep, medians over reps: drifts in
+    # background load hit both arms alike.
+    reps, clean_rates, armed_rates = 3, [], []
+    for _ in range(reps):
+        gps, _ = bench_controller_path(
+            size, budget_seconds=budget_seconds, superstep=superstep
+        )
+        clean_rates.append(gps)
+        gps, _ = bench_controller_path(
+            size,
+            budget_seconds=budget_seconds,
+            superstep=superstep,
+            params_overrides=armed,
+            backend_factory=factory,
+        )
+        armed_rates.append(gps)
+    clean_rates.sort()
+    armed_rates.sort()
+    clean_gps = clean_rates[reps // 2]
+    armed_gps = armed_rates[reps // 2]
+    harness = backends[-1]
+    record = {
+        "metric": f"gol_fault_overhead_{size}x{size}",
+        "unit": "generations/sec",
+        "superstep": superstep,
+        "reps": reps,
+        "clean_gps": round(clean_gps, 2),
+        "armed_gps": round(armed_gps, 2),
+        "clean_rates": [round(r, 1) for r in clean_rates],
+        "armed_rates": [round(r, 1) for r in armed_rates],
+        "overhead_frac": (
+            round(1.0 - armed_gps / clean_gps, 4) if clean_gps else None
+        ),
+        "faults_planned": len(plan),
+        "faults_injected": len(harness.injected),
+        "dispatches": harness.dispatches,
+    }
+    log(f"  fault-overhead record: {json.dumps(record)}")
+    return record
 
 
 def verify_engine(
@@ -674,6 +763,17 @@ def main():
         "in-kernel tier's documented escape hatch; DGOL_ICI=0 is the "
         "env spelling)",
     )
+    ap.add_argument(
+        "--faults",
+        metavar="PLAN",
+        default=None,
+        help="fault-tolerance overhead mode (ISSUE 2): run the controller "
+        "path bare and again with the retry/backoff/watchdog/checkpoint "
+        "machinery armed behind testing.faults.FaultInjectionBackend "
+        "driving PLAN (inline JSON or a file path; schema in docs/API.md "
+        "'Fault tolerance').  '{}' = the empty plan = the clean-path "
+        "overhead record.  Prints one JSON line and exits.",
+    )
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -690,6 +790,10 @@ def main():
     if dev.platform == "cpu" and size > 4096:
         size = 2048  # keep CI/laptop runs sane; the headline number is TPU
         log(f"cpu fallback: size -> {size}")
+
+    if args.faults is not None:
+        print(json.dumps(bench_faults(size, args.faults)))
+        return
 
     engine = pick_engine(args.engine, size)
     if args.all:

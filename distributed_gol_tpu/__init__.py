@@ -28,6 +28,7 @@ from distributed_gol_tpu.engine.events import (
     AliveCellsCount,
     CellFlipped,
     CellsFlipped,
+    CheckpointSaved,
     CycleDetected,
     DispatchError,
     Event,
@@ -41,6 +42,7 @@ from distributed_gol_tpu.engine.events import (
     TurnsCompleted,
     TurnTiming,
 )
+from distributed_gol_tpu.engine.controller import DispatchTimeout
 from distributed_gol_tpu.engine.gol import run, start
 
 __all__ = [
@@ -48,8 +50,10 @@ __all__ = [
     "Cell",
     "CellFlipped",
     "CellsFlipped",
+    "CheckpointSaved",
     "CycleDetected",
     "DispatchError",
+    "DispatchTimeout",
     "Event",
     "EventQueue",
     "FinalTurnComplete",

@@ -107,6 +107,34 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--soup-seed", type=int, default=0,
                     help="RNG seed for --soup (multi-host runs must pass "
                          "the same seed on every process)")
+    # Fault tolerance (docs/API.md "Fault tolerance").
+    ap.add_argument("--retry-limit", type=int, default=1, metavar="N",
+                    help="retries per failed dispatch from the last good "
+                         "board (0 = every failure terminal; default 1, "
+                         "the reference's single re-queue)")
+    ap.add_argument("--retry-backoff", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="base of the deterministic exponential backoff "
+                         "between retries (0 = retry immediately)")
+    ap.add_argument("--failure-budget", type=int, default=0, metavar="N",
+                    help="per-run failure cap: past it the next failure is "
+                         "terminal regardless of --retry-limit (0 = unlimited)")
+    ap.add_argument("--dispatch-deadline", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="dispatch watchdog: a blocking dispatch wait past "
+                         "this deadline aborts the run (sentinel + parked "
+                         "checkpoint) instead of wedging; 0 disables")
+    ap.add_argument("--checkpoint-every-turns", type=int, default=0,
+                    metavar="N",
+                    help="durable periodic checkpoint every N turns "
+                         "(atomic + CRC32 + keep-last-K; pair with "
+                         "--checkpoint-dir to survive the process)")
+    ap.add_argument("--checkpoint-every-seconds", type=float, default=0.0,
+                    metavar="S",
+                    help="wall-clock checkpoint cadence, checked at "
+                         "dispatch boundaries (refused by multi-host runs)")
+    ap.add_argument("--checkpoint-keep", type=int, default=3, metavar="K",
+                    help="keep-last-K rotation for periodic checkpoints")
     # Multi-host: launch the same command on every host (the reference's
     # hand-launched broker/worker fleet, broker/broker.go:191-205); process
     # 0 is the controller, the rest are followers.
@@ -148,6 +176,13 @@ def params_from_args(args) -> Params:
         cycle_check=args.cycle_check,
         soup_density=args.soup,
         soup_seed=args.soup_seed,
+        retry_limit=args.retry_limit,
+        retry_backoff_seconds=args.retry_backoff,
+        failure_budget=args.failure_budget,
+        dispatch_deadline_seconds=args.dispatch_deadline,
+        checkpoint_every_turns=args.checkpoint_every_turns,
+        checkpoint_every_seconds=args.checkpoint_every_seconds,
+        checkpoint_keep=args.checkpoint_keep,
     )
 
 

@@ -38,6 +38,7 @@ from distributed_gol_tpu.engine.events import (
     AliveCellsCount,
     CellFlipped,
     CellsFlipped,
+    CheckpointSaved,
     CycleDetected,
     DispatchError,
     EventQueue,
@@ -63,6 +64,84 @@ _PIPELINE_DISABLED = os.environ.get("GOL_NO_PIPELINE", "").lower() not in (
     "0",
     "false",
 )
+
+
+class DispatchTimeout(RuntimeError):
+    """A dispatch failed to resolve within ``Params.dispatch_deadline_seconds``
+    (the dispatch watchdog).  Terminal by policy — a wedged device or
+    collective would wedge a retry too — so the controller parks what it
+    can, emits the terminal DispatchError, guarantees the stream sentinel,
+    and raises this."""
+
+
+class _Watchdog:
+    """Bounds blocking waits on dispatch results (the dispatch watchdog,
+    ``Params.dispatch_deadline_seconds``).
+
+    Disabled (deadline 0, the default) it is a plain call — zero clean-path
+    overhead.  Enabled, the wait runs on a throwaway daemon thread and the
+    caller abandons it at the deadline: JAX has no cancellation for an
+    in-flight computation, so the wedged wait is left behind (daemon ⇒ it
+    cannot block interpreter exit) and the controller gets its abort path
+    instead of wedging with it."""
+
+    def __init__(self, deadline: float):
+        self.deadline = deadline
+
+    def call(self, fn):
+        if not self.deadline:
+            return fn()
+        box: list = []
+        done = threading.Event()
+
+        def _runner():
+            try:
+                box.append((True, fn()))
+            except BaseException as e:  # noqa: BLE001 — relayed to caller
+                box.append((False, e))
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_runner, name="gol-watchdog", daemon=True)
+        t.start()
+        if not done.wait(self.deadline):
+            raise DispatchTimeout(
+                f"dispatch did not resolve within {self.deadline}s "
+                "(device or collective wedged)"
+            )
+        ok, value = box[0]
+        if ok:
+            return value
+        raise value
+
+
+class _ParkGuard:
+    """Closes the watchdog-abandonment race on the terminal park: the
+    session write (commit) and the abort's abandonment are mutually
+    exclusive under one lock, and the abort reads back whether a commit
+    won — so ``DispatchError.checkpointed`` is truthful in every
+    interleaving, and a park the abort gave up on can never mutate the
+    session behind a ``checkpointed=False`` report."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._abandoned = False
+        self.committed = False
+
+    def commit(self, fn) -> bool:
+        with self._lock:
+            if self._abandoned:
+                return False
+            fn()
+            self.committed = True
+            return True
+
+    def abandon(self) -> bool:
+        """Abandon the park; returns whether a commit already won (the
+        rare at-deadline race: report it checkpointed after all)."""
+        with self._lock:
+            self._abandoned = True
+            return self.committed
 
 
 class _TickerState:
@@ -131,6 +210,13 @@ class Controller:
         # "completed" | "detached" ('q') | "killed" ('k')
         self._outcome = "completed"
         self._paused = False
+        # -- fault-tolerance state (ISSUE 2) --
+        self._watchdog = _Watchdog(params.dispatch_deadline_seconds)
+        self._failures = 0  # per-run failed-dispatch count (failure_budget)
+        self._ckpt_saved = False  # any periodic checkpoint parked this run
+        self._ckpt_save_warned = False  # one warning per run for failed saves
+        self._last_ckpt_turn = 0
+        self._last_ckpt_time = time.monotonic()
 
     # -- event helpers ---------------------------------------------------------
     def _emit(self, event):
@@ -230,49 +316,199 @@ class Controller:
 
     # -- failure surface -------------------------------------------------------
     def _dispatch(self, step, board, turn: int):
-        """Run one device dispatch with the broker's retry semantics
-        (``broker/broker.go:67-73``: a failed worker RPC is re-queued once a
-        consumer exists): on failure, retry once from the last good board
-        via :meth:`_retry_once` — the single home of the retry contract."""
+        """Run one device dispatch under the watchdog, with the retry
+        policy on failure (``Params.retry_limit`` — the broker's re-queue,
+        ``broker/broker.go:67-73``, generalised): on failure, retry from
+        the last good board via :meth:`_retry_failed` — the single home of
+        the retry contract."""
         try:
-            return step()
+            return self._watchdog.call(step)
         except Exception as e:  # noqa: BLE001 — any device/runtime failure
-            return self._retry_once(step, board, turn, e)
+            return self._retry_failed(step, board, turn, e)
 
-    def _retry_once(self, step, board_in, turn: int, first_error: Exception):
+    def _force(self, count_dev) -> int:
+        """Force an on-device count under the dispatch watchdog — the
+        blocking wait of the pipelined headless path."""
+        return self._watchdog.call(lambda: int(count_dev))
+
+    def _backoff(self, attempt: int):
+        """Deterministic exponential backoff before the ``attempt``-th
+        retry: base·2^(attempt-1) seconds, capped.  Zero base (default)
+        sleeps nothing — the reference's immediate re-queue."""
+        p = self.params
+        if p.retry_backoff_seconds <= 0:
+            return
+        delay = p.retry_backoff_seconds * (2 ** (attempt - 1))
+        if p.retry_backoff_max_seconds > 0:
+            delay = min(delay, p.retry_backoff_max_seconds)
+        time.sleep(delay)
+
+    def _retry_failed(self, step, board_in, turn: int, error: Exception):
         """The retry contract, shared by the viewer path (``_dispatch``)
         and the pipelined headless path (issue- and resolve-time
-        failures): announce, re-run ``step`` once; a second failure parks
-        ``board_in`` (the last good board) as a paused checkpoint — the
-        same resumable state a 'q' detach leaves — emits a terminal
-        DispatchError, and re-raises.  ``run()`` still guarantees the
-        stream sentinel."""
-        self._emit(DispatchError(turn, error=str(first_error), will_retry=True))
-        try:
-            return step()
-        except Exception as e2:
-            try:
-                checkpointed = self._park_checkpoint(board_in, turn)
-            except Exception:  # device wedged: board unfetchable
-                checkpointed = False
-            self._emit(
-                DispatchError(turn, error=str(e2), checkpointed=checkpointed)
-            )
-            raise
+        failures): announce each failure (DispatchError carries the
+        attempt count) and re-run ``step`` — under the watchdog, after
+        deterministic backoff — up to ``Params.retry_limit`` times.
 
-    def _park_checkpoint(self, board, turn: int) -> bool:
+        Terminal failures — retries exhausted, the per-run
+        ``Params.failure_budget`` spent, or a watchdog timeout (a wedged
+        device would wedge the retry too) — park ``board_in`` (the last
+        good board) as a paused checkpoint, the same resumable state a 'q'
+        detach leaves, emit the terminal DispatchError, and re-raise.
+        ``run()`` still guarantees the stream sentinel."""
+        p = self.params
+        attempt = 1  # failed attempts for this dispatch so far
+        while True:
+            self._failures += 1
+            terminal = (
+                isinstance(error, DispatchTimeout)
+                or attempt > p.retry_limit
+                or (p.failure_budget and self._failures > p.failure_budget)
+            )
+            if not terminal:
+                self._emit(
+                    DispatchError(
+                        turn, error=str(error), will_retry=True, attempt=attempt
+                    )
+                )
+                self._backoff(attempt)
+                try:
+                    return self._watchdog.call(step)
+                except Exception as e:  # noqa: BLE001
+                    error = e
+                    attempt += 1
+                    continue
+            # The park's fetch blocks on the device too: watchdog-guard it
+            # so a wedged device cannot turn the abort into a hang; the
+            # guard makes the session write and the abort's abandonment
+            # mutually exclusive, so the checkpointed flag below is
+            # truthful in every interleaving.
+            guard = _ParkGuard()
+            try:
+                checkpointed = self._watchdog.call(
+                    lambda: self._park_checkpoint(board_in, turn, guard)
+                )
+            except Exception:  # device wedged: board unfetchable
+                checkpointed = guard.abandon()
+            self._emit(
+                DispatchError(
+                    turn,
+                    error=str(error),
+                    checkpointed=checkpointed,
+                    attempt=attempt,
+                )
+            )
+            raise error
+
+    def _park_checkpoint(self, board, turn: int, guard=None) -> bool:
         """Park the last good board as a paused checkpoint after a terminal
         dispatch failure.  A seam, not just a helper: on a multi-host run the
         ``fetch`` below is a collective allgather, and after a one-sided
         failure the peer processes are not guaranteed to enter it — so the
         multi-host controller overrides this to skip checkpointing rather
-        than hang alone in a collective (advisor finding, round 2)."""
-        self.session.pause(
-            True,
-            world=self.backend.fetch(board),
-            turn=turn,
+        than hang alone in a collective (advisor finding, round 2).
+
+        ``guard`` (a :class:`_ParkGuard`, present when the watchdog owns
+        this call): the session write goes through ``guard.commit`` so a
+        park the abort abandoned can never mutate the session behind a
+        ``checkpointed=False`` report."""
+        world = self.backend.fetch(board)
+
+        def commit():
+            self.session.pause(
+                True,
+                world=world,
+                turn=turn,
+                rule=self.params.rule.notation,
+            )
+
+        if guard is None:
+            commit()
+            return True
+        return guard.commit(commit)
+
+    # -- durable periodic checkpoints (ISSUE 2) --------------------------------
+    def _save_checkpoint(self, world, turn: int):
+        """The session-write half of a periodic checkpoint — a seam: the
+        multi-host controller overrides it so FOLLOWERS drop the
+        (collectively fetched) world instead of pinning a full-board copy
+        on a throwaway session nothing can ever resume."""
+        self.session.save_checkpoint(
+            world,
+            turn,
             rule=self.params.rule.notation,
+            keep=self.params.checkpoint_keep,
         )
+
+    def _checkpoint_due(self, turn: int) -> bool:
+        p = self.params
+        if (
+            p.checkpoint_every_turns
+            and turn - self._last_ckpt_turn >= p.checkpoint_every_turns
+        ):
+            return True
+        return bool(
+            p.checkpoint_every_seconds
+            and time.monotonic() - self._last_ckpt_time
+            >= p.checkpoint_every_seconds
+        )
+
+    def _maybe_checkpoint(self, board, turn: int) -> bool:
+        """Park a durable periodic checkpoint when one is due
+        (``Params.checkpoint_every_turns`` / ``checkpoint_every_seconds``)
+        so a crash at any instant leaves a resumable state.  Called only
+        with a settled board at an exact turn boundary; the turn cadence
+        is deterministic in the dispatch schedule, so on multi-host runs
+        every process enters the collective ``fetch`` together (the
+        wall-clock cadence is refused there — ``run_distributed``).
+        Returns whether a checkpoint was written (callers re-latch their
+        pipeline clocks so the fetch stall is not billed to the next
+        dispatch)."""
+        if turn <= self._last_ckpt_turn or turn >= self.params.turns:
+            # Nothing new to guard — and the final turn is about to become
+            # the durable final PGM anyway (a completed run discards its
+            # periodic checkpoints in _finalize).
+            return False
+        if not self._checkpoint_due(turn):
+            return False
+        # The fetch blocks on the device (and, multi-host, is a collective
+        # allgather): watchdog-bounded like every other blocking dispatch
+        # wait, so a wedged device or dead peer surfaces as the terminal
+        # DispatchTimeout abort, never a hang at the checkpoint.
+        try:
+            world = self._watchdog.call(lambda: self.backend.fetch(board))
+            self._save_checkpoint(world, turn)
+        except DispatchTimeout as e:
+            # Wedged device/collective: the watchdog abort policy.  Tell
+            # the stream (like every other terminal timeout) before the
+            # sentinel — no park attempt, the fetch just proved wedged.
+            self._emit(DispatchError(turn, error=str(e), checkpointed=False))
+            raise
+        except Exception as e:  # noqa: BLE001 — ENOSPC, perms, ...
+            # Crash insurance must not BE the crash: a failed save leaves
+            # the run computing and the previous checkpoints intact; warn
+            # once and retry at the next cadence.  BOTH cadence anchors
+            # advance — the due schedule must stay a pure function of the
+            # dispatch schedule (multi-host processes decide `due`
+            # independently, and the collective fetch above only lines up
+            # if a save failure on one process cannot desync its anchors).
+            if not self._ckpt_save_warned:
+                self._ckpt_save_warned = True
+                import warnings
+
+                warnings.warn(
+                    f"periodic checkpoint at turn {turn} failed ({e}); "
+                    "run continues, will retry at the next cadence",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+            self._last_ckpt_turn = turn
+            self._last_ckpt_time = time.monotonic()
+            return False
+        self._ckpt_saved = True
+        self._last_ckpt_turn = turn
+        self._last_ckpt_time = time.monotonic()
+        self._emit(CheckpointSaved(turn))
         return True
 
     # -- the run (distributor, gol/distributor.go:194-262) ---------------------
@@ -290,6 +526,8 @@ class Controller:
     def _run(self):
         p = self.params
         board_np, start_turn = self._initial_world()
+        self._last_ckpt_turn = start_turn
+        self._last_ckpt_time = time.monotonic()
         viewer = p.wants_flips() or p.wants_frames()
 
         # Initial flips: one per alive cell of the *actual* starting world
@@ -362,6 +600,7 @@ class Controller:
             self._emit(TurnComplete(turn))
             if p.emit_timing:
                 self._emit(TurnTiming(turn, k, time.perf_counter() - t0))
+            self._maybe_checkpoint(board, turn)
         return board, turn
 
     def _headless_loop(self, board, turn: int, state: _TickerState):
@@ -410,9 +649,9 @@ class Controller:
             board_in, board_out, count_dev, k, t_issue = pending
             pending = None
             try:
-                count = int(count_dev)
+                count = self._force(count_dev)
             except Exception as e:  # noqa: BLE001 — device/runtime failure
-                board_out, count = self._retry_once(
+                board_out, count = self._retry_failed(
                     lambda: self.backend.run_turns(board_in, k),
                     board_in,
                     turn,
@@ -434,6 +673,10 @@ class Controller:
                 self._emit(TurnTiming(turn, k, dt))
             if adaptive and k == superstep:
                 superstep = self._next_superstep(k, dt, superstep, warm_sizes, cap)
+            if self._maybe_checkpoint(board_out, turn):
+                # The checkpoint's fetch stalled the pipeline; don't bill
+                # that host time to the next dispatch's adaptive sizing.
+                prev_resolve = time.perf_counter()
             return board_out
 
         # Whole-board cycle detection (Params.cycle_check): every
@@ -491,7 +734,7 @@ class Controller:
                 # latch, and timing telemetry have exactly one home.
                 if pending is not None:
                     board = resolve()
-                new_board, count = self._retry_once(
+                new_board, count = self._retry_failed(
                     lambda: self.backend.run_turns(board, k), board, turn, e
                 )
                 pending = (board, new_board, count, k, t0)
@@ -578,7 +821,7 @@ class Controller:
         if remaining <= 0:
             return board, turn
         # Device work below goes through _dispatch: the standard
-        # retry-once-then-park contract, like any other dispatch.
+        # retry-then-park contract, like any other dispatch.
         counts = self._dispatch(
             lambda: self.backend.cycle_counts(board), board, turn
         )  # count after i+1 generations
@@ -652,6 +895,12 @@ class Controller:
     def _finalize(self, board, turn: int):
         p = self.params
         if self._outcome == "completed":
+            if self._ckpt_saved:
+                # The run the periodic checkpoints guarded finished:
+                # nothing may resume from them (same consume-once policy
+                # as check_states).  Detach/kill paths keep their own
+                # semantics — 'q' parked a newer checkpoint, 'k' quit().
+                self.session.discard_checkpoint()
             final_np = self.backend.fetch(board)
             # FinalTurnComplete carries the true turn count (quirk Q1 fixed)
             # and the alive-cell list tests consume (gol_test.go:33-41).

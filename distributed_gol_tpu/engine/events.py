@@ -182,11 +182,17 @@ class FinalTurnComplete(Event):
 class DispatchError(Event):
     """A device dispatch failed (framework extension).  The host-level
     analog of the reference broker re-queuing a failed worker RPC
-    (``broker/broker.go:67-73``): the controller retries the superstep once
-    from the last good board; if the retry also fails it parks a checkpoint
-    on the session (resumable like a 'q' detach) and aborts the run — the
-    stream still ends with the sentinel either way.
+    (``broker/broker.go:67-73``), generalised to a policy: the controller
+    retries the superstep from the last good board up to
+    ``Params.retry_limit`` times with deterministic exponential backoff
+    (``Params.retry_backoff_seconds``); a terminal failure — retries
+    exhausted, per-run ``Params.failure_budget`` spent, or a watchdog
+    timeout — parks a checkpoint on the session (resumable like a 'q'
+    detach) and aborts the run.  The stream still ends with the sentinel
+    either way.
 
+    ``attempt``: 1-based count of failed attempts for this dispatch so far
+    (1 = the original dispatch failed, 2 = its first retry failed...).
     ``will_retry``: this failure is about to be retried.
     ``checkpointed``: terminal failure, last good board parked on the session.
     """
@@ -194,6 +200,7 @@ class DispatchError(Event):
     error: str = ""
     will_retry: bool = False
     checkpointed: bool = False
+    attempt: int = 0
 
     def __str__(self) -> str:
         action = (
@@ -201,7 +208,22 @@ class DispatchError(Event):
             if self.will_retry
             else ("checkpointed" if self.checkpointed else "aborting")
         )
-        return f"Dispatch error ({action}): {self.error}"
+        tag = f"attempt {self.attempt}, " if self.attempt else ""
+        return f"Dispatch error ({tag}{action}): {self.error}"
+
+
+@dataclass(frozen=True)
+class CheckpointSaved(Event):
+    """A durable periodic checkpoint was parked on the session (framework
+    extension; ``Params.checkpoint_every_turns`` /
+    ``checkpoint_every_seconds``).  The board at ``completed_turns`` is
+    resumable by a fresh controller — the crash-recovery contract: atomic
+    tmp+rename writes, world-before-meta ordering, a CRC32 sidecar that
+    detects torn writes at resume, keep-last-K rotation (see
+    ``Session.save_checkpoint``)."""
+
+    def __str__(self) -> str:
+        return f"Checkpoint saved at turn {self.completed_turns}"
 
 
 @dataclass(frozen=True)
@@ -365,5 +387,6 @@ AnyEvent = Union[
     CycleDetected,
     FinalTurnComplete,
     DispatchError,
+    CheckpointSaved,
     TurnTiming,
 ]

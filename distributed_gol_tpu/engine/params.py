@@ -140,6 +140,55 @@ class Params:
     # (broker/broker.go:192).
     mesh_shape: tuple[int, int] = (1, 1)
 
+    # --- fault tolerance (framework extension; the reference's only story
+    # is the broker re-queueing a failed worker RPC once,
+    # broker/broker.go:67-73; see docs/API.md "Fault tolerance") ---
+    # Retries per failed dispatch, each re-run from the last good board.
+    # The default mirrors the reference's single re-queue; 0 disables
+    # retries (every failure is terminal: park a checkpoint and abort).
+    retry_limit: int = 1
+    # Deterministic exponential backoff between retries: the n-th retry of
+    # a dispatch sleeps base·2^(n-1) seconds, capped at
+    # retry_backoff_max_seconds.  0 (default) retries immediately — the
+    # reference's re-queue semantics, and the right call for the transient
+    # single-dispatch errors retries exist for; a base > 0 spaces retries
+    # out for failures that need the device a moment to recover.
+    retry_backoff_seconds: float = 0.0
+    retry_backoff_max_seconds: float = 2.0
+    # Per-run failure cap: once this many dispatch failures have occurred
+    # in one run, the NEXT failure is terminal even if retry_limit allows
+    # more — a flapping device should park a resumable checkpoint and
+    # abort, not grind a long run forever.  0 = unlimited.
+    failure_budget: int = 0
+    # Dispatch watchdog: any blocking wait on a dispatch result (count
+    # force, sync viewer dispatch, retry, terminal checkpoint fetch) that
+    # exceeds this many seconds raises DispatchTimeout; the run aborts
+    # with the stream sentinel — and a parked checkpoint when the last
+    # good board is still fetchable — instead of wedging the controller.
+    # Timeouts are terminal (never retried): a wedged device or collective
+    # would wedge the retry too.  On multi-host runs every process's own
+    # watchdog fires, so no process hangs alone in a collective.  0
+    # (default) disables; the clean path then pays nothing.
+    #
+    # The deadline bounds WALL-CLOCK waits — the watchdog cannot tell a
+    # wedge from a legitimately slow wait, so set it above the worst
+    # legitimate one: first-dispatch jit compilation (tens of seconds at
+    # 16384²-class boards; see bench.budget_for) and, with an explicit
+    # large superstep, the dispatch's own device time.
+    dispatch_deadline_seconds: float = 0.0
+    # Durable periodic checkpoints: every N completed turns (and/or every
+    # S seconds, both checked at dispatch boundaries against the settled
+    # board) the controller parks a checkpoint on the session — atomic
+    # tmp+rename writes, world-before-meta ordering, CRC32 sidecar,
+    # keep-last-K rotation (Session.save_checkpoint) — so a crash at any
+    # instant leaves a resumable state and a torn write is detected and
+    # skipped at resume.  0 disables.  Multi-host runs refuse the
+    # wall-clock cadence (it would diverge the SPMD dispatch schedule
+    # between processes); the turn cadence is deterministic everywhere.
+    checkpoint_every_turns: int = 0
+    checkpoint_every_seconds: float = 0.0
+    checkpoint_keep: int = 3
+
     # Input-source override: a random soup of this density instead of the
     # ``images/WxH.pgm`` file (framework extension — the reference ships
     # pre-made soups as PGMs, which stops being practical at 16384²+ where
@@ -184,6 +233,20 @@ class Params:
             raise ValueError("max_dispatch_seconds must be positive")
         if self.soup_density is not None and not 0.0 < self.soup_density < 1.0:
             raise ValueError("soup_density must be in (0, 1)")
+        if self.retry_limit < 0:
+            raise ValueError("retry_limit must be >= 0 (0 disables retries)")
+        if self.retry_backoff_seconds < 0 or self.retry_backoff_max_seconds < 0:
+            raise ValueError("retry backoff times must be >= 0")
+        if self.failure_budget < 0:
+            raise ValueError("failure_budget must be >= 0 (0 = unlimited)")
+        if self.dispatch_deadline_seconds < 0:
+            raise ValueError(
+                "dispatch_deadline_seconds must be >= 0 (0 disables the watchdog)"
+            )
+        if self.checkpoint_every_turns < 0 or self.checkpoint_every_seconds < 0:
+            raise ValueError("checkpoint cadences must be >= 0 (0 disables)")
+        if self.checkpoint_keep < 1:
+            raise ValueError("checkpoint_keep must be >= 1")
         # Paths may arrive as strings from CLI/config files.
         object.__setattr__(self, "images_dir", Path(self.images_dir))
         object.__setattr__(self, "out_dir", Path(self.out_dir))

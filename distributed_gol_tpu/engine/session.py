@@ -14,12 +14,26 @@ detach/reattach within a process (the default global session); given a
 directory it also persists checkpoints as PGM + sidecar metadata, so a brand
 new process can resume — strictly more durable than the reference, whose
 checkpoint dies with the broker process.
+
+Durability contract (ISSUE 2): every persisted checkpoint is crash-safe.
+The world PGM is written first, then the sidecar — each atomically
+(tmp + ``os.replace``) — and the sidecar carries the world's CRC32, so the
+sidecar is the commit record: it never points at a world that is not fully
+on disk, and a torn world left by a crash (or a corrupt/truncated sidecar)
+is detected at resume, warned about once, and skipped rather than resumed.
+Periodic checkpoints (:meth:`save_checkpoint`) rotate under
+``checkpoint-<turn>`` stems with keep-last-K pruning, so a torn newest pair
+falls back to the previous intact one; the 'q'-detach path keeps the
+legacy un-numbered ``checkpoint.*`` stem.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
+import warnings
+import zlib
 from dataclasses import dataclass
 from pathlib import Path
 
@@ -55,6 +69,14 @@ class Session:
         self._checkpoint: Checkpoint | None = None
         self._shutdown = False
         self._dir = Path(checkpoint_dir) if checkpoint_dir is not None else None
+        # On-disk stem of the current checkpoint pair: "checkpoint" for the
+        # 'q'-detach path (legacy name), "checkpoint-<turn>" for rotated
+        # periodic saves.
+        self._ckpt_name = "checkpoint"
+        # Stems THIS session persisted: quit()/discard_checkpoint() remove
+        # only these, so a shared directory's foreign pairs stay claimable.
+        self._written_stems: set[str] = set()
+        self._warned: set[str] = set()  # one warning per bad file per session
 
     # -- Broker.Pause (broker/broker.go:143-155) ------------------------------
     def pause(
@@ -74,7 +96,58 @@ class Session:
                 self._checkpoint = Checkpoint(
                     np.asarray(world, dtype=np.uint8), turn, rule
                 )
+                self._ckpt_name = "checkpoint"
                 self._persist()
+
+    # -- periodic durable checkpoints (ISSUE 2) --------------------------------
+    def save_checkpoint(
+        self,
+        world: np.ndarray,
+        turn: int,
+        rule: str | None = None,
+        keep: int = 3,
+    ):
+        """Park a periodic (crash-recovery) checkpoint: the same resumable
+        state a 'q' detach leaves, under a rotated ``checkpoint-<turn>``
+        stem so the previous K-1 pairs survive as fallbacks when the
+        newest write is torn.  Keeps the newest ``keep`` rotated pairs
+        (the controller feeds ``Params.checkpoint_keep`` — the one
+        authoritative knob)."""
+        with self._lock:
+            prev = (self._paused, self._checkpoint, self._ckpt_name)
+            self._paused = True
+            self._checkpoint = Checkpoint(
+                np.asarray(world, dtype=np.uint8), turn, rule
+            )
+            self._ckpt_name = f"checkpoint-{turn:012d}"
+            try:
+                self._persist()
+                self._rotate(keep)
+            except BaseException:
+                # A failed persist (ENOSPC, perms) must not leave the
+                # session paused on a mid-run board: a COMPLETED run would
+                # then look resumable and the next run would silently
+                # restart it.  All-or-nothing: roll the slot back, let the
+                # caller decide (the controller warns and keeps running).
+                self._paused, self._checkpoint, self._ckpt_name = prev
+                raise
+
+    def discard_checkpoint(self):
+        """Drop the parked checkpoint — the in-memory slot and the ROTATED
+        pairs this session wrote — without shutting the session down: the
+        run that parked periodic checkpoints completed, so nothing may
+        resume from them.  The legacy un-numbered stem (and any rotated
+        pair another session wrote into a shared directory) is left
+        alone: it may be another controller's still-parked checkpoint
+        that this run's check_states refused on a shape/rule mismatch
+        (the contract says a mismatch leaves it claimable).  NB the
+        in-memory slot is single by design — the reference broker holds
+        exactly one checkpoint (``broker/broker.go:143-148``); only the
+        on-disk extension is multi-pair."""
+        with self._lock:
+            self._paused = False
+            self._checkpoint = None
+            self._unlink_written(rotated_only=True)
 
     # -- Broker.CheckStates (broker/broker.go:124-141) ------------------------
     def check_states(
@@ -85,24 +158,20 @@ class Session:
         clears paused as a side effect (the reference broadcasts on its
         pause cond here, ``broker/broker.go:137-138``).  A size or rule
         mismatch leaves the checkpoint parked un-consumed, so a matching
-        controller can still claim it."""
+        controller can still claim it.
+
+        Durable sessions scan every on-disk pair, newest turn first, and
+        adopt the first INTACT one: a corrupt or truncated sidecar, an
+        unreadable world PGM, or a CRC mismatch (torn write) is warned
+        about once and skipped — "no checkpoint" rather than an exception
+        out of resume negotiation, with older rotated pairs as fallbacks."""
         with self._lock:
             ckpt, paused = self._checkpoint, self._paused
             if ckpt is None and self._dir is not None:
-                # Refuse from the few-byte sidecar alone when possible: a
-                # mismatch has no side effects, so repeated mismatched
-                # calls must not re-read a multi-GB world PGM each time.
-                meta = self._load_meta()
-                if meta is None or not meta.get("paused", False):
+                found = self._adopt_from_disk(width, height, rule)
+                if found is None:
                     return None
-                mrule = meta.get("rule")
-                if rule is not None and mrule is not None and rule != mrule:
-                    return None
-                mshape = meta.get("shape")
-                if mshape is not None and tuple(mshape) != (height, width):
-                    return None
-                world = pgm.read_pgm(self._world_path)
-                ckpt, paused = Checkpoint(world, int(meta["turn"]), mrule), True
+                ckpt, paused = found, True
             if not paused or ckpt is None:
                 return None
             if ckpt.world.shape != (height, width):
@@ -111,24 +180,60 @@ class Session:
                 return None
             # Adopt + consume: clear paused in memory AND on disk, so the
             # checkpoint is resumed exactly once (a second fresh process must
-            # not silently restart from it).
+            # not silently restart from it — nor from an OLDER rotated pair).
             self._checkpoint = ckpt
             self._paused = False
-            self._persist_meta(paused=False)
+            self._mark_consumed(ckpt.world.shape, ckpt.rule)
             return ckpt
+
+    def _adopt_from_disk(
+        self, width: int, height: int, rule: str | None
+    ) -> Checkpoint | None:
+        """The durable half of resume negotiation: the newest intact pair,
+        gated from the few-byte sidecar alone where possible — a mismatch
+        has no side effects, so repeated mismatched calls must not re-read
+        a multi-GB world PGM each time."""
+        for path, meta in self._disk_candidates():
+            mrule = meta.get("rule")
+            if rule is not None and mrule is not None and rule != mrule:
+                # Another controller's pair (the dir may be shared): skip
+                # it, leave it parked and claimable — never let it shadow
+                # or consume this controller's own checkpoints.
+                continue
+            mshape = meta.get("shape")
+            if mshape is not None and tuple(mshape) != (height, width):
+                continue  # same: parked for a different board size
+            if not meta.get("paused", False):
+                # A consumed record is dead, not a scan stopper: consume
+                # marks EVERY matching paused sidecar at adoption time, so
+                # any pair still paused now was parked AFTER that consume
+                # (a newer run's crash state) and is legitimately
+                # adoptable — a stale consumed record from an earlier,
+                # higher-turn run must not shadow it.
+                continue
+            world = self._load_world(path, meta)
+            if world is None:
+                continue  # torn/unreadable pair: fall back to an older one
+            return Checkpoint(world, int(meta["turn"]), mrule)
+        return None
 
     # -- Broker.Quit (broker/broker.go:182-189) --------------------------------
     def quit(self):
         """'k' teardown: drop all state.  The reference kills the broker and
         worker processes via os.Exit; in-process the analog is discarding the
-        checkpoint so nothing can resume."""
+        checkpoint so nothing can resume.  Scope: this session's own legacy
+        pair plus every pair it wrote — a shared directory's foreign pairs
+        are another "broker"'s state and stay claimable."""
         with self._lock:
             self._shutdown = True
             self._paused = False
             self._checkpoint = None
             if self._dir is not None:
-                for p in (self._meta_path, self._world_path):
-                    p.unlink(missing_ok=True)
+                # The legacy slot is this session's own even if it never
+                # wrote it this process (pre-rotation behaviour).
+                (self._dir / "checkpoint.json").unlink(missing_ok=True)
+                (self._dir / "checkpoint.pgm").unlink(missing_ok=True)
+            self._unlink_written(rotated_only=False)
 
     @property
     def paused(self) -> bool:
@@ -146,23 +251,28 @@ class Session:
             self._checkpoint = None
             self._shutdown = False
 
-    # -- optional durable checkpoints (framework extension) --------------------
+    # -- durable persistence (framework extension) -----------------------------
     @property
     def _world_path(self) -> Path:
         assert self._dir is not None
-        return self._dir / "checkpoint.pgm"
+        return self._dir / f"{self._ckpt_name}.pgm"
 
     @property
     def _meta_path(self) -> Path:
         assert self._dir is not None
-        return self._dir / "checkpoint.json"
+        return self._dir / f"{self._ckpt_name}.json"
 
     def _persist(self):
         if self._dir is None or self._checkpoint is None:
             return
         self._dir.mkdir(parents=True, exist_ok=True)
+        # World BEFORE meta, each atomic (tmp + os.replace): the sidecar is
+        # the commit record.  A crash before the meta replace leaves the
+        # previous pair (or no pair) authoritative; a torn world under an
+        # existing sidecar fails the sidecar's CRC and is skipped at resume.
         pgm.write_pgm(self._world_path, self._checkpoint.world)
         self._persist_meta(paused=True)
+        self._written_stems.add(self._ckpt_name)
 
     def _persist_meta(self, paused: bool):
         if self._dir is None or self._checkpoint is None:
@@ -172,17 +282,146 @@ class Session:
             "turn": self._checkpoint.turn,
             "paused": paused,
             "shape": list(self._checkpoint.world.shape),
+            # Buffer-protocol CRC: no .tobytes() copy — the world can be
+            # hundreds of MB at the headline board sizes.
+            "crc32": zlib.crc32(np.ascontiguousarray(self._checkpoint.world)),
         }
         if self._checkpoint.rule is not None:
             meta["rule"] = self._checkpoint.rule
-        self._meta_path.write_text(json.dumps(meta))
+        self._write_json(self._meta_path, meta)
 
-    def _load_meta(self) -> dict | None:
-        """Read just the durable checkpoint's sidecar (turn/paused/rule/
-        shape) — the world PGM is read only once the cheap gates pass."""
-        if self._dir is None or not self._meta_path.exists():
+    @staticmethod
+    def _write_json(path: Path, meta: dict):
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(json.dumps(meta))
+        os.replace(tmp, path)
+
+    def _rotate(self, keep: int):
+        """Prune THIS session's rotated pairs beyond the newest ``keep``
+        (0 = all of them).  Scope matters in a shared directory: foreign
+        rotated pairs and the legacy 'q' pair are other controllers'
+        claimable state and are never pruned.  Sidecar first — deleting
+        the commit record makes the pair dead even if the world unlink is
+        lost to a crash."""
+        if self._dir is None or keep < 0:
+            return
+        stems = sorted(
+            s for s in self._written_stems if s.startswith("checkpoint-")
+        )
+        for stem in stems[:-keep] if keep else stems:
+            (self._dir / f"{stem}.json").unlink(missing_ok=True)
+            (self._dir / f"{stem}.pgm").unlink(missing_ok=True)
+            self._written_stems.discard(stem)
+        # GC: a CONSUMED rotated pair is dead for everyone (consume-once),
+        # whoever wrote it — prune it so crash/resume cycles don't leak a
+        # keep-full of multi-hundred-MB worlds per restart.  Paused
+        # (claimable) and unreadable (warned-about) foreign pairs stay.
+        for path in self._dir.glob("checkpoint-*.json"):
+            if path.stem in self._written_stems:
+                continue
+            meta = self._load_meta(path)
+            if meta is not None and not meta.get("paused", True):
+                path.unlink(missing_ok=True)
+                path.with_suffix(".pgm").unlink(missing_ok=True)
+
+    def _unlink_written(self, rotated_only: bool):
+        """Delete the pairs this session persisted (sidecar first — the
+        commit record); ``rotated_only`` spares the legacy 'q' stem."""
+        if self._dir is None:
+            self._written_stems.clear()
+            return
+        for stem in sorted(self._written_stems):
+            if rotated_only and not stem.startswith("checkpoint-"):
+                continue
+            (self._dir / f"{stem}.json").unlink(missing_ok=True)
+            (self._dir / f"{stem}.pgm").unlink(missing_ok=True)
+        self._written_stems = (
+            {s for s in self._written_stems if not s.startswith("checkpoint-")}
+            if rotated_only
+            else set()
+        )
+
+    def _disk_candidates(self) -> list[tuple[Path, dict]]:
+        """(sidecar path, meta) for every readable on-disk sidecar, newest
+        turn first.  Unreadable sidecars are warned about once and skipped
+        — a corrupt file must degrade to "no checkpoint", never raise out
+        of resume negotiation."""
+        if self._dir is None or not self._dir.is_dir():
+            return []
+        out = []
+        for path in sorted(self._dir.glob("checkpoint*.json")):
+            meta = self._load_meta(path)
+            if meta is not None:
+                out.append((path, meta))
+        out.sort(key=lambda pm: pm[1]["turn"], reverse=True)
+        return out
+
+    def _load_meta(self, path: Path | None = None) -> dict | None:
+        """Read one checkpoint sidecar (turn/paused/rule/shape/crc32) —
+        the world PGM is read only once the cheap gates pass.  Corrupt,
+        truncated, or unreadable sidecars return None with a one-time
+        warning."""
+        path = self._meta_path if path is None else path
+        try:
+            meta = json.loads(path.read_text())
+            if not isinstance(meta, dict) or not isinstance(meta.get("turn"), int):
+                raise ValueError("sidecar is not a checkpoint record")
+            return meta
+        except FileNotFoundError:
             return None
-        return json.loads(self._meta_path.read_text())
+        except (OSError, ValueError) as e:
+            self._warn_once(path, f"ignoring unreadable checkpoint sidecar ({e})")
+            return None
+
+    def _load_world(self, meta_path: Path, meta: dict) -> np.ndarray | None:
+        """The world PGM named by a sidecar, validated against the
+        sidecar's CRC32; unreadable or torn worlds return None with a
+        one-time warning (pre-CRC sidecars skip the checksum)."""
+        world_path = meta_path.with_suffix(".pgm")
+        try:
+            world = pgm.read_pgm(world_path)
+        except (OSError, pgm.PgmError) as e:
+            self._warn_once(
+                world_path, f"ignoring unreadable checkpoint world ({e})"
+            )
+            return None
+        crc = meta.get("crc32")
+        if crc is not None and zlib.crc32(np.ascontiguousarray(world)) != crc:
+            self._warn_once(
+                world_path, "checkpoint world fails its CRC32 (torn write?)"
+            )
+            return None
+        return world
+
+    def _mark_consumed(self, shape, rule: str | None):
+        """Flip THIS controller's on-disk sidecars to paused=False: resume
+        is consume-once across the whole rotation (a second fresh process
+        must not adopt an older pair of the same run).  Pairs parked for a
+        DIFFERENT shape or rule belong to another controller sharing the
+        directory and stay claimable; a sidecar with the field missing
+        matches anything (it would be adoptable here), so consume-once
+        wins and it is flipped."""
+        if self._dir is None or not self._dir.is_dir():
+            return
+        for path in self._dir.glob("checkpoint*.json"):
+            meta = self._load_meta(path)
+            if meta is None or not meta.get("paused", False):
+                continue
+            mshape = meta.get("shape")
+            if mshape is not None and tuple(mshape) != tuple(shape):
+                continue
+            mrule = meta.get("rule")
+            if rule is not None and mrule is not None and rule != mrule:
+                continue
+            meta["paused"] = False
+            self._write_json(path, meta)
+
+    def _warn_once(self, path: Path, msg: str):
+        key = str(path)
+        if key in self._warned:
+            return
+        self._warned.add(key)
+        warnings.warn(f"{path}: {msg}", RuntimeWarning, stacklevel=4)
 
 
 # The default in-process session: the analog of "the one broker at

@@ -112,18 +112,29 @@ class _BroadcastKeys:
 
     Each ``get``/``empty`` call is one scalar broadcast, so ALL processes
     must call them in the same order — guaranteed because the controller's
-    control flow is a pure function of what these calls return."""
+    control flow is a pure function of what these calls return.
 
-    def __init__(self, inner):
+    ``watchdog`` (a ``controller._Watchdog``) bounds each broadcast: after
+    a one-sided failure the surviving processes' next keys poll is a
+    collective the dead peer never joins, and without the bound they hang
+    there — outside the dispatch paths' own watchdog — until the
+    coordination service's heartbeat timeout hard-kills them (observed as
+    SIGABRT with no sentinel)."""
+
+    def __init__(self, inner, watchdog=None):
         import queue as _queue
 
         self._inner = inner  # the real queue on process 0, else None
         self._queue_mod = _queue
+        self._watchdog = watchdog
 
     def _bcast(self, value: int) -> int:
         from jax.experimental import multihost_utils
 
-        return int(multihost_utils.broadcast_one_to_all(np.int32(value)))
+        def do():
+            return int(multihost_utils.broadcast_one_to_all(np.int32(value)))
+
+        return self._watchdog.call(do) if self._watchdog is not None else do()
 
     def get(self, block=False, timeout=None):
         code = 0
@@ -190,6 +201,13 @@ def run_distributed(params, events=None, key_presses=None, session=None):
     """
     if not params.no_vis or params.wants_flips() or params.wants_frames():
         raise ValueError("multi-host runs are headless (no_vis=True)")
+    if params.checkpoint_every_seconds:
+        raise ValueError(
+            "multi-host runs schedule periodic checkpoints by turn cadence "
+            "only (checkpoint_every_turns): the wall-clock cadence would "
+            "diverge the SPMD dispatch schedule between processes (the "
+            "checkpoint fetch is a collective)"
+        )
 
     try:
         return _run_distributed(params, events, key_presses, session)
@@ -205,7 +223,7 @@ def run_distributed(params, events=None, key_presses=None, session=None):
 def _run_distributed(params, events, key_presses, session):
     from jax.experimental import multihost_utils
 
-    from distributed_gol_tpu.engine.controller import Controller
+    from distributed_gol_tpu.engine.controller import Controller, _Watchdog
     from distributed_gol_tpu.engine.session import Session, default_session
 
     main = jax.process_index() == 0
@@ -251,21 +269,46 @@ def _run_distributed(params, events, key_presses, session):
             pass
 
     ev = events if (main and events is not None) else _DevNull()
-    keys = _BroadcastKeys(key_presses if main else None)
+    keys = _BroadcastKeys(
+        key_presses if main else None,
+        _Watchdog(params.dispatch_deadline_seconds),
+    )
 
     class MultihostController(Controller):
         def _write_pgm(self, path, board_np):
             if main:
                 super()._write_pgm(path, board_np)
 
-        def _park_checkpoint(self, board, turn):
+        def _park_checkpoint(self, board, turn, guard=None):
             # The base-class checkpoint fetch is a collective allgather; a
             # dispatch failure may be one-sided (one process's runtime
             # dies), and entering a collective alone hangs this process
             # instead of aborting with the sentinel.  Skip checkpointing:
             # the terminal DispatchError still reports checkpointed=False
-            # and the stream still ends.
+            # and the stream still ends.  (PERIODIC checkpoints —
+            # Controller._maybe_checkpoint — do fetch collectively: their
+            # turn cadence is deterministic in the dispatch schedule, so
+            # every process enters that allgather together; they are the
+            # resumable state a one-sided abort leaves behind.)
+            #
+            # The dispatch watchdog completes this divergence-safety
+            # policy: a one-sided failure leaves the SURVIVING processes
+            # blocked forcing a count whose collective the dead peer never
+            # joined.  With Params.dispatch_deadline_seconds set, each
+            # process's own watchdog raises DispatchTimeout (terminal:
+            # never retried), the stream ends with the sentinel, and
+            # run_distributed re-raises — every process aborts instead of
+            # hanging alone in the collective.
             return False
+
+        def _save_checkpoint(self, world, turn):
+            # Followers' sessions are throwaway and never consulted for
+            # resume; storing the allgathered board would pin a full-size
+            # host copy per follower per cadence.  The collective fetch
+            # itself already ran (SPMD lockstep) — only the session write
+            # is main-only, like _write_pgm above.
+            if main:
+                super()._save_checkpoint(world, turn)
 
         def _initial_world(self):
             if negotiated is not None:
@@ -280,8 +323,9 @@ def _run_distributed(params, events, key_presses, session):
             # failure while forcing it would make this process silently
             # read False while peers read True — divergent collective
             # schedules, a hang.  Abort with the stream sentinel instead
-            # (same policy as _park_checkpoint above).
-            return bool(flag)
+            # (same policy as _park_checkpoint above); the watchdog bounds
+            # the force itself, like every other blocking collective wait.
+            return self._watchdog.call(lambda: bool(flag))
 
         def _next_superstep(self, k, dt, superstep, warm_sizes, cap):
             # Deterministic adaptive sizing (round-3 verdict, missing-3):
@@ -297,8 +341,13 @@ def _run_distributed(params, events, key_presses, session):
                 superstep = super()._next_superstep(
                     k, dt, superstep, warm_sizes, cap
                 )
-            return int(
-                multihost_utils.broadcast_one_to_all(np.int32(superstep))
+            # Watchdog-bounded like the keys broadcast: this collective
+            # runs once per resolved dispatch and must not become the
+            # place a survivor hangs after a one-sided failure.
+            return self._watchdog.call(
+                lambda: int(
+                    multihost_utils.broadcast_one_to_all(np.int32(superstep))
+                )
             )
 
     MultihostController(params, ev, keys, session, backend).run()

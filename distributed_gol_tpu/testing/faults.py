@@ -1,0 +1,252 @@
+"""Deterministic, seedable fault injection at the dispatch seam.
+
+The reference system's failure story is one mechanism — the broker
+re-queues a failed worker RPC (``broker/broker.go:67-73``) — and its tests
+never exercise it.  The rebuild's controller has a real fault surface
+(retry policy, dispatch watchdog, periodic checkpoints; ``Params`` fault-
+tolerance knobs), and this module is the single way failures are produced
+to test it: a :class:`FaultPlan` is an explicit, dispatch-indexed schedule
+of faults, and :class:`FaultInjectionBackend` wraps ANY backend (single
+device, sharded mesh, multi-host) and injects the plan at the headless
+dispatch seam the controller's retry contract is built on
+(``Backend.run_turns_async`` / ``run_turns``).
+
+Fault kinds:
+
+- ``issue`` — the dispatch raises at issue time (a Python-level device
+  error; the sync retry path sees these too).
+- ``resolve`` — the dispatch issues fine but its on-device count raises
+  when forced (the async failure mode: the error surfaces dispatches
+  later, when the pipelined controller resolves it).
+- ``latency`` — the dispatch is delayed ``seconds`` before issuing (a
+  network/device latency spike; no error is raised).
+- ``hang`` — the dispatch issues fine but its count never resolves:
+  forcing it blocks (the wedged-device / wedged-collective mode the
+  dispatch watchdog exists for).  A safety timeout (``seconds``, default
+  30) bounds the injected hang itself so an abandoned watchdog thread
+  cannot outlive its test run.
+
+Determinism: a plan is a pure value.  Scripted plans are literal fault
+lists; :meth:`FaultPlan.random` derives the schedule from a seed via
+``random.Random`` (no global RNG, no wall-clock), so the same seed gives
+the bitwise-identical schedule on every host — one process of a
+multi-host run can be faulted while its peers run clean, repeatably.
+
+Dispatch indexing counts EVERY ``run_turns_async``/``run_turns`` call the
+controller makes, retries included — so consecutive indices model a burst
+that defeats the retry budget, and an index equal to a retry's position
+faults the retry itself.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Sequence
+
+FAULT_KINDS = ("issue", "resolve", "latency", "hang")
+
+# Injected hangs self-release after this long if nothing (watchdog, test
+# teardown) got there first: a leaked daemon thread must not outlive the
+# test session.
+DEFAULT_HANG_SECONDS = 30.0
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One scripted failure, striking the ``at``-th dispatch (0-based)."""
+
+    at: int
+    kind: str
+    seconds: float = 0.0  # latency duration / hang self-release timeout
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; one of {FAULT_KINDS}")
+        if self.at < 0:
+            raise ValueError(f"fault index must be >= 0, got {self.at}")
+        if self.seconds < 0:
+            raise ValueError(f"fault seconds must be >= 0, got {self.seconds}")
+
+
+class FaultPlan:
+    """An immutable dispatch-indexed fault schedule (at most one fault per
+    dispatch index — a "burst" is faults at consecutive indices)."""
+
+    def __init__(self, faults: Iterable[Fault] = ()):
+        by_index: dict[int, Fault] = {}
+        for f in faults:
+            if f.at in by_index:
+                raise ValueError(f"two faults scripted at dispatch {f.at}")
+            by_index[f.at] = f
+        self._by_index = by_index
+
+    def fault_at(self, dispatch: int) -> Fault | None:
+        return self._by_index.get(dispatch)
+
+    @property
+    def faults(self) -> tuple[Fault, ...]:
+        return tuple(sorted(self._by_index.values(), key=lambda f: f.at))
+
+    def __len__(self) -> int:
+        return len(self._by_index)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, FaultPlan) and self.faults == other.faults
+
+    def __repr__(self) -> str:
+        return f"FaultPlan({list(self.faults)!r})"
+
+    @classmethod
+    def random(
+        cls,
+        seed: int,
+        n_dispatches: int,
+        p_fault: float = 0.1,
+        kinds: Sequence[str] = ("issue", "resolve"),
+        burst: int = 1,
+        seconds: float = 0.0,
+    ) -> "FaultPlan":
+        """A seeded schedule over dispatches ``0..n_dispatches-1``: each
+        index independently starts a fault with probability ``p_fault``; a
+        started fault emits ``burst`` consecutive faults of one (seeded)
+        kind.  Same arguments, same plan — everywhere."""
+        if not 0.0 <= p_fault <= 1.0:
+            raise ValueError("p_fault must be in [0, 1]")
+        if burst < 1:
+            raise ValueError("burst must be >= 1")
+        rng = random.Random(seed)
+        faults: list[Fault] = []
+        i = 0
+        while i < n_dispatches:
+            if rng.random() < p_fault:
+                kind = kinds[rng.randrange(len(kinds))]
+                for j in range(i, i + burst):
+                    faults.append(Fault(j, kind, seconds=seconds))
+                i += burst
+            else:
+                i += 1
+        return cls(faults)
+
+    # -- the PLAN schema (bench.py --faults; docs/API.md "Fault tolerance") ----
+    @classmethod
+    def from_json(cls, spec: str) -> "FaultPlan":
+        """Build a plan from a JSON spec — the text itself or a path to a
+        file holding it.  Two forms:
+
+        scripted: ``{"faults": [{"at": 3, "kind": "issue"},
+                                {"at": 7, "kind": "latency", "seconds": 0.05}]}``
+        seeded:   ``{"seed": 0, "n_dispatches": 64, "p_fault": 0.1,
+                     "kinds": ["issue", "resolve"], "burst": 2}``
+
+        ``{}`` (or ``{"faults": []}``) is the empty plan — the clean-path
+        overhead measurement."""
+        text = str(spec)
+        try:
+            if Path(text).is_file():
+                text = Path(text).read_text()
+        except OSError:
+            pass  # inline JSON longer than a legal path name
+        obj = json.loads(text)
+        if not isinstance(obj, dict):
+            raise ValueError("fault plan must be a JSON object")
+        if "seed" in obj:
+            return cls.random(
+                int(obj["seed"]),
+                int(obj["n_dispatches"]),
+                p_fault=float(obj.get("p_fault", 0.1)),
+                kinds=tuple(obj.get("kinds", ("issue", "resolve"))),
+                burst=int(obj.get("burst", 1)),
+                seconds=float(obj.get("seconds", 0.0)),
+            )
+        return cls(
+            Fault(
+                int(f["at"]),
+                str(f["kind"]),
+                seconds=float(f.get("seconds", 0.0)),
+            )
+            for f in obj.get("faults", ())
+        )
+
+
+class _PoisonedScalar:
+    """Stands in for an on-device count whose computation died after issue:
+    resolution (``int()``) raises — the async failure mode."""
+
+    def __init__(self, error: str):
+        self._error = error
+
+    def __int__(self) -> int:
+        raise RuntimeError(self._error)
+
+
+class _HangingScalar:
+    """A count that never resolves: ``int()`` blocks until released (or the
+    safety timeout), then raises so nothing downstream mistakes the stale
+    value for a result."""
+
+    def __init__(self, release: threading.Event, seconds: float):
+        self._release = release
+        self._seconds = seconds or DEFAULT_HANG_SECONDS
+
+    def __int__(self) -> int:
+        self._release.wait(self._seconds)
+        raise RuntimeError("injected hang released")
+
+
+class FaultInjectionBackend:
+    """A :class:`FaultPlan`-driven wrapper around any backend.
+
+    Everything except the dispatch seam delegates to the wrapped backend,
+    so viewer paths, board placement, cycle probes, and engine/tier
+    resolution behave exactly as the real backend's — the harness changes
+    WHEN dispatches fail, never what they compute.
+
+    Observability for assertions and bench records: ``dispatches`` counts
+    every seam call, ``injected`` lists the faults that actually struck
+    (a plan can script faults past the end of a short run)."""
+
+    def __init__(self, inner, plan: FaultPlan):
+        self._inner = inner
+        self.plan = plan
+        self.dispatches = 0
+        self.injected: list[Fault] = []
+        self._release = threading.Event()
+
+    def __getattr__(self, name):
+        # Only consulted for names not defined on the wrapper: params,
+        # put/fetch, viewer dispatches, skip telemetry, _CYCLE_PERIOD...
+        return getattr(self._inner, name)
+
+    def release_hangs(self) -> None:
+        """Unblock every injected hang (test teardown: frees any watchdog
+        thread still parked in a hung force)."""
+        self._release.set()
+
+    def run_turns_async(self, board, turns: int):
+        i = self.dispatches
+        self.dispatches += 1
+        fault = self.plan.fault_at(i)
+        if fault is None:
+            return self._inner.run_turns_async(board, turns)
+        self.injected.append(fault)
+        if fault.kind == "issue":
+            raise RuntimeError(f"injected issue-time failure (dispatch {i})")
+        if fault.kind == "latency":
+            time.sleep(fault.seconds)
+            return self._inner.run_turns_async(board, turns)
+        new_board, count = self._inner.run_turns_async(board, turns)
+        if fault.kind == "resolve":
+            return new_board, _PoisonedScalar(
+                f"injected resolve-time failure (dispatch {i})"
+            )
+        return new_board, _HangingScalar(self._release, fault.seconds)
+
+    def run_turns(self, board, turns: int):
+        # Through the seam above so retries are counted (and faultable).
+        new_board, count = self.run_turns_async(board, turns)
+        return new_board, int(count)

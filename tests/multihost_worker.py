@@ -42,6 +42,8 @@ def main():
         return adaptive_main(coordinator, nprocs, pid, okfile, sys.argv[6])
     if mode == "frontier":
         return frontier_main(coordinator, nprocs, pid, okfile, sys.argv[6])
+    if mode == "faults":
+        return faults_main(coordinator, nprocs, pid, okfile, sys.argv[6])
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -453,6 +455,132 @@ def frontier_main(coordinator, nprocs, pid, okfile, out_dir):
         multihost.run_distributed(params, events2)
         t2.join(timeout=30)
     open(okfile, "w").write("ok")
+
+
+def faults_main(coordinator, nprocs, pid, okfile, out_dir):
+    """One-sided dispatch failure across processes (ISSUE 2 satellite):
+    process 1's backend injects an issue-time fault (retry_limit=0 keeps
+    its dispatch schedule short) and aborts; process 0 stays healthy, so
+    its next count force blocks in a collective its peer never joins — the
+    divergence mode that used to hang forever.  With a dispatch watchdog
+    armed, EVERY process must end its stream with the sentinel and abort
+    within the deadline: process 1 via the terminal DispatchError path,
+    process 0 via DispatchTimeout (or the transport surfacing the dead
+    collective, whichever gloo delivers first — both are bounded aborts).
+
+    The faulted peer stays alive (parked on its okfile wait) until the
+    survivor has also aborted, so the survivor genuinely exercises the
+    hung-collective wait rather than a torn-down transport."""
+    import queue
+    import threading
+    import time
+    import traceback
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import distributed_gol_tpu as gol
+    from distributed_gol_tpu.parallel import multihost
+    from distributed_gol_tpu.testing.faults import (
+        Fault,
+        FaultInjectionBackend,
+        FaultPlan,
+    )
+
+    try:
+        multihost.initialize(coordinator, nprocs, pid)
+        my_out = os.path.join(out_dir, f"p{pid}")
+        os.makedirs(my_out, exist_ok=True)
+        params = gol.Params(
+            turns=400,
+            image_width=64,
+            image_height=64,
+            soup_density=0.3,
+            out_dir=my_out,
+            superstep=10,
+            retry_limit=0,
+            dispatch_deadline_seconds=3.0,
+            cycle_check=0,
+            turn_events="batch",
+            ticker_period=60.0,
+        )
+        # The injection seam: only process 1's backend is wrapped — the
+        # fault is genuinely one-sided.
+        real_make = multihost.make_backend
+
+        def make_faulty(p):
+            backend = real_make(p)
+            if pid == 1:
+                backend = FaultInjectionBackend(
+                    backend, FaultPlan([Fault(4, "issue")])
+                )
+            return backend
+
+        multihost.make_backend = make_faulty
+
+        events: queue.Queue = queue.Queue()
+        sentinel = threading.Event()
+        seen = []
+
+        def pump():
+            while True:
+                e = events.get(timeout=120)
+                if e is None:
+                    sentinel.set()
+                    return
+                seen.append(e)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        t0 = time.monotonic()
+        err = None
+        try:
+            multihost.run_distributed(params, events)
+        except BaseException as e:  # noqa: BLE001 — the abort under test
+            err = e
+        elapsed = time.monotonic() - t0
+        assert err is not None, "one-sided failure must abort the run"
+        assert sentinel.wait(10), "stream did not end with the sentinel"
+        assert elapsed < 90, f"abort took {elapsed:.0f}s — watchdog must bound it"
+        if pid == 0:
+            # The survivor aborts by whichever bounded exit trips first —
+            # all three are clean sentinel aborts and which one wins is a
+            # race between the peer's teardown and the next collective:
+            #   (a) the watchdog on a control-plane broadcast
+            #       (DispatchTimeout, no dispatch failed → no DispatchError),
+            #   (b) a failed or timed-out dispatch (terminal DispatchError,
+            #       checkpoint skipped by the multi-host park policy),
+            #   (c) the transport noticing the dead peer first (a gloo
+            #       "connection closed" runtime error from a collective).
+            errors = [e for e in seen if isinstance(e, gol.DispatchError)]
+            if errors:
+                assert not errors[-1].will_retry, errors
+                assert not errors[-1].checkpointed
+            else:
+                assert isinstance(err, gol.DispatchTimeout) or (
+                    "closed" in str(err).lower()
+                    or "gloo" in str(err).lower()
+                    or "unavailable" in str(err).lower()
+                ), err
+        with open(okfile, "w") as f:
+            f.write("ok")
+        print(
+            f"[{pid}] one-sided failure: sentinel + abort in {elapsed:.1f}s "
+            f"({type(err).__name__}: {err})",
+            flush=True,
+        )
+    except BaseException:
+        traceback.print_exc()
+        os._exit(1)
+    # Wait for the peer's okfile so the transport stays up while IT aborts;
+    # then exit hard — abandoned watchdog waits and the distributed
+    # runtime's service threads must not wedge interpreter shutdown.
+    peer = os.path.join(os.path.dirname(okfile), f"ok{1 - pid}")
+    deadline = time.time() + 60
+    while not os.path.exists(peer) and time.time() < deadline:
+        time.sleep(0.5)
+    os._exit(0)
 
 
 if __name__ == "__main__":

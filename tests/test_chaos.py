@@ -1,0 +1,280 @@
+"""The chaos matrix (ISSUE 2 tentpole): execution tier × fault kind.
+
+Drives the deterministic fault harness (``testing.faults``) through the
+full controller on every execution tier — single device, sharded mesh on
+the per-turn ppermute engine, and the sharded adaptive ``pallas-packed``
+tier (the one that hosts the in-kernel ICI exchange on TPU meshes; on this
+CPU rig the tier policy records its ppermute strip form, the same
+controller/backend seam) — and asserts the fault-tolerance contract: every
+injected failure ends in either a **bit-identical recovery** against the
+fault-free oracle, or a **clean sentinel-terminated abort with a valid
+resumable checkpoint** whose resumed run lands back on the oracle board.
+Never a hang (the dispatch watchdog + the conftest faulthandler guard
+bound every case), never silent corruption (a torn checkpoint write is
+detected by its CRC and skipped for an older intact pair).
+
+Marked ``chaos`` (registered in pytest.ini) so the failure-path suite can
+be run alone: ``pytest -m chaos``.
+"""
+
+import queue
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.engine.backend import Backend
+from distributed_gol_tpu.engine.controller import DispatchTimeout
+from distributed_gol_tpu.engine.events import CheckpointSaved, DispatchError
+from distributed_gol_tpu.engine.pgm import read_pgm
+from distributed_gol_tpu.engine.session import Session
+from distributed_gol_tpu.testing.faults import (
+    Fault,
+    FaultInjectionBackend,
+    FaultPlan,
+)
+
+pytestmark = pytest.mark.chaos
+
+# Each tier: 6 dispatches of `superstep` turns on a seeded soup.  Explicit
+# superstep + cycle_check=0 keep the dispatch schedule (= fault-plan
+# indices) exact and identical across the faulted run and the oracle.
+TIERS = {
+    "single": dict(
+        engine="roll", mesh_shape=(1, 1), image_width=16, image_height=16,
+        superstep=4, turns=24,
+    ),
+    "sharded-ppermute": dict(
+        engine="packed", mesh_shape=(8, 1), image_width=64, image_height=64,
+        superstep=5, turns=30,
+    ),
+    "ici-adaptive": dict(
+        engine="pallas-packed", mesh_shape=(2, 1), skip_stable=True,
+        image_width=128, image_height=64, superstep=6, turns=36,
+    ),
+}
+
+
+def tier_params(tier, out_dir, **kw):
+    cfg = dict(TIERS[tier])
+    cfg.update(
+        soup_density=0.25,
+        soup_seed=11,
+        out_dir=out_dir,
+        cycle_check=0,
+        ticker_period=60.0,
+    )
+    cfg.update(kw)
+    return gol.Params(**cfg)
+
+
+def drain(events):
+    out = []
+    while (e := events.get(timeout=60)) is not None:
+        out.append(e)
+    return out
+
+
+def run_ok(params, backend=None, session=None):
+    session = session if session is not None else Session()
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events, session=session, backend=backend)
+    return drain(events), session
+
+
+def run_aborting(params, backend, session, exc=RuntimeError):
+    events: queue.Queue = queue.Queue()
+    with pytest.raises(exc):
+        gol.run(params, events, session=session, backend=backend)
+    # The sentinel is guaranteed even on the abort path: this drain
+    # terminating (instead of timing out) IS the assertion.
+    return drain(events)
+
+
+@pytest.fixture(scope="module")
+def oracle(tmp_path_factory):
+    """Fault-free reference run per tier, computed once: (final event,
+    final board bytes) — the recovery target every chaos case compares
+    against."""
+    cache = {}
+
+    def get(tier):
+        if tier not in cache:
+            out = tmp_path_factory.mktemp(f"oracle-{tier}")
+            p = tier_params(tier, out)
+            stream, _ = run_ok(p)
+            final = [
+                e for e in stream if isinstance(e, gol.FinalTurnComplete)
+            ][0]
+            board = (out / f"{p.final_output_name}.pgm").read_bytes()
+            cache[tier] = (final, board)
+        return cache[tier]
+
+    return get
+
+
+def assert_matches_oracle(tier, params, stream, oracle):
+    want_final, want_board = oracle(tier)
+    final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)][0]
+    assert final.completed_turns == params.turns
+    assert sorted(final.alive) == sorted(want_final.alive)
+    got = (params.out_dir / f"{params.final_output_name}.pgm").read_bytes()
+    assert got == want_board, f"{tier}: final board differs from oracle"
+
+
+def resume_and_check(tier, tmp_path, session_dir_or_session, oracle):
+    """A fresh controller resumes from the parked checkpoint and must land
+    bit-identically on the oracle board."""
+    out = tmp_path / "resumed"
+    out.mkdir(exist_ok=True)
+    params = tier_params(tier, out)
+    session = (
+        session_dir_or_session
+        if isinstance(session_dir_or_session, Session)
+        else Session(session_dir_or_session)
+    )
+    stream, _ = run_ok(params, session=session)
+    assert_matches_oracle(tier, params, stream, oracle)
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_issue_fault_recovers_bit_identically(tier, tmp_path, oracle):
+    params = tier_params(tier, tmp_path)
+    backend = FaultInjectionBackend(Backend(params), FaultPlan([Fault(1, "issue")]))
+    stream, session = run_ok(params, backend)
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert [e.will_retry for e in errors] == [True]
+    assert_matches_oracle(tier, params, stream, oracle)
+    assert session.check_states(params.image_width, params.image_height) is None
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_resolve_fault_recovers_bit_identically(tier, tmp_path, oracle):
+    params = tier_params(tier, tmp_path)
+    backend = FaultInjectionBackend(
+        Backend(params), FaultPlan([Fault(1, "resolve")])
+    )
+    stream, session = run_ok(params, backend)
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert [e.will_retry for e in errors] == [True]
+    assert "resolve-time" in errors[0].error
+    assert_matches_oracle(tier, params, stream, oracle)
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_burst_aborts_cleanly_and_resumes(tier, tmp_path, oracle):
+    """A 2-failure burst defeats the default retry budget: sentinel-
+    terminated abort, last good board parked, resume lands on the oracle."""
+    params = tier_params(tier, tmp_path / "faulted")
+    (tmp_path / "faulted").mkdir()
+    backend = FaultInjectionBackend(
+        Backend(params), FaultPlan([Fault(2, "issue"), Fault(3, "issue")])
+    )
+    session = Session()
+    stream = run_aborting(params, backend, session)
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert [e.will_retry for e in errors] == [True, False]
+    assert errors[-1].checkpointed
+    ckpt = session.check_states(params.image_width, params.image_height)
+    assert ckpt is not None and 0 < ckpt.turn < params.turns
+    session.pause(True, world=ckpt.world, turn=ckpt.turn)  # re-park (consumed)
+    resume_and_check(tier, tmp_path, session, oracle)
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_hang_is_bounded_by_the_watchdog(tier, tmp_path, oracle):
+    """A dispatch that never resolves must abort via DispatchTimeout within
+    the deadline — sentinel, parked checkpoint, resumable — not wedge."""
+    params = tier_params(
+        tier, tmp_path / "faulted", dispatch_deadline_seconds=1.0
+    )
+    (tmp_path / "faulted").mkdir()
+    backend = FaultInjectionBackend(
+        Backend(params), FaultPlan([Fault(1, "hang", seconds=25.0)])
+    )
+    session = Session()
+    t0 = time.monotonic()
+    try:
+        stream = run_aborting(params, backend, session, exc=DispatchTimeout)
+        elapsed = time.monotonic() - t0
+        # Bounded abort: deadline + park + slack, nowhere near the 25 s hang.
+        assert elapsed < 15, f"watchdog abort took {elapsed:.1f}s"
+        errors = [e for e in stream if isinstance(e, DispatchError)]
+        assert len(errors) == 1 and not errors[0].will_retry  # never retried
+        assert errors[0].checkpointed
+    finally:
+        backend.release_hangs()
+    ckpt = session.check_states(params.image_width, params.image_height)
+    assert ckpt is not None and ckpt.turn == TIERS[tier]["superstep"]
+    session.pause(True, world=ckpt.world, turn=ckpt.turn)
+    resume_and_check(tier, tmp_path, session, oracle)
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_torn_checkpoint_skipped_for_older_intact_pair(tier, tmp_path, oracle):
+    """Periodic checkpoints + a mid-run abort leave rotated pairs on disk;
+    tearing the newest pairs (truncated world files — the crash-mid-write
+    artifact) must make a fresh process fall back to the newest INTACT
+    pair, warn once, and still land on the oracle board."""
+    ckpt_dir = tmp_path / "ckpt"
+    out = tmp_path / "faulted"
+    out.mkdir()
+    superstep = TIERS[tier]["superstep"]
+    params = tier_params(tier, out, checkpoint_every_turns=superstep)
+    backend = FaultInjectionBackend(
+        Backend(params), FaultPlan([Fault(2, "issue"), Fault(3, "issue")])
+    )
+    session = Session(ckpt_dir)
+    stream = run_aborting(params, backend, session)
+    assert [e for e in stream if isinstance(e, CheckpointSaved)]
+
+    # Two dispatches completed: rotated pairs at turns s and 2s, plus the
+    # terminal park (legacy stem) at 2s.  Tear the two newest worlds.
+    legacy = ckpt_dir / "checkpoint.pgm"
+    newest = ckpt_dir / f"checkpoint-{2 * superstep:012d}.pgm"
+    for path in (legacy, newest):
+        assert path.exists(), f"expected checkpoint world {path}"
+        path.write_bytes(path.read_bytes()[: max(8, path.stat().st_size // 2)])
+    intact = ckpt_dir / f"checkpoint-{superstep:012d}.pgm"
+    assert intact.exists()
+
+    # Fresh process analog: a new durable Session must skip the torn pairs
+    # (one-time warnings) and resume from turn s — never crash, never
+    # silently resume corrupt state.
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        resume_and_check(tier, tmp_path, Session(ckpt_dir), oracle)
+    torn = [w for w in caught if issubclass(w.category, RuntimeWarning)]
+    assert torn, "torn checkpoints should be warned about"
+
+
+def test_torn_sidecar_and_torn_world_degrade_to_no_checkpoint(tmp_path):
+    """Single-pair corruption (no rotation to fall back to): a truncated
+    sidecar or a truncated world file means 'no checkpoint' plus a one-time
+    warning — a fresh run starts from turn 0 instead of raising out of
+    resume negotiation."""
+    for kind in ("sidecar", "world"):
+        ckpt_dir = tmp_path / f"ckpt-{kind}"
+        s1 = Session(ckpt_dir)
+        s1.pause(True, world=np.zeros((16, 16), np.uint8), turn=9, rule="B3/S23")
+        victim = ckpt_dir / ("checkpoint.json" if kind == "sidecar" else "checkpoint.pgm")
+        victim.write_bytes(victim.read_bytes()[:10])
+
+        s2 = Session(ckpt_dir)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            assert s2.check_states(16, 16, "B3/S23") is None
+            assert s2.check_states(16, 16, "B3/S23") is None  # and again
+        warned = [w for w in caught if issubclass(w.category, RuntimeWarning)]
+        assert len(warned) == 1, f"{kind}: want exactly one warning, got {warned}"
+
+        # A faulted-but-checkpointless run still completes from turn 0.
+        out = tmp_path / f"out-{kind}"
+        out.mkdir()
+        params = tier_params("single", out)
+        events: queue.Queue = queue.Queue()
+        gol.run(params, events, session=s2)
+        final = [e for e in drain(events) if isinstance(e, gol.FinalTurnComplete)]
+        assert final and final[0].completed_turns == params.turns

@@ -132,6 +132,19 @@ def test_cli_multihost_run(tmp_path):
     assert not list(outs[1].iterdir()), "follower wrote files"
 
 
+def test_one_sided_failure_aborts_every_process(tmp_path):
+    """ISSUE 2 satellite: an injected one-sided dispatch failure (process
+    1's backend faults; process 0 stays healthy) must end in a bounded
+    abort with the stream sentinel on EVERY process — the survivor's
+    dispatch watchdog (Params.dispatch_deadline_seconds) breaks it out of
+    the collective its dead peer never joins, instead of the pre-watchdog
+    behaviour of hanging there forever (see multihost_worker.faults_main
+    for the per-process assertions, including the abort-time bound)."""
+    out = tmp_path / "out"
+    out.mkdir()
+    _launch_workers(tmp_path, "faults", extra=(str(out),))
+
+
 def test_two_process_frontier_parity(tmp_path):
     """Round-5 frontier strip kernel across a process-spanning mesh:
     skip_stable + superstep=0 on 512-row strips (frontier plan engaged),

@@ -1,14 +1,23 @@
-"""Host-level dispatch retry — the broker re-queue analog.
+"""Host-level dispatch retry policy — the broker re-queue analog, generalised.
 
 Reference: ``broker/broker.go:67-73`` re-queues a failed worker RPC back
 onto the publish channel (SURVEY.md §5 failure mechanism 2).  The TPU
-rebuild's equivalent: the controller retries a failed device superstep once
-from the last good board; a second failure parks that board as a paused
+rebuild's equivalent is a policy (ISSUE 2): the controller retries a failed
+device superstep from the last good board up to ``Params.retry_limit``
+times with deterministic exponential backoff; a terminal failure (retries
+exhausted / ``failure_budget`` spent) parks that board as a paused
 checkpoint on the session (resumable exactly like a 'q' detach) and the
 stream still ends with the sentinel.
+
+All failures here are produced by the deterministic fault-injection
+harness (``distributed_gol_tpu.testing.faults``), which replaced the
+ad-hoc flaky backends this file used to carry; the full tier × fault-kind
+matrix lives in ``test_chaos.py``.  Boards are seeded soups, so the suite
+is hermetic (no reference data needed).
 """
 
 import queue
+import time
 
 import numpy as np
 import pytest
@@ -17,74 +26,32 @@ import distributed_gol_tpu as gol
 from distributed_gol_tpu.engine.backend import Backend
 from distributed_gol_tpu.engine.events import DispatchError
 from distributed_gol_tpu.engine.session import Session
+from distributed_gol_tpu.testing.faults import (
+    Fault,
+    FaultInjectionBackend,
+    FaultPlan,
+)
+from distributed_gol_tpu.utils.soup import random_soup
 
 
-class FlakyBackend(Backend):
-    """Injects ``fail`` consecutive dispatch failures, then works.
-
-    Overrides ``run_turns_async`` — the seam both the pipelined headless
-    path and the sync ``run_turns`` retry path go through — so a failure
-    here surfaces at issue time, like a Python-level dispatch error."""
-
-    def __init__(self, params, fail: int):
-        super().__init__(params)
-        self.failures_left = fail
-        self.dispatches = 0
-
-    def run_turns_async(self, board, turns):
-        self.dispatches += 1
-        if self.failures_left:
-            self.failures_left -= 1
-            raise RuntimeError("injected device failure")
-        return super().run_turns_async(board, turns)
-
-
-class _PoisonCount:
-    """A device-count stand-in whose resolution fails — models a dispatch
-    that issues fine but whose computation dies on device (the async
-    failure mode: the error surfaces when the count is forced)."""
-
-    def __init__(self, real, poisoned: bool):
-        self._real = real
-        self._poisoned = poisoned
-
-    def __int__(self):
-        if self._poisoned:
-            raise RuntimeError("injected resolve-time failure")
-        return int(self._real)
-
-
-class ResolveFlakyBackend(Backend):
-    """Injects ``fail`` dispatches whose counts fail to RESOLVE (the board
-    result is also poisoned conceptually; the controller must discard any
-    dispatch speculatively issued on top of it)."""
-
-    def __init__(self, params, fail: int):
-        super().__init__(params)
-        self.failures_left = fail
-        self.dispatches = 0
-
-    def run_turns_async(self, board, turns):
-        self.dispatches += 1
-        new_board, count = super().run_turns_async(board, turns)
-        if self.failures_left:
-            self.failures_left -= 1
-            return new_board, _PoisonCount(count, True)
-        return new_board, count
-
-
-def make_params(tmp_path, input_images, **kw):
+def make_params(tmp_path, **kw):
     defaults = dict(
         turns=20,
         image_width=16,
         image_height=16,
-        images_dir=input_images,
+        soup_density=0.3,
+        soup_seed=7,
         out_dir=tmp_path,
         superstep=5,
         engine="roll",
+        cycle_check=0,  # keep the dispatch schedule = plan indices exact
     )
     defaults.update(kw)
     return gol.Params(**defaults)
+
+
+def faulty(params, faults):
+    return FaultInjectionBackend(Backend(params), FaultPlan(faults))
 
 
 def drain(events):
@@ -94,28 +61,34 @@ def drain(events):
     return out
 
 
-def reference_final(params, tmp_path, input_images):
-    """The same run through an unfaulted backend, for comparison."""
-    events: queue.Queue = queue.Queue()
-    gol.run(make_params(tmp_path / "ref", input_images), events)
-    final = [e for e in drain(events) if isinstance(e, gol.FinalTurnComplete)]
-    return final[0]
-
-
-def test_single_failure_is_retried_and_run_completes(tmp_path, input_images):
-    (tmp_path / "ref").mkdir()
-    params = make_params(tmp_path, input_images)
-    want = reference_final(params, tmp_path, input_images)
-
-    backend = FlakyBackend(params, fail=1)
-    session = Session()
+def run_collecting(params, backend=None, session=None):
+    session = session if session is not None else Session()
     events: queue.Queue = queue.Queue()
     gol.run(params, events, session=session, backend=backend)
-    stream = drain(events)
+    return drain(events), session
+
+
+def reference_final(params, tmp_path):
+    """The same run through an unfaulted backend, for comparison."""
+    from dataclasses import replace
+
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir(exist_ok=True)
+    stream, _ = run_collecting(replace(params, out_dir=ref_dir))
+    return [e for e in stream if isinstance(e, gol.FinalTurnComplete)][0]
+
+
+def test_single_failure_is_retried_and_run_completes(tmp_path):
+    params = make_params(tmp_path)
+    want = reference_final(params, tmp_path)
+
+    backend = faulty(params, [Fault(0, "issue")])
+    stream, session = run_collecting(params, backend)
 
     errors = [e for e in stream if isinstance(e, DispatchError)]
     assert len(errors) == 1 and errors[0].will_retry
-    assert "injected device failure" in errors[0].error
+    assert errors[0].attempt == 1
+    assert "injected issue-time failure" in errors[0].error
 
     final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)]
     assert len(final) == 1
@@ -126,13 +99,15 @@ def test_single_failure_is_retried_and_run_completes(tmp_path, input_images):
     assert session.check_states(16, 16) is None
 
 
-def test_double_failure_checkpoints_and_aborts(tmp_path, input_images):
-    params = make_params(tmp_path, input_images, superstep=4)
-    backend = FlakyBackend(params, fail=2)
+def test_double_failure_checkpoints_and_aborts(tmp_path):
+    params = make_params(tmp_path, superstep=4)
+    # The retry (dispatch 1) is faulted too: a burst that defeats the
+    # default retry_limit=1 budget.
+    backend = faulty(params, [Fault(0, "issue"), Fault(1, "issue")])
     session = Session()
     events: queue.Queue = queue.Queue()
 
-    with pytest.raises(RuntimeError, match="injected device failure"):
+    with pytest.raises(RuntimeError, match="injected issue-time failure"):
         gol.run(params, events, session=session, backend=backend)
 
     # Sentinel guaranteed even on the failure path.
@@ -142,31 +117,26 @@ def test_double_failure_checkpoints_and_aborts(tmp_path, input_images):
 
     errors = [e for e in stream if isinstance(e, DispatchError)]
     assert [e.will_retry for e in errors] == [True, False]
+    assert [e.attempt for e in errors] == [1, 2]
     assert errors[1].checkpointed
 
     # The parked checkpoint is the untouched initial board at turn 0,
     # resumable by a fresh controller (the 'q'-detach contract).
     ckpt = session.check_states(16, 16)
     assert ckpt is not None and ckpt.turn == 0
-    from distributed_gol_tpu.engine.pgm import read_pgm
-
-    start = read_pgm(input_images / "16x16.pgm")
+    start = random_soup(16, 16, 0.3, 7)
     assert np.array_equal(ckpt.world, start)
 
 
-def test_resolve_time_failure_is_retried(tmp_path, input_images):
+def test_resolve_time_failure_is_retried(tmp_path):
     """A dispatch that issues fine but dies on device surfaces when its
     count is forced; the pipelined controller must retry it AND discard
     the dispatch it speculatively issued on the poisoned board."""
-    (tmp_path / "ref").mkdir()
-    params = make_params(tmp_path, input_images)
-    want = reference_final(params, tmp_path, input_images)
+    params = make_params(tmp_path)
+    want = reference_final(params, tmp_path)
 
-    backend = ResolveFlakyBackend(params, fail=1)
-    session = Session()
-    events: queue.Queue = queue.Queue()
-    gol.run(params, events, session=session, backend=backend)
-    stream = drain(events)
+    backend = faulty(params, [Fault(0, "resolve")])
+    stream, session = run_collecting(params, backend)
 
     errors = [e for e in stream if isinstance(e, DispatchError)]
     assert len(errors) == 1 and errors[0].will_retry
@@ -182,12 +152,15 @@ def test_resolve_time_failure_is_retried(tmp_path, input_images):
     assert session.check_states(16, 16) is None
 
 
-def test_resolve_time_terminal_failure_checkpoints(tmp_path, input_images):
-    """fail=3: the first resolve fails, its speculative successor is
-    poisoned too (discarded), and the sync retry also fails -> park the
-    last good board, emit the terminal DispatchError, raise."""
-    params = make_params(tmp_path, input_images, superstep=4)
-    backend = ResolveFlakyBackend(params, fail=3)
+def test_resolve_time_terminal_failure_checkpoints(tmp_path):
+    """A resolve-time burst: the first resolve fails, its speculative
+    successor is poisoned too (discarded), and the sync retry also fails
+    -> park the last good board, emit the terminal DispatchError, raise."""
+    params = make_params(tmp_path, superstep=4)
+    backend = faulty(
+        params,
+        [Fault(0, "resolve"), Fault(1, "resolve"), Fault(2, "resolve")],
+    )
     session = Session()
     events: queue.Queue = queue.Queue()
 
@@ -204,18 +177,13 @@ def test_resolve_time_terminal_failure_checkpoints(tmp_path, input_images):
     assert ckpt is not None and ckpt.turn == 0
 
 
-def test_failure_mid_run_checkpoints_last_good_turn(tmp_path, input_images):
-    """Failures after progress park the *latest* completed board."""
-    params = make_params(tmp_path, input_images, superstep=4, turns=20)
-
-    class FailAfter(FlakyBackend):
-        def run_turns_async(self, board, turns):
-            # Succeed twice (8 turns), then fail the rest of the run.
-            if self.dispatches >= 2:
-                self.failures_left = 2
-            return super().run_turns_async(board, turns)
-
-    backend = FailAfter(params, fail=0)
+def test_failure_mid_run_checkpoints_last_good_turn(tmp_path):
+    """Failures after progress park the *latest* completed board, and a
+    fresh run resumes from it."""
+    params = make_params(tmp_path, superstep=4, turns=20)
+    # Dispatches 0 and 1 succeed (8 turns); dispatch 2 fails at issue and
+    # its retry (dispatch 3) fails too.
+    backend = faulty(params, [Fault(2, "issue"), Fault(3, "issue")])
     session = Session()
     events: queue.Queue = queue.Queue()
     with pytest.raises(RuntimeError):
@@ -227,8 +195,129 @@ def test_failure_mid_run_checkpoints_last_good_turn(tmp_path, input_images):
     assert ckpt is not None and ckpt.turn == 8
 
     # And a fresh run resumes from it, finishing the remaining turns.
-    events2: queue.Queue = queue.Queue()
-    gol.run(make_params(tmp_path, input_images, turns=20), events2, session=session)
-    stream = [e for e in drain(events2)]
+    session.pause(True, world=ckpt.world, turn=ckpt.turn)
+    stream, _ = run_collecting(make_params(tmp_path, turns=20), session=session)
     final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)][0]
     assert final.completed_turns == 20
+
+
+# -- the configurable policy (ISSUE 2) ----------------------------------------
+
+
+def test_retry_limit_exhausts_a_longer_burst(tmp_path):
+    """retry_limit=3 survives a 3-failure burst that would kill the
+    default policy; every attempt is announced with its count."""
+    params = make_params(tmp_path, retry_limit=3)
+    want = reference_final(params, tmp_path)
+
+    backend = faulty(
+        params, [Fault(0, "issue"), Fault(1, "issue"), Fault(2, "issue")]
+    )
+    stream, session = run_collecting(params, backend)
+
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert [e.will_retry for e in errors] == [True, True, True]
+    assert [e.attempt for e in errors] == [1, 2, 3]
+    final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)][0]
+    assert sorted(final.alive) == sorted(want.alive)
+    assert session.check_states(16, 16) is None
+
+
+def test_retry_limit_zero_is_terminal_immediately(tmp_path):
+    params = make_params(tmp_path, retry_limit=0, superstep=4)
+    backend = faulty(params, [Fault(0, "issue")])
+    session = Session()
+    events: queue.Queue = queue.Queue()
+    with pytest.raises(RuntimeError, match="injected issue-time failure"):
+        gol.run(params, events, session=session, backend=backend)
+    stream = []
+    while (e := events.get(timeout=5)) is not None:
+        stream.append(e)
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert [e.will_retry for e in errors] == [False]
+    assert errors[0].attempt == 1 and errors[0].checkpointed
+    assert backend.dispatches == 1  # no retry dispatch was issued
+    assert session.check_states(16, 16) is not None
+
+
+def test_backoff_is_deterministic_exponential(tmp_path):
+    """base=0.05: the two retries sleep 0.05 then 0.1 seconds — the run
+    must take at least their sum; the (tight) cap clamps the second."""
+    params = make_params(
+        tmp_path,
+        retry_limit=3,
+        retry_backoff_seconds=0.05,
+        retry_backoff_max_seconds=0.08,
+    )
+    backend = faulty(params, [Fault(0, "issue"), Fault(1, "issue")])
+    t0 = time.perf_counter()
+    stream, _ = run_collecting(params, backend)
+    elapsed = time.perf_counter() - t0
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert [e.attempt for e in errors] == [1, 2]
+    # attempt-1 retry sleeps 0.05, attempt-2 retry sleeps min(0.1, 0.08).
+    assert elapsed >= 0.13
+    assert [e for e in stream if isinstance(e, gol.FinalTurnComplete)]
+
+
+def test_failure_budget_caps_a_flapping_run(tmp_path):
+    """failure_budget=1: the first failure retries, the second (over
+    budget) is terminal even though retry_limit would allow more."""
+    params = make_params(
+        tmp_path, retry_limit=5, failure_budget=1, superstep=4
+    )
+    backend = faulty(
+        params, [Fault(1, "issue"), Fault(3, "issue")]
+    )  # two separated transients
+    session = Session()
+    events: queue.Queue = queue.Queue()
+    with pytest.raises(RuntimeError):
+        gol.run(params, events, session=session, backend=backend)
+    stream = []
+    while (e := events.get(timeout=5)) is not None:
+        stream.append(e)
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert [e.will_retry for e in errors] == [True, False]
+    # The terminal failure still parked a resumable checkpoint mid-run.
+    ckpt = session.check_states(16, 16)
+    assert ckpt is not None and ckpt.turn > 0
+
+
+def test_latency_fault_is_not_a_failure(tmp_path):
+    params = make_params(tmp_path)
+    want = reference_final(params, tmp_path)
+    backend = faulty(params, [Fault(1, "latency", seconds=0.05)])
+    stream, _ = run_collecting(params, backend)
+    assert not [e for e in stream if isinstance(e, DispatchError)]
+    final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)][0]
+    assert sorted(final.alive) == sorted(want.alive)
+
+
+# -- the plan value itself ----------------------------------------------------
+
+
+def test_fault_plan_seeded_determinism_and_json_round_trip():
+    a = FaultPlan.random(42, 64, p_fault=0.25, kinds=("issue", "hang"), burst=2)
+    b = FaultPlan.random(42, 64, p_fault=0.25, kinds=("issue", "hang"), burst=2)
+    assert a == b and len(a) > 0
+    c = FaultPlan.random(43, 64, p_fault=0.25, kinds=("issue", "hang"), burst=2)
+    assert a != c  # a different seed is a different schedule
+
+    spec = (
+        '{"seed": 42, "n_dispatches": 64, "p_fault": 0.25,'
+        ' "kinds": ["issue", "hang"], "burst": 2}'
+    )
+    assert FaultPlan.from_json(spec) == a
+    scripted = FaultPlan.from_json(
+        '{"faults": [{"at": 3, "kind": "issue"},'
+        ' {"at": 7, "kind": "latency", "seconds": 0.05}]}'
+    )
+    assert scripted.fault_at(3).kind == "issue"
+    assert scripted.fault_at(7).seconds == 0.05
+    assert scripted.fault_at(4) is None
+    assert len(FaultPlan.from_json("{}")) == 0  # the clean-path plan
+
+    with pytest.raises(ValueError):
+        FaultPlan([Fault(1, "issue"), Fault(1, "resolve")])
+    with pytest.raises(ValueError):
+        Fault(0, "explode")

@@ -265,6 +265,256 @@ class TestKill:
         assert session.check_states(16, 16) is None
 
 
+class TestDurableCheckpointRobustness:
+    """ISSUE 2 satellites: atomic + checksummed persistence, keep-last-K
+    rotation, and corrupt-checkpoint degradation.  Hermetic (seeded soups,
+    no reference data)."""
+
+    def _board(self, seed=3):
+        rng = np.random.default_rng(seed)
+        return np.where(rng.random((16, 16)) < 0.3, 255, 0).astype(np.uint8)
+
+    def test_interrupted_persist_is_detected_not_resumed(self, tmp_path):
+        """The crash window `Session._persist` used to leave open: a new
+        world written but the sidecar not yet updated (or vice versa).
+        With world-before-meta ordering + the CRC32 sidecar, the stale
+        meta/world mismatch is detected and degrades to 'no checkpoint'
+        with a one-time warning — never a silent resume of torn state."""
+        import warnings
+
+        ckpt_dir = tmp_path / "ckpt"
+        s1 = Session(ckpt_dir)
+        s1.pause(True, world=self._board(1), turn=5, rule="B3/S23")
+        # Simulate the crash: a NEW world hit the disk (atomic in itself)
+        # but the process died before the sidecar commit.
+        from distributed_gol_tpu.engine.pgm import write_pgm
+
+        write_pgm(ckpt_dir / "checkpoint.pgm", self._board(2))
+
+        s2 = Session(ckpt_dir)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            assert s2.check_states(16, 16) is None
+            assert s2.check_states(16, 16) is None
+        warned = [w for w in caught if issubclass(w.category, RuntimeWarning)]
+        assert len(warned) == 1 and "CRC32" in str(warned[0].message)
+
+    def test_rotation_keeps_last_k_and_consumes_once(self, tmp_path):
+        ckpt_dir = tmp_path / "ckpt"
+        s1 = Session(ckpt_dir)
+        for turn in (4, 8, 12, 16, 20):
+            s1.save_checkpoint(self._board(turn), turn, rule="B3/S23", keep=3)
+        pairs = sorted(p.name for p in ckpt_dir.glob("checkpoint-*.json"))
+        assert len(pairs) == 3 and pairs[-1].startswith("checkpoint-")
+        assert not (ckpt_dir / "checkpoint-000000000004.json").exists()
+        assert not (ckpt_dir / "checkpoint-000000000004.pgm").exists()
+
+        # A fresh process adopts the newest pair...
+        s2 = Session(ckpt_dir)
+        ck = s2.check_states(16, 16, "B3/S23")
+        assert ck is not None and ck.turn == 20
+        assert np.array_equal(ck.world, self._board(20))
+        # ...and the consume covers the WHOLE rotation: another fresh
+        # process must not adopt an older pair of the same run.
+        assert Session(ckpt_dir).check_states(16, 16, "B3/S23") is None
+
+    def test_torn_newest_falls_back_to_previous_pair(self, tmp_path):
+        ckpt_dir = tmp_path / "ckpt"
+        s1 = Session(ckpt_dir)
+        s1.save_checkpoint(self._board(8), 8, keep=3)
+        s1.save_checkpoint(self._board(16), 16, keep=3)
+        torn = ckpt_dir / "checkpoint-000000000016.pgm"
+        torn.write_bytes(torn.read_bytes()[:20])  # crash mid-write artifact
+
+        import warnings
+
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            ck = Session(ckpt_dir).check_states(16, 16)
+        assert ck is not None and ck.turn == 8
+        assert np.array_equal(ck.world, self._board(8))
+        assert any(issubclass(w.category, RuntimeWarning) for w in caught)
+
+    def test_completed_run_discards_periodic_checkpoints(self, tmp_path):
+        """Periodic checkpoints are crash insurance, not detach state: a
+        run that COMPLETES must leave nothing to resume (same as today's
+        no-checkpoint contract for clean runs)."""
+        ckpt_dir = tmp_path / "ckpt"
+        session = Session(ckpt_dir)
+        params = gol.Params(
+            turns=20,
+            image_width=16,
+            image_height=16,
+            soup_density=0.3,
+            soup_seed=7,
+            out_dir=tmp_path,
+            superstep=5,
+            engine="roll",
+            cycle_check=0,
+            checkpoint_every_turns=5,
+        )
+        events: queue.Queue = queue.Queue()
+        gol.run(params, events, session=session)
+        stream = drain(events)
+        saves = [e for e in stream if isinstance(e, gol.CheckpointSaved)]
+        # One per due dispatch boundary, minus the final turn (the run
+        # ended there; the final PGM is the durable artifact).
+        assert [e.completed_turns for e in saves] == [5, 10, 15]
+        assert Session(ckpt_dir).check_states(16, 16) is None
+        assert not list(ckpt_dir.glob("checkpoint*"))
+
+    def test_discard_leaves_foreign_detach_checkpoint_parked(self, tmp_path):
+        """A completed run's discard must only remove ITS rotated pairs:
+        a 'q'-detach checkpoint of a different board size sharing the
+        directory stays claimable (the check_states mismatch contract)."""
+        ckpt_dir = tmp_path / "ckpt"
+        other = Session(ckpt_dir)  # run A: 32x32 detach, still parked
+        other.pause(True, world=np.zeros((32, 32), np.uint8), turn=7)
+
+        session = Session(ckpt_dir)
+        params = gol.Params(
+            turns=20,
+            image_width=16,
+            image_height=16,
+            soup_density=0.3,
+            soup_seed=7,
+            out_dir=tmp_path,
+            superstep=5,
+            engine="roll",
+            cycle_check=0,
+            checkpoint_every_turns=5,
+        )
+        events: queue.Queue = queue.Queue()
+        gol.run(params, events, session=session)  # 16x16: refuses A's pair
+        drain(events)
+        assert not list(ckpt_dir.glob("checkpoint-*")), "rotated pairs kept"
+        ck = Session(ckpt_dir).check_states(32, 32)
+        assert ck is not None and ck.turn == 7, "foreign detach pair lost"
+
+    def test_failed_save_rolls_back_and_completed_run_stays_clean(
+        self, tmp_path
+    ):
+        """An unwritable checkpoint dir: every periodic save fails — the
+        run must warn once and keep computing, and its COMPLETION must not
+        leave a stale resumable state (the failed save may not park the
+        in-memory slot)."""
+        import warnings
+
+        blocker = tmp_path / "blocked"
+        blocker.write_text("not a directory")  # mkdir() will raise
+        session = Session(blocker)
+        params = gol.Params(
+            turns=20,
+            image_width=16,
+            image_height=16,
+            soup_density=0.3,
+            soup_seed=7,
+            out_dir=tmp_path,
+            superstep=5,
+            engine="roll",
+            cycle_check=0,
+            checkpoint_every_turns=5,
+        )
+        events: queue.Queue = queue.Queue()
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            gol.run(params, events, session=session)
+        stream = drain(events)
+        final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)]
+        assert final and final[0].completed_turns == 20
+        warned = [
+            w
+            for w in caught
+            if issubclass(w.category, RuntimeWarning)
+            and "periodic checkpoint" in str(w.message)
+        ]
+        assert len(warned) == 1, warned  # once per run, not per cadence
+        assert not session.paused
+        assert session.check_states(16, 16) is None
+
+    def test_stale_consumed_record_does_not_shadow_newer_crash_pair(
+        self, tmp_path
+    ):
+        """A consumed sidecar left by an earlier (resumed) run must not
+        stop the scan: any pair still paused postdates that consume and
+        is the newer run's crash state."""
+        ckpt_dir = tmp_path / "ckpt"
+        s1 = Session(ckpt_dir)  # run 1: detached at turn 50, then resumed
+        s1.pause(True, world=self._board(50), turn=50)
+        assert Session(ckpt_dir).check_states(16, 16) is not None  # consume
+        s2 = Session(ckpt_dir)  # run 2: periodic pair at turn 10, "crash"
+        s2.save_checkpoint(self._board(10), 10)
+
+        ck = Session(ckpt_dir).check_states(16, 16)
+        assert ck is not None and ck.turn == 10
+        assert np.array_equal(ck.world, self._board(10))
+
+    def test_crash_resume_cycles_do_not_leak_rotated_pairs(self, tmp_path):
+        """keep-last-K must hold across restarts: once a resuming session
+        consumes the crashed run's pairs, its own saves GC them."""
+        ckpt_dir = tmp_path / "ckpt"
+        crashed = Session(ckpt_dir)
+        crashed.save_checkpoint(self._board(5), 5, keep=3)
+        crashed.save_checkpoint(self._board(10), 10, keep=3)
+        # Fresh process: adopt (marks the old pairs consumed)...
+        resumed = Session(ckpt_dir)
+        assert resumed.check_states(16, 16).turn == 10
+        # ...and its own periodic saves prune the dead pairs.
+        resumed.save_checkpoint(self._board(15), 15, keep=3)
+        stems = sorted(p.stem for p in ckpt_dir.glob("checkpoint-*.json"))
+        assert stems == ["checkpoint-000000000015"], stems
+
+    def test_shared_dir_scan_skips_foreign_pairs(self, tmp_path):
+        """A shared checkpoint dir: another controller's shape-mismatched
+        pair must neither shadow this controller's own (older-turn)
+        rotated pair nor be consumed by its adoption."""
+        ckpt_dir = tmp_path / "ckpt"
+        foreign = Session(ckpt_dir)  # run A: 32x32 detach at a NEWER turn
+        foreign.pause(True, world=np.zeros((32, 32), np.uint8), turn=50)
+        mine = Session(ckpt_dir)  # run B: 16x16 periodic pair, then "crash"
+        mine.save_checkpoint(self._board(10), 10, rule="B3/S23")
+
+        # Fresh 16x16 process: must find B's turn-10 pair despite A's
+        # newer foreign one...
+        ck = Session(ckpt_dir).check_states(16, 16, "B3/S23")
+        assert ck is not None and ck.turn == 10
+        # ...and consuming it must not touch A's pair.
+        ck_a = Session(ckpt_dir).check_states(32, 32)
+        assert ck_a is not None and ck_a.turn == 50
+
+    def test_wall_clock_cadence_checkpoints(self, tmp_path):
+        """checkpoint_every_seconds: latency-spiked dispatches (injected)
+        guarantee the clock advances past the cadence between dispatch
+        boundaries, so at least one periodic checkpoint lands."""
+        from distributed_gol_tpu.engine.backend import Backend
+        from distributed_gol_tpu.testing.faults import (
+            Fault,
+            FaultInjectionBackend,
+            FaultPlan,
+        )
+
+        params = gol.Params(
+            turns=20,
+            image_width=16,
+            image_height=16,
+            soup_density=0.3,
+            soup_seed=7,
+            out_dir=tmp_path,
+            superstep=5,
+            engine="roll",
+            cycle_check=0,
+            checkpoint_every_seconds=0.01,
+        )
+        plan = FaultPlan(Fault(i, "latency", seconds=0.03) for i in range(4))
+        backend = FaultInjectionBackend(Backend(params), plan)
+        session = Session()
+        events: queue.Queue = queue.Queue()
+        gol.run(params, events, session=session, backend=backend)
+        stream = drain(events)
+        assert [e for e in stream if isinstance(e, gol.CheckpointSaved)]
+        assert session.check_states(16, 16) is None  # completed => discarded
+
+
 def _drain_nonblocking(events):
     out = []
     while True:
