@@ -77,11 +77,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "frames (auto switches on board size)")
     ap.add_argument("--frame-max", default="512x512", metavar="HxW",
                     help="max size of a device-pooled viewer frame")
-    ap.add_argument("--frame-stride", type=int, default=1, metavar="N",
+    ap.add_argument("--frame-stride", type=int, default=0, metavar="N",
                     help="frame mode: exact generations per rendered frame "
                          "(each frame costs one host round-trip; stride N "
                          "multiplies wall-clock sim speed ~N on high-"
-                         "latency links)")
+                         "latency links).  Default 0 = latency-adaptive: "
+                         "the frame-fetch round-trip is measured at "
+                         "viewer start and the stride raised to match on "
+                         "slow links (local links keep a frame per turn)")
     ap.add_argument("--max-dispatch-seconds", type=float, default=0.25,
                     help="adaptive-superstep target per dispatch; bounds "
                          "keypress latency at ~2x this value")

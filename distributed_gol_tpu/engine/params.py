@@ -103,13 +103,17 @@ class Params:
     # Max (rows, cols) of a device-pooled viewer frame.
     frame_max: tuple[int, int] = (512, 512)
     # Generations per rendered frame in frame mode (exact simulation, the
-    # viewer samples every Nth turn).  1 = reference-faithful (a frame per
-    # turn).  Useful on high-latency host links: each frame costs one
-    # synchronous fetch round-trip (~100 ms through this rig's tunnel),
-    # so stride N multiplies the per-wall-clock simulation rate by ~N
-    # while the screen still updates at the same fps.  TurnComplete
-    # events stay dense.  Ignored outside frame mode.
-    frame_stride: int = 1
+    # viewer samples every Nth turn).  Each frame costs one synchronous
+    # fetch round-trip (~100 ms through a tunnelled rig), so stride N
+    # multiplies the per-wall-clock simulation rate by ~N while the
+    # screen still updates at the same fps.  TurnComplete events stay
+    # dense and exact at every stride.  0 (default) = LATENCY-ADAPTIVE:
+    # the controller measures the frame-fetch round-trip at viewer start
+    # and raises the effective stride on slow links (local links keep the
+    # reference-faithful frame-per-turn cadence; see
+    # Controller._auto_frame_stride for the policy).  An explicit N >= 1
+    # always wins.  Ignored outside frame mode.
+    frame_stride: int = 0
     # Whole-board cycle detection for headless runs: every N device
     # dispatches, probe (asynchronously, off the critical path) whether
     # advancing 6 generations reproduces the board exactly.  Once it does,
@@ -216,8 +220,10 @@ class Params:
         fh, fw = self.frame_max
         if fh < 1 or fw < 1:
             raise ValueError(f"frame_max must be positive, got {self.frame_max}")
-        if self.frame_stride < 1:
-            raise ValueError("frame_stride must be >= 1")
+        if self.frame_stride < 0:
+            raise ValueError(
+                "frame_stride must be >= 1, or 0 for latency-adaptive"
+            )
         ny, nx = self.mesh_shape
         if ny < 1 or nx < 1:
             raise ValueError(f"mesh_shape must be positive, got {self.mesh_shape}")
@@ -346,5 +352,9 @@ class Params:
         if self.wants_flips():
             return 1
         if self.wants_frames():
-            return self.frame_stride
+            # Latency-adaptive stride (0) plans as 1: the controller may
+            # raise the EFFECTIVE stride after measuring the link, but
+            # engine selection and dispatch planning must not assume a
+            # slow link that may not exist.
+            return max(1, self.frame_stride)
         return self.effective_superstep(False)

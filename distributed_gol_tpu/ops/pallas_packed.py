@@ -38,6 +38,7 @@ packed engine is test-gated (interpret mode hermetically; real hardware via
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from functools import partial
 
@@ -741,8 +742,132 @@ _EMPTY_LO = 1 << 30
 _COL_WINDOW = 256
 
 
+class PlanGeometry(tuple):
+    """The two static levers of the frontier megakernel plan (round 6):
+    ``(sub_margin, col_window)``.
+
+    - ``sub_margin``: the S-margin beyond ``4·T`` — the row sub-window is
+      ``S = round8(4·turns + sub_margin)``.  Eligibility needs
+      ``S ≥ cluster_rows + 4T + 35`` plus ≤ 8 rows of 8-alignment slack
+      (derivation: ``_frontier_placement``'s floor placement + the
+      ``±t6`` measure band), so the margin admits clusters up to about
+      ``sub_margin − 43`` rows before the stripe falls back to the full
+      window.  The shipped 96 admits ~53-row clusters; 64 admits ~21 —
+      settled-board residue is a few rows, so the smaller margin cuts the
+      dominant ``(T+6)·S·C`` compute term ~19% at T=18 per BASELINE's
+      decomposition, at the price of full-window fallbacks for mid-size
+      clusters.  Always sound: eligibility is checked dynamically and
+      exactly, a too-small window only changes which tier computes.
+    - ``col_window``: the column-tier width in words (one or two 128-word
+      placement quanta), or 0 to disable the tier.  128 halves the
+      compute term again but any cluster straddling a 128-word boundary
+      (placement is quantized) falls back to the row tier.
+
+    Candidate geometries are enumerated by :func:`geometry_candidates`;
+    :func:`set_plan_geometry` / :func:`plan_geometry_override` install
+    one process-wide (clearing the geometry-dependent kernel caches);
+    the retune pass in ``tools/decompose.py`` measures them with the
+    quiet protocol and interpret-mode bit-identity is test-gated for
+    every candidate (tests/test_adaptive_skip.py)."""
+
+    __slots__ = ()
+
+    def __new__(cls, sub_margin: int, col_window: int):
+        if sub_margin < 48 or sub_margin % 8:
+            raise ValueError(
+                f"sub_margin must be a multiple of 8 >= 48, got {sub_margin}"
+            )
+        if col_window and (col_window < 128 or col_window % 128):
+            raise ValueError(
+                f"col_window must be 0 (off) or a multiple of 128, got {col_window}"
+            )
+        return super().__new__(cls, (int(sub_margin), int(col_window)))
+
+    @property
+    def sub_margin(self) -> int:
+        return self[0]
+
+    @property
+    def col_window(self) -> int:
+        return self[1]
+
+    @property
+    def label(self) -> str:
+        return f"m{self.sub_margin}c{self.col_window or 'off'}"
+
+
+# The shipped default: the round-5 measured geometry.  The round-6 levers
+# (margin 64, C=128) ship as gated candidates — hw-compile-gated and
+# interpret-bit-identity-tested — installed by the retune pass when a
+# hardware sweep measures them ahead (BASELINE.md "quiet protocol").
+_GEOMETRY_SHIPPED = PlanGeometry(96, _COL_WINDOW)
+_plan_geometry = _GEOMETRY_SHIPPED
+
+
+def plan_geometry() -> PlanGeometry:
+    """The process-wide active frontier plan geometry."""
+    return _plan_geometry
+
+
+def geometry_candidates() -> list[PlanGeometry]:
+    """The retune/A-B candidate set, shipped default first: the round-5
+    geometry, the S-margin lever (4T+96 → 4T+64, i.e. c_max ~53 → ~21
+    rows), the C=128 column-window lever, and both combined."""
+    return [
+        _GEOMETRY_SHIPPED,
+        PlanGeometry(64, 256),
+        PlanGeometry(96, 128),
+        PlanGeometry(64, 128),
+    ]
+
+
+def set_plan_geometry(geometry: PlanGeometry | None) -> PlanGeometry:
+    """Install ``geometry`` (None = the shipped default) as the active
+    frontier plan geometry; returns the previous one.  Clears every
+    geometry-dependent kernel cache — here and in the sharded strip
+    module when it is loaded — so no cached build can keep serving a
+    stale plan shape (the caches key on everything else).
+
+    Scope contract: install BEFORE building engines (``make_superstep``
+    closures and Backend instances trace their kernels on first dispatch
+    and keep that trace in jit caches this function cannot see); the A/B
+    and retune flows build a fresh superstep per candidate inside
+    :func:`plan_geometry_override` for exactly this reason."""
+    global _plan_geometry
+    prev = _plan_geometry
+    if geometry is None:
+        geometry = _GEOMETRY_SHIPPED
+    if not isinstance(geometry, PlanGeometry):
+        geometry = PlanGeometry(*geometry)
+    _plan_geometry = geometry
+    _build_dispatch_frontier.cache_clear()
+    import sys
+
+    ph = sys.modules.get("distributed_gol_tpu.parallel.pallas_halo")
+    if ph is not None:
+        ph._build_dispatch_frontier_strip.cache_clear()
+        ph._build_ext_launch_frontier.cache_clear()
+    return prev
+
+
+@contextlib.contextmanager
+def plan_geometry_override(geometry: PlanGeometry | tuple):
+    """Scoped :func:`set_plan_geometry` — the A/B, retune-sweep, and
+    hw-compile-gate form."""
+    prev = set_plan_geometry(
+        geometry if isinstance(geometry, PlanGeometry) else PlanGeometry(*geometry)
+    )
+    try:
+        yield plan_geometry()
+    finally:
+        set_plan_geometry(prev)
+
+
 def _frontier_plan(
-    shape: tuple[int, int], turns: int, tile_cap: int | None
+    shape: tuple[int, int],
+    turns: int,
+    tile_cap: int | None,
+    geometry: PlanGeometry | None = None,
 ) -> tuple[int, int, int | None] | None:
     """(pad_f, sub_rows, col_window) for the frontier kernel, or None
     when the geometry can't host it (structural reasons only: no
@@ -760,7 +885,13 @@ def _frontier_plan(
     the megakernel, frontier measured faster at BOTH poles — settled
     16384² 561k vs 436k (T swept), settled 65536² 10.6k vs 6.1k gens/s —
     so the probing kernel is now only the structural fallback (geometry
-    can't host a frontier plan)."""
+    can't host a frontier plan).
+
+    Round 6: the static levers — the S-margin and the column-window
+    width — come from the active :class:`PlanGeometry` (``geometry``
+    overrides per call; callers inside the kernel builders leave it None
+    so one process-wide knob governs plan and telemetry alike)."""
+    geom = geometry if geometry is not None else _plan_geometry
     h, wp = shape
     tile_h = _tile_for_pad(h, wp, _round8(turns), tile_cap)
     if tile_h is None:
@@ -771,10 +902,11 @@ def _frontier_plan(
     if _PLANES * (tile_h + 2 * pad_f) * wp * 4 > _vmem_budget():
         return None
     h_ext_f = tile_h + 2 * pad_f
-    sub_rows = _round8(4 * turns + 96)
+    sub_rows = _round8(4 * turns + geom.sub_margin)
     if sub_rows + 64 > h_ext_f:
         return None
-    col_window = _COL_WINDOW if wp >= 2 * _COL_WINDOW else None
+    cw = geom.col_window
+    col_window = cw if cw and wp >= 2 * cw else None
     return pad_f, sub_rows, col_window
 
 

@@ -243,11 +243,16 @@ def cycle_main(coordinator, nprocs, pid, okfile, out_dir):
     my_out = os.path.join(out_dir, f"p{pid}")
     os.makedirs(my_out, exist_ok=True)
     turns = 10**6
+    # Hermetic (round 6): a seeded soup — every process generates the
+    # identical board, and the proof below is parity against a
+    # single-device run of the SAME params, so no reference mount is
+    # needed.  Seed 7 settles to period-<=6 ash by ~turn 600.
     params = gol.Params(
         turns=turns,
         image_width=64,
         image_height=64,
-        images_dir="/root/reference/images",
+        soup_density=0.3,
+        soup_seed=7,
         out_dir=my_out,
         superstep=10,
         turn_events="batch",
@@ -270,7 +275,7 @@ def cycle_main(coordinator, nprocs, pid, okfile, out_dir):
         assert len(cycles) == 1, cycles
         final = [e for e in seen if isinstance(e, gol.FinalTurnComplete)][0]
         assert final.completed_turns == turns
-        assert len(final.alive) == 101  # check/alive/64x64.csv steady state
+        assert len(final.alive) > 0  # settled ash, not an empty board
 
         # Single-device comparison run (same process, default backend).
         single_out = os.path.join(out_dir, "single")
@@ -318,11 +323,14 @@ def adaptive_main(coordinator, nprocs, pid, okfile, out_dir):
     my_out = os.path.join(out_dir, f"p{pid}")
     os.makedirs(my_out, exist_ok=True)
     turns = 10**6
+    # Hermetic seeded soup (round 6) — see cycle_main; the proof is
+    # parity against a single-device run of the same params.
     params = gol.Params(
         turns=turns,
         image_width=64,
         image_height=64,
-        images_dir="/root/reference/images",
+        soup_density=0.3,
+        soup_seed=7,
         out_dir=my_out,
         superstep=0,  # adaptive: the thing under test
         skip_stable=None,  # auto: resolves to the long-run policy
@@ -346,7 +354,7 @@ def adaptive_main(coordinator, nprocs, pid, okfile, out_dir):
 
         final = [e for e in seen if isinstance(e, gol.FinalTurnComplete)][0]
         assert final.completed_turns == turns
-        assert len(final.alive) == 101  # check/alive/64x64.csv steady state
+        assert len(final.alive) > 0  # settled ash, not an empty board
 
         # Single-device comparison run, same adaptive params: dispatch
         # partitioning never changes results, so byte-identity holds even

@@ -702,6 +702,96 @@ class TestColumnWindow:
         self._run_both(b, 8 * self._t())
 
 
+class TestPlanGeometryCandidates:
+    """Round-6 compute levers: every candidate ``PlanGeometry`` — the
+    S-margin 96→64 sweep and the C 256→128 column-window A/B — must be
+    bit-identical to the XLA packed engine in interpret mode at the
+    headline lane counts (wp = 512, the 16384² lane count; wp = 2048,
+    the 65536² one).  The boards place clusters where the NARROWED
+    windows differ from the shipped ones: a 128-word-quantum straddle
+    (C=128 must fall back where C=256 fits), a tall-ish cluster (the
+    64-margin row window must fall back where 96 fits), and plain
+    mid-board residue (the narrow windows engage).  Bit-identity makes
+    every fallback decision self-checking — a wrong eligibility either
+    way still has to produce the exact board."""
+
+    H, W = 2048, 16384  # wp = 512
+
+    def _t(self, shape=None):
+        t, adaptive = pallas_packed.adaptive_launch_depth(
+            shape or (self.H, self.W // 32), 960, 512
+        )
+        assert adaptive
+        return t
+
+    def _board(self):
+        b = np.zeros((self.H, self.W), dtype=np.uint8)
+        # Mid-board glider: the narrow windows engage.
+        for dy, dx in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+            b[700 + dy, 8000 + dx] = 255
+        # Straddles the 4096-cell (128-word) placement quantum: C=128
+        # cannot host it at floor placement, C=256 can.
+        b[600:602, 4090:4102:4] = 255
+        # A ~40-row vertical blinker fence: within the margin-96 row
+        # window's c_max (~53 rows), beyond margin-64's (~21) — the
+        # S-margin candidates must fall back here, shipped must not.
+        b[1500:1540:6, 2000:2003] = 255
+        return b
+
+    def _run_both(self, geom, b, turns):
+        p = packed.pack(jnp.asarray(b))
+        with pallas_packed.plan_geometry_override(geom):
+            got = pallas_packed.make_superstep(
+                CONWAY, interpret=True, skip_stable=True, skip_tile_cap=512
+            )(p, turns)
+            want = packed.superstep(p, CONWAY, turns)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    @pytest.mark.parametrize(
+        "geom", pallas_packed.geometry_candidates(), ids=lambda g: g.label
+    )
+    def test_wp512_candidate_bit_identical(self, geom):
+        t = self._t()
+        shape = (self.H, self.W // 32)
+        plan = pallas_packed._frontier_plan(shape, t, 512, geometry=geom)
+        assert plan is not None
+        assert plan[1] == pallas_packed._round8(4 * t + geom.sub_margin)
+        assert plan[2] == geom.col_window
+        self._run_both(geom, self._board(), 4 * t)
+
+    def test_wp2048_combined_levers_bit_identical(self):
+        # The 65536² lane count (wp = 2048) with both levers at once —
+        # the short board keeps interpret mode affordable; the lane
+        # geometry (placement quanta, window widths) is the headline one.
+        H, W = 1024, 65536
+        shape = (H, W // 32)
+        t = self._t(shape)
+        geom = pallas_packed.PlanGeometry(64, 128)
+        assert pallas_packed._frontier_plan(shape, t, 512, geometry=geom)[2] == 128
+        b = np.zeros((H, W), dtype=np.uint8)
+        for dy, dx in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+            b[300 + dy, 30000 + dx] = 255
+        b[800:802, 4094:4100] = 255  # quantum straddle
+        p = packed.pack(jnp.asarray(b))
+        with pallas_packed.plan_geometry_override(geom):
+            got = pallas_packed.make_superstep(
+                CONWAY, interpret=True, skip_stable=True, skip_tile_cap=512
+            )(p, 2 * t)
+            want = packed.superstep(p, CONWAY, 2 * t)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_override_scoping_and_validation(self):
+        shipped = pallas_packed.plan_geometry()
+        with pallas_packed.plan_geometry_override((64, 128)) as g:
+            assert pallas_packed.plan_geometry() == g == (64, 128)
+            assert g.label == "m64c128"
+        assert pallas_packed.plan_geometry() == shipped
+        with pytest.raises(ValueError):
+            pallas_packed.PlanGeometry(40, 128)  # margin below the floor
+        with pytest.raises(ValueError):
+            pallas_packed.PlanGeometry(96, 100)  # not a placement quantum
+
+
 def test_vmem_budget_platform_derivation(monkeypatch):
     """Round-4 verdict weak-4: the tuned VMEM budget must resolve per
     platform instead of silently running v5e capacity numbers.  CPU
@@ -722,8 +812,11 @@ def test_vmem_budget_platform_derivation(monkeypatch):
         assert pp._vmem_budget() == 100 << 20
         pp._vmem_physical.cache_clear()
         monkeypatch.delitem(pp._VMEM_BY_KIND, "TPU v99 test")
-        # Unknown generation: the 128 MB baseline (= v5e values).
-        assert pp._vmem_budget() == 50 << 20
+        # Unknown generation: the 128 MB baseline (= v5e values) — and
+        # the one-time un-swept-hardware warning, asserted here (an
+        # uncaptured escape is an error per pytest.ini).
+        with pytest.warns(RuntimeWarning, match="not in the VMEM table"):
+            assert pp._vmem_budget() == 50 << 20
     finally:
         pp._vmem_physical.cache_clear()
 

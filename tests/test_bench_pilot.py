@@ -1,0 +1,86 @@
+"""Tier-1 smoke for the bench/decompose harnesses (round-6 satellite):
+a bench-harness regression must fail tests, not burn a TPU session.
+
+``bench.py --pilot`` runs in a subprocess (the driver's real invocation
+path: stdout must carry exactly one lint-clean JSON line);
+``tools/decompose.py``'s pilot runs in-process (it shares this process's
+jax) — both at toy scale, both producing the full round-6 record shape.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+from distributed_gol_tpu.utils import measure  # noqa: E402
+
+
+def test_bench_pilot_record_shape():
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        GOL_BENCH_NO_PROBE="1",  # skip the wedged-backend probe subprocess
+        XLA_FLAGS="",  # no virtual mesh needed; keep startup lean
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--pilot"],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    # stdout is EXACTLY one JSON line (the driver contract).
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    record = json.loads(lines[0])
+    assert record["pilot"] is True
+    assert record["unit"] == "generations/sec"
+    # Every headline row carries {reps, median, spread} — the round-6
+    # acceptance bar, machine-checked.
+    assert measure.check_headline_stats(record) == []
+    assert record["reps"] >= 2 and record["median"] > 0
+    assert record["bit_identical"] is True
+    cp = record.get("controller_path")
+    assert cp is None or cp["median"] > 0
+
+
+def test_decompose_pilot_record_shape():
+    from tools import decompose
+
+    record = decompose.pilot_record()
+    assert record["pilot"] is True
+    assert measure.check_headline_stats(record) == []
+    # The decomposition structure: floor + settled + geometry A/B rows
+    # with bit-identity, the cap sweep, and the per-launch term fit.
+    assert record["floor"]["median"] > 0
+    assert record["settled"]["skip_fraction"] is not None
+    geoms = record["geometries"]
+    assert set(geoms) == {"m96c256", "m64c128"}
+    for row in geoms.values():
+        assert row["bit_identical"] is True
+        assert row["median"] > 0
+    assert record["col_window"] == 256  # wp=512: the column tier engages
+    assert geoms["m64c128"]["col_window"] == 128
+    terms = record["per_launch_terms"]
+    assert terms["floor_us_per_launch"] > 0
+    assert "us_per_active_stripe" in terms
+    assert record["caps"]["512"]["skip_fraction"] is not None
+
+
+def test_geometry_cli_spelling():
+    """bench.py --plan-geometry parses to the same PlanGeometry the
+    candidates enumerate (no subprocess: just the parse + install)."""
+    from distributed_gol_tpu.ops import pallas_packed as pp
+
+    prev = pp.plan_geometry()
+    try:
+        pp.set_plan_geometry(pp.PlanGeometry(64, 128))
+        assert pp.plan_geometry().label == "m64c128"
+        assert pp.plan_geometry() in pp.geometry_candidates()
+    finally:
+        pp.set_plan_geometry(prev)

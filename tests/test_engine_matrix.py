@@ -26,6 +26,10 @@ def used(engine, mesh=(1, 1), width=4096, height=64, **kw):
 # --- single device ---------------------------------------------------------
 
 
+# The matrix columns exercise documented downgrades on purpose; their
+# warnings are pinned by the dedicated tests below, so the columns ignore
+# them (pytest.ini escalates uncaptured engine warnings to errors).
+@pytest.mark.filterwarnings("ignore:engine :RuntimeWarning")
 def test_single_device_column():
     assert used("roll") == "roll"
     assert used("pallas") == "pallas"  # W % 128 == 0; interpret off-TPU
@@ -64,6 +68,7 @@ def test_row_mesh_column():
 # --- 2-D mesh --------------------------------------------------------------
 
 
+@pytest.mark.filterwarnings("ignore:engine :RuntimeWarning")
 def test_2d_mesh_column():
     assert used("roll", mesh=(2, 4)) == "roll"
     assert used("packed", mesh=(2, 4)) == "packed"
@@ -76,6 +81,7 @@ def test_2d_mesh_column():
     assert used("packed", mesh=(2, 4), width=2048 + 32) == "roll"
 
 
+@pytest.mark.filterwarnings("ignore:engine :RuntimeWarning")
 def test_unsupported_per_device_width_falls_to_roll():
     # 4104 / 4 = 1026, not a multiple of 32 -> word halos unsupported.
     assert used("packed", mesh=(1, 4), width=4104, height=64) == "roll"
@@ -123,4 +129,9 @@ def test_no_warning_when_engine_honoured_or_policy(recwarn):
     used("auto")  # CPU auto prefers packed and gets it
     used("auto", width=200)  # width unpackable by design: policy, not downgrade
     used("auto", no_vis=False, flip_events="cell")  # per-turn roll is policy
+    # Round-6 satellite: per-device strips narrower than one packed word
+    # (64 wide over 4 mesh columns -> 16 cells/device) are a documented
+    # capability bound — `auto` routing them to roll is policy.  This was
+    # the round-5 hermetic suite's 14-warning noise source.
+    assert used("auto", mesh=(2, 4), width=64, height=64) == "roll"
     assert not [w for w in recwarn if w.category is RuntimeWarning]

@@ -80,12 +80,16 @@ class TestEventQueueUnit:
 
 
 def _stream(events_queue, turns=20, **kw):
+    # Hermetic (round 6): a seeded soup instead of the reference images
+    # mount — this suite compares two runs of OUR system against each
+    # other, so it must run on rigs without /root/reference.
     kw.setdefault("cycle_check", 0)
     p = Params(
         turns=turns,
         image_width=64,
         image_height=64,
-        images_dir="/root/reference/images",
+        soup_density=0.3,
+        soup_seed=7,  # settles to ash (period <= 6) by ~turn 600
         out_dir=tempfile.mkdtemp(prefix="gol_evq_"),
         **kw,
     )
@@ -113,11 +117,18 @@ class TestEventQueueStreamParity:
         assert isinstance(fast[-1], StateChange)
 
     def test_cycle_fast_forward_stream_identical(self):
-        # 64² settles well inside 1000 turns; the fast-forward's chunked
+        # The seeded soup settles by ~turn 600; 5000 turns leaves the
+        # probe schedule (every 4 dispatches, forced a probe later) room
+        # to fire well before the end, and the fast-forward's chunked
         # emission must expand to the same dense stream.
-        plain = _comparable(_stream(queue.Queue(), turns=1000, cycle_check=4))
-        fast = _comparable(_stream(EventQueue(), turns=1000, cycle_check=4))
+        plain = _comparable(_stream(queue.Queue(), turns=5000, cycle_check=4))
+        fast = _comparable(_stream(EventQueue(), turns=5000, cycle_check=4))
         assert plain == fast
+        # The comparison only means something if the fast-forward really
+        # ran: the seeded soup must settle and the probe must fire.
+        from distributed_gol_tpu.engine.events import CycleDetected
+
+        assert any(isinstance(e, CycleDetected) for e in fast)
 
 
 class TestGetMany:

@@ -1,0 +1,163 @@
+"""The quiet-measurement protocol helpers (round 6, utils/measure.py):
+spread/median math pinned, amplification sizing, malformed-record
+rejection, and the on-device repeat loop — all deterministic on CPU."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_gol_tpu.models.life import CONWAY
+from distributed_gol_tpu.ops import packed
+from distributed_gol_tpu.utils import measure
+
+
+class TestStats:
+    def test_median_is_upper_median(self):
+        # The one convention every artifact row uses (sorted[n//2]) —
+        # the BENCH_ICI_PR1-era rows must stay comparable.
+        assert measure.median([3.0, 1.0, 2.0]) == 2.0
+        assert measure.median([4.0, 1.0, 2.0, 3.0]) == 3.0
+        assert measure.median([5.0]) == 5.0
+        with pytest.raises(ValueError):
+            measure.median([])
+
+    def test_spread_is_full_envelope_over_median(self):
+        assert measure.spread([100.0, 90.0, 110.0]) == pytest.approx(0.2)
+        assert measure.spread([7.0]) == 0.0
+
+    def test_summarize_block(self):
+        s = measure.summarize([90.0, 110.0, 100.0])
+        assert s == {
+            "reps": 3,
+            "median": 100.0,
+            "spread": pytest.approx(0.2),
+            "rates": [90.0, 100.0, 110.0],
+        }
+        assert measure.summarize([42.0])["spread"] == 0.0
+
+    def test_summarize_rejects_broken_measurements(self):
+        with pytest.raises(measure.MalformedRecord):
+            measure.summarize([])
+        with pytest.raises(measure.MalformedRecord):
+            measure.summarize([100.0, 0.0])
+        with pytest.raises(measure.MalformedRecord):
+            measure.summarize([100.0, float("nan")])
+        with pytest.raises(measure.MalformedRecord):
+            measure.summarize([-5.0])
+
+
+class TestAmplification:
+    def test_dwarfs_noise_and_target(self):
+        # 1 ms unit, 10 ms noise, default 20x mult -> 0.5 s target wins
+        # over 0.2 s of noise floor: 500 units.
+        assert measure.pick_amplification(0.001, 0.010) == 500
+        # Loud noise: 20 x 0.11 s = 2.2 s >> target -> 2200 units.
+        assert measure.pick_amplification(0.001, 0.110) == 2200
+        # Slow unit: one dispatch already dwarfs everything.
+        assert measure.pick_amplification(2.0, 0.110) == 2
+        assert measure.pick_amplification(10.0, 0.0) == 1
+
+    def test_cap_and_degenerate_unit(self):
+        assert measure.pick_amplification(1e-9, 0.1, cap=4096) == 4096
+        assert measure.pick_amplification(0.0, 0.1) == 4096
+        assert measure.pick_amplification(0.001, 0.0, target_seconds=0.25,
+                                          cap=100) == 100
+
+
+class TestHeadlineLint:
+    def _row(self, **kw):
+        row = {
+            "metric": "m",
+            "value": 1.0,
+            "reps": 3,
+            "median": 10.0,
+            "spread": 0.1,
+            "rates": [9.0, 10.0, 11.0],
+        }
+        row.update(kw)
+        return row
+
+    def test_clean_record_passes(self):
+        record = self._row(nested=self._row(), rows=[self._row(), {"no": 1}])
+        assert measure.check_headline_stats(record) == []
+        measure.require_headline_stats(record)  # no raise
+
+    def test_bare_single_sample_row_rejected(self):
+        # The round-5 shape: a metric with only a value — exactly what
+        # the acceptance bar outlaws.
+        problems = measure.check_headline_stats(
+            {"metric": "m", "value": 123.0}
+        )
+        assert problems and "reps" in problems[0]
+        with pytest.raises(measure.MalformedRecord):
+            measure.require_headline_stats({"metric": "m", "value": 123.0})
+
+    def test_malformed_blocks_rejected(self):
+        assert measure.check_headline_stats(self._row(reps=0))
+        assert measure.check_headline_stats(self._row(median=-1.0))
+        assert measure.check_headline_stats(self._row(median=None))
+        assert measure.check_headline_stats(self._row(spread=-0.1))
+        assert measure.check_headline_stats(self._row(spread=None))
+        assert measure.check_headline_stats(self._row(rates=[1.0]))  # != reps
+
+    def test_single_rep_row_may_omit_spread_only_as_zero(self):
+        # reps == 1 (pilot rows): spread None is tolerated, numbers are
+        # still required.
+        row = self._row(reps=1, spread=None, rates=[10.0])
+        assert measure.check_headline_stats(row) == []
+
+    def test_nested_violation_carries_path(self):
+        record = {"metric": "top", **self._row(), "inner": {"metric": "bad",
+                                                            "value": 1.0}}
+        problems = measure.check_headline_stats(record)
+        assert len(problems) == 1 and "$.inner" in problems[0]
+
+
+class TestRepeatLoop:
+    def test_device_repeat_matches_chained_supersteps(self, rng):
+        """The lax.fori_loop amplification is the SAME simulation: 4
+        on-device reps of 6 generations == one 24-generation superstep,
+        bit for bit (seeded board, packed engine, CPU)."""
+        b = np.where(rng.random((64, 64)) < 0.3, 255, 0).astype(np.uint8)
+        p = packed.pack(jnp.asarray(b))
+        run = lambda x, t: packed.superstep(x, CONWAY, t)  # noqa: E731
+        repeated = measure.device_repeat(run, 6, 4)
+        np.testing.assert_array_equal(
+            np.asarray(repeated(p)), np.asarray(run(p, 24))
+        )
+
+    def test_chain_issues_n_calls(self):
+        calls = []
+
+        def run(x):
+            calls.append(x)
+            return x + 1
+
+        assert measure.chain(run, 0, 5) == 5
+        assert calls == [0, 1, 2, 3, 4]
+
+    def test_quiet_rates_shape_and_accounting(self, rng):
+        """End-to-end on a real (tiny, CPU) engine: the stats block is
+        well-formed, rates are positive, and the protocol fields record
+        how quiet the measurement was."""
+        b = np.where(rng.random((64, 64)) < 0.3, 255, 0).astype(np.uint8)
+        p = packed.pack(jnp.asarray(b))
+        run = lambda x, t: packed.superstep(x, CONWAY, t)  # noqa: E731
+        p = run(p, 6)  # compile outside the measurement
+
+        def sync(x):
+            return np.asarray(x)[0, 0]
+
+        _, stats = measure.quiet_rates(
+            lambda x: run(x, 6),
+            p,
+            gens_per_call=6,
+            sync=sync,
+            reps=3,
+            target_seconds=0.02,
+        )
+        assert stats["reps"] == 3 and len(stats["rates"]) == 3
+        assert stats["median"] > 0 and stats["spread"] >= 0
+        assert stats["amp"] >= 1 and stats["unit_s"] > 0
+        assert measure.check_headline_stats({"metric": "m", **stats}) == []

@@ -16,6 +16,8 @@ from pathlib import Path
 
 import pytest
 
+from tests.conftest import needs_reference
+
 WORKER = Path(__file__).parent / "multihost_worker.py"
 
 
@@ -57,10 +59,13 @@ def test_two_process_mesh_bit_identical(tmp_path):
     _launch_workers(tmp_path, "dataplane")
 
 
+@needs_reference
 def test_two_process_full_controller_run(tmp_path):
     """The whole reference contract across processes: run_distributed on a
     2-process mesh — event stream, broadcast snapshot keypress, file-write
-    discipline, golden final PGM (see multihost_worker.controller_main)."""
+    discipline, golden final PGM (see multihost_worker.controller_main).
+    Golden-gated: needs the reference mount (the hermetic cross-process
+    proofs are the cycle/adaptive/frontier tests below)."""
     out = tmp_path / "out"
     out.mkdir()
     _launch_workers(tmp_path, "controller", extra=(str(out),))
@@ -87,6 +92,7 @@ def test_two_process_adaptive_superstep(tmp_path):
     _launch_workers(tmp_path, "adaptive", extra=(str(out),))
 
 
+@needs_reference
 def test_cli_multihost_run(tmp_path):
     """The CLI's multi-host mode: the same command on two 'hosts'
     (--process-id 0/1), golden-checked output from process 0."""

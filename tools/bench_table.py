@@ -65,17 +65,28 @@ def main():
                 continue
             # bench_config auto-calibrates the dispatch depth, so the
             # starting kturns only seeds the calibration.
-            gps, cups = bench_config(size, args.kturns or 256, engine, args.reps)
+            stats: dict = {}
+            gps, cups = bench_config(
+                size, args.kturns or 256, engine, args.reps, out_stats=stats
+            )
             ok = verify_engine(size, engine)
-            rows.append((size, engine, gps, cups, ok))
+            rows.append((size, engine, gps, cups, ok, stats.get("quiet", {})))
             engine_gps[size] = max(engine_gps.get(size, 0.0), gps)
 
-    print("| Board | Engine | gens/s | cell-updates/s | bit-identical |")
-    print("|---|---|---|---|---|")
-    for size, engine, gps, cups, ok in rows:
+    # Quiet-protocol columns (round 6): the table carries the same
+    # {reps, median, spread} every JSON artifact row does — a number
+    # without its spread is not a measurement on this rig's tunnel.
+    print(
+        "| Board | Engine | gens/s (median) | spread | reps | "
+        "cell-updates/s | bit-identical |"
+    )
+    print("|---|---|---|---|---|---|---|")
+    for size, engine, gps, cups, ok, q in rows:
+        spread = f"{q['spread']:.1%}" if q else "n/a"
+        reps = f"{q['reps']}x{q.get('amp', 1)}" if q else "n/a"
         print(
-            f"| {size}² | `{engine}` | {gps:,.0f} | {cups:.3e} | "
-            f"{'n/a' if ok is None else ok} |"
+            f"| {size}² | `{engine}` | {gps:,.0f} | {spread} | {reps} | "
+            f"{cups:.3e} | {'n/a' if ok is None else ok} |"
         )
 
     if not args.paths:
@@ -86,8 +97,8 @@ def main():
     # per dispatch (one compile, no adaptive ladder) for the headless
     # rows; the viewer rows are per-turn by construction.
     print()
-    print("| Board | Path | gens/s | vs engine |")
-    print("|---|---|---|---|")
+    print("| Board | Path | gens/s | spread | reps | vs engine |")
+    print("|---|---|---|---|---|---|")
     for size in sizes:
         best = engine_gps.get(size, 0.0)
         ss = superstep_for(best) if best else 0
@@ -95,11 +106,27 @@ def main():
         for label, kw in (
             ("run() batch", dict(turn_events="batch", superstep=ss)),
             ("run() per-turn", dict(turn_events="per-turn", superstep=ss)),
-            ("viewer frames", dict(view="frame")),
+            # frame_stride 0 = the round-6 latency-adaptive default (the
+            # stride-1 row it replaces was the round-5 9-fps-AND-9-gens/s
+            # wall on the tunnel); stride 1 pins the reference-faithful
+            # frame-per-turn cadence for comparison.
+            ("viewer frames (auto stride)", dict(view="frame")),
+            (
+                "viewer frames (stride 1)",
+                dict(view="frame", params_overrides=dict(frame_stride=1)),
+            ),
         ):
-            gps, turns = bench_controller_path(size, budget_seconds=budget, **kw)
+            st: dict = {}
+            gps, turns = bench_controller_path(
+                size, budget_seconds=budget, out_stats=st, **kw
+            )
             ratio = f"{gps / best:.0%}" if best else "n/a"
-            print(f"| {size}² | {label} | {gps:,.0f} | {ratio} |")
+            spread = f"{st['spread']:.1%}" if st else "n/a"
+            reps = st.get("reps", "n/a")
+            print(
+                f"| {size}² | {label} | {gps:,.0f} | {spread} | {reps} | "
+                f"{ratio} |"
+            )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,439 @@
+"""Settled-regime cost decomposition of the adaptive megakernel (round 6).
+
+The round-5 decomposition protocol (BASELINE.md "Settled 16384² cost
+decomposition") was hand-driven and single-sample; its two named compute
+levers — the S margin and the C=128 column window — were then dropped as
+"inside tunnel noise".  This tool is the protocol as code, on the quiet
+repeat-loop (``utils/measure.py``): every row is an on-device-amplified,
+repeated ``{reps, median, spread}`` record, so a few-percent lever is
+measurable through a ~110 ms-sync tunnel.
+
+What it separates, and how (by construction, not subtraction alone):
+
+- ``floor``: the all-dead board — every stripe skip-elides, so the row
+  measures the megakernel's irreducible per-launch cost (grid
+  sequencing, SMEM interval logistics, the skip bookkeeping).
+- ``settled``: the real settled board (``--load-board`` — the recorded
+  200k-gen 65536² protocol) or, on rigs without one, a synthetic
+  ash+glider proxy (``--proxy``; labelled, never published as settled).
+- ``geometry:<label>``: the same board re-measured under each candidate
+  ``PlanGeometry`` (the S-margin sweep and the C 256→128 A/B).  The
+  active-stripe window term scales with S·C while the floor does not, so
+  a least-squares fit over the candidate rows splits the per-active-
+  stripe cost into its S·C-scaled share (window compute + window DMA)
+  and its fixed share (launch logistics, measure reductions, fallbacks);
+  the roofline constants (tools/roofline.py, BASELINE.md) then price
+  compute vs DMA inside the scaled share.  Every candidate row also
+  records on-device bit-identity vs the XLA packed engine — a geometry
+  that is fast but wrong must die in the artifact, not in review.
+- ``cap:<rows>``: the skip-cap sensitivity sweep (the 65536² 0.88-skip
+  plateau question), with the measured skip fraction per cap.
+
+Usage (hardware, the 65536² recipe):
+    python tools/decompose.py --size 65536 --load-board b65k_200k.npy \
+        --reps 5
+Hermetic smoke (tier-1 runs this — machinery + record shape, toy scale):
+    python tools/decompose.py --pilot
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from distributed_gol_tpu.utils import measure  # noqa: E402
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _sync(x):
+    import jax
+
+    return np.asarray(jax.device_get(x.ravel()[0]))
+
+
+def proxy_settled_board(h: int, wp: int, seed: int = 11, gliders: int = 1):
+    """A synthetic settled-regime packed board: sparse ash (blocks +
+    blinkers, one cluster per ~cap rows) plus ``gliders`` gliders — the
+    shape of a long-settled soup without the 200k-generation burn-in.
+    Proxy rows are LABELLED proxy; they exercise the same code paths and
+    scale the same way, but published settled numbers must ride a real
+    burned-in board (``--load-board``)."""
+    import jax.numpy as jnp
+
+    w = wp * 32
+    b = np.zeros((h, w), dtype=np.uint8)
+    rng = np.random.default_rng(seed)
+    for y in range(64, h - 64, 256):
+        x = int(rng.integers(16, w - 16))
+        if y % 512:
+            b[y : y + 2, x : x + 2] = 255  # block
+        else:
+            b[y, x : x + 3] = 255  # blinker
+    for g in range(gliders):
+        y = int(h // 2 + 40 * g) % (h - 16)
+        x = int(rng.integers(w // 4, 3 * w // 4))
+        for dy, dx in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+            b[y + dy, x + dx] = 255
+    from distributed_gol_tpu.ops import packed
+
+    return packed.pack(jnp.asarray(b))
+
+
+def _quiet_row(run, board, turns, reps, target_seconds, device_reps=1):
+    """One decomposition row: ``device_reps`` supersteps fused into one
+    dispatch via ``lax.fori_loop`` (the strongest amplification — zero
+    per-iteration dispatch cost), then the chained-dispatch quiet
+    protocol on top."""
+    fn = measure.device_repeat(run, turns, device_reps) if device_reps > 1 else (
+        lambda b: run(b, turns)
+    )
+    board, stats = measure.quiet_rates(
+        fn,
+        board,
+        gens_per_call=turns * device_reps,
+        sync=_sync,
+        reps=reps,
+        target_seconds=target_seconds,
+    )
+    stats["device_reps"] = device_reps
+    return board, stats
+
+
+def decompose(
+    board,
+    *,
+    reps: int = 5,
+    kturns: int | None = None,
+    caps: tuple[int, ...] = (256, 512, 1024),
+    geometries: bool = True,
+    proxy: bool = False,
+    target_seconds: float = 1.0,
+    device_reps: int = 1,
+    identity_turns: int | None = None,
+    cap: int | None = None,
+) -> dict:
+    """Run the decomposition on a packed ``board`` (shape (H, W/32));
+    returns the artifact record (every row quiet-protocol-statted)."""
+    import jax.numpy as jnp
+
+    from distributed_gol_tpu.models.life import CONWAY
+    from distributed_gol_tpu.ops import packed, pallas_packed as pp
+
+    shape = tuple(board.shape)
+    h, wp = shape
+    size = f"{h}x{wp * 32}"
+    cap = cap or pp.default_skip_cap(h)
+    t, adaptive = pp.adaptive_launch_depth(shape, 10**6, cap)
+    if not adaptive or pp._frontier_plan(shape, t, cap) is None:
+        raise SystemExit(f"no frontier plan for {shape}: nothing to decompose")
+    kt = kturns or 24 * t  # several launches per dispatch...
+    kt -= kt % t  # ...and an exact multiple of the launch depth
+    plan = pp._frontier_plan(shape, t, cap)
+    tile = pp._plan_tile(shape, t, cap)
+    grid = h // tile
+    record: dict = {
+        "metric": f"gol_decompose_{size}",
+        "unit": "generations/sec",
+        "value": 0.0,  # settled median, filled below
+        "T": t,
+        "tile": tile,
+        "grid": grid,
+        "pad": plan[0],
+        "sub_rows": plan[1],
+        "col_window": plan[2],
+        "cap": cap,
+        "kturns": kt,
+        "proxy_board": proxy,
+    }
+
+    def runner(tile_cap=None):
+        # NB: the jit trace (and so the kernel build) happens on the
+        # first CALL, not here — geometry overrides must stay active
+        # around the whole per-candidate block, not just this factory.
+        return pp.make_superstep(
+            CONWAY,
+            skip_stable=True,
+            skip_tile_cap=tile_cap or cap,
+            with_stats=True,
+        )
+
+    # -- floor: all-dead board, every stripe elides -------------------------
+    dead = jnp.zeros_like(board)
+    run_s = runner()
+    run = lambda b, n: run_s(b, n)[0]  # noqa: E731
+    t0 = time.perf_counter()
+    dead = run(dead, kt)
+    _sync(dead)
+    log(f"  floor compile+first dispatch: {time.perf_counter() - t0:.1f}s")
+    dead, floor = _quiet_row(run, dead, kt, reps, target_seconds, device_reps)
+    record["floor"] = {
+        "metric": f"gol_decompose_{size}_floor",
+        "unit": "generations/sec",
+        "value": round(floor["median"], 2),
+        **floor,
+    }
+    log(f"  floor (all-dead): {floor['median']:,.0f} gens/s")
+
+    # -- settled (or proxy) board, shipped geometry -------------------------
+    t0 = time.perf_counter()
+    board = run(board, kt)
+    _sync(board)
+    log(f"  settled compile+first dispatch: {time.perf_counter() - t0:.1f}s")
+    board, settled = _quiet_row(run, board, kt, reps, target_seconds, device_reps)
+    _, skipped = run_s(board, kt)
+    total = pp.adaptive_tile_launches(shape, kt, cap)
+    skip_frac = int(skipped) / total if total else None
+    active = (1.0 - skip_frac) * grid if skip_frac is not None else None
+    record["settled"] = {
+        "metric": f"gol_decompose_{size}_settled"
+        + ("_PROXY" if proxy else ""),
+        "unit": "generations/sec",
+        "value": round(settled["median"], 2),
+        **settled,
+        "skip_fraction": round(skip_frac, 4) if skip_frac is not None else None,
+        "active_stripes_per_launch": round(active, 2) if active else None,
+    }
+    record["value"] = round(settled["median"], 2)
+    record.update(settled)
+    log(
+        f"  settled{' (proxy)' if proxy else ''}: {settled['median']:,.0f} "
+        f"gens/s, skip {skip_frac}, ~{active and round(active, 1)} active "
+        "stripes/launch"
+    )
+
+    # -- geometry candidates: the S-margin sweep + C 256->128 A/B -----------
+    if geometries:
+        rows = {}
+        for geom in pp.geometry_candidates():
+            # The override must span compile AND measurement: the jit
+            # trace — where the kernel geometry is baked — happens on the
+            # first call, not at make_superstep.
+            with pp.plan_geometry_override(geom):
+                run_g = runner()
+                rg = lambda b, n: run_g(b, n)[0]  # noqa: E731
+                b2 = rg(board, kt)  # compile + warm
+                _sync(b2)
+                b2, st = _quiet_row(
+                    rg, b2, kt, reps, target_seconds, device_reps
+                )
+                it = identity_turns or 6 * t
+                got = rg(b2, it)
+                want = packed.superstep(b2, CONWAY, it)
+                ok = bool(jnp.array_equal(got, want))
+            gplan = pp._frontier_plan(shape, t, cap, geometry=geom)
+            rows[geom.label] = {
+                "metric": f"gol_decompose_{size}_geom_{geom.label}",
+                "unit": "generations/sec",
+                "value": round(st["median"], 2),
+                **st,
+                "sub_rows": gplan[1],
+                "col_window": gplan[2],
+                "bit_identical": ok,
+            }
+            log(
+                f"  geometry {geom.label}: {st['median']:,.0f} gens/s "
+                f"(S={gplan[1]}, C={gplan[2]}), bit_identical={ok}"
+            )
+        record["geometries"] = rows
+        record["per_launch_terms"] = _terms(record, rows, t, grid)
+
+    # -- skip-cap sensitivity ----------------------------------------------
+    cap_rows = {}
+    for c in caps:
+        if pp._frontier_plan(shape, pp.adaptive_launch_depth(shape, kt, c)[0],
+                             c) is None:
+            log(f"  cap {c}: no frontier plan; skipped")
+            continue
+        run_c = runner(tile_cap=c)
+        rc = lambda b, n: run_c(b, n)[0]  # noqa: E731
+        b2 = rc(board, kt)
+        _sync(b2)
+        b2, st = _quiet_row(rc, b2, kt, reps, target_seconds, device_reps)
+        _, sk = run_c(b2, kt)
+        tot = pp.adaptive_tile_launches(shape, kt, c)
+        cap_rows[str(c)] = {
+            "metric": f"gol_decompose_{size}_cap{c}",
+            "unit": "generations/sec",
+            "value": round(st["median"], 2),
+            **st,
+            "skip_fraction": round(int(sk) / tot, 4) if tot else None,
+        }
+        log(f"  cap {c}: {st['median']:,.0f} gens/s, "
+            f"skip {cap_rows[str(c)]['skip_fraction']}")
+    if cap_rows:
+        record["caps"] = cap_rows
+    return record
+
+
+def _terms(record: dict, geom_rows: dict, t: int, grid: int) -> dict:
+    """The per-launch decomposition: floor vs active-stripe terms, with
+    the S·C fit over the geometry rows splitting the active term into
+    its window-scaled and fixed shares (see module docstring)."""
+    floor_rate = record["floor"]["median"]
+    settled = record["settled"]
+    active = settled.get("active_stripes_per_launch")
+    t_floor = t / floor_rate  # seconds per launch, all elided
+    t_settled = t / settled["median"]
+    terms = {
+        "floor_us_per_launch": round(t_floor * 1e6, 2),
+        "active_extra_us_per_launch": round((t_settled - t_floor) * 1e6, 2),
+    }
+    if active:
+        per_active = (t_settled - t_floor) / active
+        terms["us_per_active_stripe"] = round(per_active * 1e6, 2)
+        # Least-squares fit of per-active-stripe cost vs S·C over the
+        # geometry rows: slope = the window-scaled share (compute + DMA,
+        # both linear in S·C), intercept = the window-size-independent
+        # share (launch logistics, reductions, fallback residue).
+        xs, ys = [], []
+        for row in geom_rows.values():
+            if not row.get("bit_identical", True) or not row.get("col_window"):
+                continue
+            sc = row["sub_rows"] * row["col_window"]
+            extra = (t / row["median"] - t_floor) / active
+            xs.append(sc)
+            ys.append(extra * 1e6)
+        if len(set(xs)) >= 2:
+            a = np.polyfit(np.asarray(xs, float), np.asarray(ys, float), 1)
+            terms["window_fit"] = {
+                "us_per_kword_SC": round(float(a[0]) * 1024, 4),
+                "fixed_us": round(float(a[1]), 2),
+                "points": len(xs),
+            }
+    return terms
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=65536)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--kturns", type=int, default=0, help="0 = auto (24·T)")
+    ap.add_argument("--device-reps", type=int, default=1,
+                    help="supersteps fused on device per timed dispatch "
+                    "(lax.fori_loop amplification)")
+    ap.add_argument("--caps", default="256,512,1024")
+    ap.add_argument("--no-geometries", action="store_true")
+    ap.add_argument("--burnin", type=int, default=0,
+                    help="evolve the fresh soup N generations first (the "
+                    "settled protocol; tools/bench_65536.py --save-board "
+                    "is the split-session form)")
+    ap.add_argument("--load-board", default=None, metavar="NPY",
+                    help="packed uint32 settled board (the published-"
+                    "settled-number path)")
+    ap.add_argument("--proxy", action="store_true",
+                    help="synthetic ash+glider board instead of a burned-"
+                    "in soup (rows labelled _PROXY)")
+    ap.add_argument("--pilot", action="store_true",
+                    help="hermetic smoke: toy interpret-mode geometry, "
+                    "1 rep — exercises the machinery + record shape "
+                    "(tier-1 runs this)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if args.pilot:
+        record = pilot_record()
+        measure.require_headline_stats(record)
+        print(json.dumps(record))
+        return record
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} platform={dev.platform}")
+    H, WP = args.size, args.size // 32
+    if args.load_board:
+        loaded = np.load(args.load_board)
+        if loaded.shape != (H, WP) or loaded.dtype != np.uint32:
+            raise SystemExit(
+                f"--load-board wants uint32 ({H}, {WP}), got "
+                f"{loaded.dtype} {loaded.shape}"
+            )
+        import jax.numpy as jnp
+
+        board = jnp.asarray(loaded)
+        proxy = False
+    elif args.proxy:
+        board = proxy_settled_board(H, WP)
+        proxy = True
+    else:
+        import jax.numpy as jnp
+
+        board = jax.random.bits(jax.random.key(0), (H, WP), dtype=jnp.uint32)
+        proxy = args.burnin == 0  # an unburned soup is not settled either
+        if args.burnin:
+            from distributed_gol_tpu.models.life import CONWAY
+            from distributed_gol_tpu.ops import pallas_packed as pp
+
+            run_s = pp.make_superstep(CONWAY, skip_stable=True, with_stats=True)
+            done = 0
+            t0 = time.perf_counter()
+            while done < args.burnin:
+                board = run_s(board, 9984)[0]
+                done += 9984
+            _sync(board)
+            log(f"  burn-in: {done} gens in {time.perf_counter() - t0:.1f}s")
+    record = decompose(
+        board,
+        reps=args.reps,
+        kturns=args.kturns or None,
+        caps=tuple(int(c) for c in args.caps.split(",") if c),
+        geometries=not args.no_geometries,
+        proxy=proxy,
+        device_reps=args.device_reps,
+    )
+    measure.require_headline_stats(record)
+    print(json.dumps(record))
+    return record
+
+
+def pilot_record() -> dict:
+    """The hermetic (CPU interpret-mode) smoke form: a (1024, 16384)
+    board — wp = 512, the 16384² lane count, so BOTH column-window
+    candidates engage — one rep, two geometry candidates, one cap.
+    Numbers are meaningless (interpret mode); the record shape, the
+    geometry A/B plumbing, the bit-identity gates and the term fit are
+    exactly the hardware protocol."""
+    import jax.numpy as jnp  # noqa: F401
+
+    from distributed_gol_tpu.ops import pallas_packed as pp
+
+    size = 1024
+    board = proxy_settled_board(size, 16384 // 32)
+
+    # Shrink the candidate matrix to the two poles (shipped + both
+    # levers) so the tier-1 smoke stays cheap; the full matrix is the
+    # hardware CLI run and the dedicated interpret-identity tests.
+    full = pp.geometry_candidates
+    pp_candidates = [full()[0], full()[-1]]
+    try:
+        pp.geometry_candidates = lambda: pp_candidates
+        record = decompose(
+            board,
+            reps=1,
+            kturns=36,
+            caps=(512,),
+            proxy=True,
+            target_seconds=0.0,
+            identity_turns=18,
+            # cap 512 -> a 2-stripe grid, so skip/elide bookkeeping and
+            # neighbour unions are real (the 1024-row default would make
+            # the whole board one stripe).
+            cap=512,
+        )
+    finally:
+        pp.geometry_candidates = full
+    record["pilot"] = True
+    return record
+
+
+if __name__ == "__main__":
+    main()

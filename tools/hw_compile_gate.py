@@ -30,6 +30,7 @@ protocol's "prove it compiles on the real target" discipline).
 
 from __future__ import annotations
 
+import contextlib
 import sys
 import time
 from pathlib import Path
@@ -46,49 +47,73 @@ def _configs():
     from distributed_gol_tpu.ops import pallas_packed as pp
     from distributed_gol_tpu.parallel import pallas_halo as ph
 
-    def superstep(shape, skip, turns):
+    def superstep(shape, skip, turns, geometry=None):
         def lower():
-            run = pp.make_superstep(CONWAY, skip_stable=skip)
-            run.lower(
-                jax.ShapeDtypeStruct(shape, jnp.uint32), turns=turns
-            ).compile()
+            # Candidate plan geometries (round 6: the S-margin and C=128
+            # levers) compile under a scoped override — a fresh
+            # make_superstep per candidate so the jit trace can't reuse a
+            # kernel built for another geometry.
+            ctx = (
+                pp.plan_geometry_override(geometry)
+                if geometry is not None
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                run = pp.make_superstep(CONWAY, skip_stable=skip)
+                run.lower(
+                    jax.ShapeDtypeStruct(shape, jnp.uint32), turns=turns
+                ).compile()
         return lower
 
-    def strip(kind, shape, turns):
+    def strip(kind, shape, turns, geometry=None):
         def lower():
-            cap = pp.default_skip_cap(shape[0])
-            i32 = lambda n: jax.ShapeDtypeStruct((n,), jnp.int32)  # noqa: E731
-            b = jax.ShapeDtypeStruct(shape, jnp.uint32)
-            if kind in ("ici", "ici-loopback"):
-                # In-kernel ICI exchange megakernel (round 6): the kernel
-                # takes neighbour mesh coords as an SMEM input instead of
-                # calling axis_index, exactly so this gate can AOT-compile
-                # the remote-DMA lowering standalone — interpret mode
-                # structurally cannot reach it (no remote-DMA emulation).
-                call = ph._build_dispatch_frontier_strip(
-                    shape, CONWAY, turns, 8, False, cap, kind == "ici"
-                )
-                jax.jit(call).lower(i32(3), b, b).compile()
-                return
-            if kind == "frontier":
-                call = ph._build_ext_launch_frontier(shape, CONWAY, turns, False, cap)
-                grid = shape[0] // ph._strip_plan_tile(shape, turns, cap)
-                pad = pp._frontier_plan(shape, turns, cap)[0]
-                h = jax.ShapeDtypeStruct((pad, shape[1]), jnp.uint32)
-                args = [i32(grid)] + [i32(grid + 2)] * 6 + [b, h, h, b]
-            elif kind == "adaptive":
-                call = ph._build_ext_launch_adaptive(shape, CONWAY, turns, False, cap)
-                grid = shape[0] // ph._strip_plan_tile(shape, turns, cap)
-                pad = pp._round8(turns)
-                h = jax.ShapeDtypeStruct((pad, shape[1]), jnp.uint32)
-                args = [i32(grid + 2), b, h, h, b]
-            else:  # plain
-                call = ph._build_ext_launch(shape, CONWAY, turns, False)
-                pad = pp._round8(turns)
-                ext = jax.ShapeDtypeStruct((shape[0] + 2 * pad, shape[1]), jnp.uint32)
-                args = [ext]
-            jax.jit(call).lower(*args).compile()
+            # Candidate geometries reach the SHARDED kernels through the
+            # same process-wide PlanGeometry (set_plan_geometry clears the
+            # strip builder caches), so the gate must compile the strip
+            # forms under them too — the plan shapes (pad, sub_rows,
+            # col_window) are derived inside this block.
+            ctx = (
+                pp.plan_geometry_override(geometry)
+                if geometry is not None
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                _strip_lower(kind, shape, turns)
         return lower
+
+    def _strip_lower(kind, shape, turns):
+        cap = pp.default_skip_cap(shape[0])
+        i32 = lambda n: jax.ShapeDtypeStruct((n,), jnp.int32)  # noqa: E731
+        b = jax.ShapeDtypeStruct(shape, jnp.uint32)
+        if kind in ("ici", "ici-loopback"):
+            # In-kernel ICI exchange megakernel (round 6): the kernel
+            # takes neighbour mesh coords as an SMEM input instead of
+            # calling axis_index, exactly so this gate can AOT-compile
+            # the remote-DMA lowering standalone — interpret mode
+            # structurally cannot reach it (no remote-DMA emulation).
+            call = ph._build_dispatch_frontier_strip(
+                shape, CONWAY, turns, 8, False, cap, kind == "ici"
+            )
+            jax.jit(call).lower(i32(3), b, b).compile()
+            return
+        if kind == "frontier":
+            call = ph._build_ext_launch_frontier(shape, CONWAY, turns, False, cap)
+            grid = shape[0] // ph._strip_plan_tile(shape, turns, cap)
+            pad = pp._frontier_plan(shape, turns, cap)[0]
+            h = jax.ShapeDtypeStruct((pad, shape[1]), jnp.uint32)
+            args = [i32(grid)] + [i32(grid + 2)] * 6 + [b, h, h, b]
+        elif kind == "adaptive":
+            call = ph._build_ext_launch_adaptive(shape, CONWAY, turns, False, cap)
+            grid = shape[0] // ph._strip_plan_tile(shape, turns, cap)
+            pad = pp._round8(turns)
+            h = jax.ShapeDtypeStruct((pad, shape[1]), jnp.uint32)
+            args = [i32(grid + 2), b, h, h, b]
+        else:  # plain
+            call = ph._build_ext_launch(shape, CONWAY, turns, False)
+            pad = pp._round8(turns)
+            ext = jax.ShapeDtypeStruct((shape[0] + 2 * pad, shape[1]), jnp.uint32)
+            args = [ext]
+        jax.jit(call).lower(*args).compile()
 
     cfgs = []
     for size, wp in ((16384, 512), (65536, 2048)):
@@ -101,6 +126,20 @@ def _configs():
         cfgs.append(
             (f"{size}^2 adaptive T={t_f}+rem", superstep(shape, True, t_f * 5 + 11))
         )
+        # The candidate plan geometries (ISSUE 3): every non-default
+        # (sub_margin, col_window) pair the retune pass may install must
+        # hardware-compile at both headline boards — interpret mode
+        # cannot gate the Mosaic alignment class of the narrower
+        # window/rect DMA offsets.
+        for geom in pp.geometry_candidates():
+            if geom == pp.plan_geometry():
+                continue
+            cfgs.append(
+                (
+                    f"{size}^2 adaptive {geom.label} T={t_f}",
+                    superstep(shape, True, t_f * 5 + 11, geometry=geom),
+                )
+            )
         cfgs.append((f"{size}^2 plain", superstep(shape, False, 128)))
         for ny in (2, 4, 8):
             s = (size // ny, wp)
@@ -112,6 +151,21 @@ def _configs():
                 # same geometry — the one lowering class interpret mode
                 # can never gate.
                 cfgs.append((f"strip {s} ici T={t_s}", strip("ici", s, t_s)))
+                if ny == 2:
+                    # The strip kernels consume candidate PlanGeometries
+                    # too (one process-wide knob): gate the combined-
+                    # lever pair at one representative strip per size —
+                    # the narrower window/rect DMA offsets must lower in
+                    # the sharded forms as well.
+                    geom = pp.PlanGeometry(64, 128)
+                    cfgs.append(
+                        (f"strip {s} frontier {geom.label} T={t_s}",
+                         strip("frontier", s, t_s, geometry=geom))
+                    )
+                    cfgs.append(
+                        (f"strip {s} ici {geom.label} T={t_s}",
+                         strip("ici", s, t_s, geometry=geom))
+                    )
             if adaptive:
                 cfgs.append((f"strip {s} probing T=18", strip("adaptive", s, 18)))
         # The (1,1)-mesh loopback build of the in-kernel tier at the full
@@ -143,9 +197,18 @@ def run_gate(log=print, core: bool = False) -> dict:
         return {"ok": 0, "failed": [], "skipped": "no TPU attached"}
     cfgs = _configs()
     if core:
-        keep = ("strip (8192, 512) frontier", "strip (32768, 2048) frontier",
-                "strip (8192, 512) ici", "strip (32768, 2048) ici",
-                "strip (16384, 512) ici-loopback", "65536^2 adaptive")
+        # The "T=" suffixes keep each prefix from also matching the
+        # candidate-geometry rows ("... m64c128 T=..."), which would
+        # break the count check below.
+        keep = ("strip (8192, 512) frontier T=", "strip (32768, 2048) frontier T=",
+                "strip (8192, 512) ici T=", "strip (32768, 2048) ici T=",
+                "strip (16384, 512) ici-loopback", "65536^2 adaptive T=",
+                # The combined round-6 lever geometry at the flagship
+                # board: one candidate row rides every bench artifact so
+                # a Mosaic regression in the narrower window/rect offsets
+                # is driver-visible (the full candidate matrix is the
+                # CLI run).
+                "16384^2 adaptive m64c128")
         cfgs = [(l, f) for l, f in cfgs if l.startswith(keep)]
         if len(cfgs) != len(keep):
             # The filter failing to find its configs IS a gate failure —
