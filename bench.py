@@ -456,6 +456,14 @@ def bench_controller_path(
     consumer = threading.Thread(target=consume, daemon=True)
     consumer.start()
 
+    # Per-run metrics (ISSUE 4): the registry is process-wide, so the
+    # run's own telemetry is the delta across this call — embedded in the
+    # record (out_stats["metrics"]) and schema-linted before printing,
+    # same contract as require_headline_stats.
+    from distributed_gol_tpu.obs import metrics as obs_metrics
+
+    metrics_before = obs_metrics.REGISTRY.snapshot()
+
     def quit_later():
         time.sleep(budget_seconds)
         quit_at[0] = time.perf_counter()
@@ -473,6 +481,10 @@ def bench_controller_path(
     consumer.join(timeout=300)
     if consumer.is_alive():
         log("  WARNING: event consumer still draining; results may be skewed")
+    if out_stats is not None:
+        out_stats["metrics"] = (
+            obs_metrics.REGISTRY.snapshot().delta(metrics_before).to_dict()
+        )
 
     window = [(n, t) for n, t in times if t <= quit_at[0]]
     if len(window) < 2:
@@ -550,17 +562,20 @@ def bench_faults(size: int, plan_spec: str, budget_seconds: float = 8.0) -> dict
     # Interleaved A/B at the fixed superstep, medians over reps: drifts in
     # background load hit both arms alike.
     reps, clean_rates, armed_rates = 3, [], []
+    armed_stats: dict = {}
     for _ in range(reps):
         gps, _ = bench_controller_path(
             size, budget_seconds=budget_seconds, superstep=superstep
         )
         clean_rates.append(gps)
+        armed_stats = {}
         gps, _ = bench_controller_path(
             size,
             budget_seconds=budget_seconds,
             superstep=superstep,
             params_overrides=armed,
             backend_factory=factory,
+            out_stats=armed_stats,
         )
         armed_rates.append(gps)
     from distributed_gol_tpu.utils import measure
@@ -612,6 +627,12 @@ def bench_faults(size: int, plan_spec: str, budget_seconds: float = 8.0) -> dict
     )
     if dropped:
         record["degenerate_reps_dropped"] = dropped
+    # The last armed run's own telemetry (ISSUE 4): retry counts, backoff
+    # seconds and watchdog arms ride the artifact, so the record shows
+    # WHAT the armed machinery did, not just what it cost.
+    snap = armed_stats.get("metrics")
+    if snap:
+        record["metrics"] = snap
     log(f"  fault-overhead record: {json.dumps(record)}")
     return record
 
@@ -880,6 +901,7 @@ def main():
 
     import jax
 
+    from distributed_gol_tpu.obs import metrics as obs_metrics
     from distributed_gol_tpu.utils import measure
     from distributed_gol_tpu.utils.platform import honour_env_platforms
 
@@ -903,12 +925,17 @@ def main():
     if args.pilot:
         record = pilot_record(dev)
         measure.require_headline_stats(record)
+        # The metrics-snapshot lint (ISSUE 4): same contract as the stats
+        # lint above — a malformed embedded snapshot fails the run rather
+        # than shipping a broken artifact.
+        obs_metrics.require_embedded_metrics(record)
         print(json.dumps(record))
         return
 
     if args.faults is not None:
         record = bench_faults(size, args.faults)
         measure.require_headline_stats(record)
+        obs_metrics.require_embedded_metrics(record)
         print(json.dumps(record))
         return
 
@@ -972,8 +999,10 @@ def main():
         )
     # Artifact lint (round-6 acceptance bar): every headline row must
     # carry its {reps, median, spread} block — fail the run rather than
-    # ship a bare single-sample rate.
+    # ship a bare single-sample rate.  The embedded metrics snapshots get
+    # the same treatment (round-7: obs.metrics schema lint).
     measure.require_headline_stats(record)
+    obs_metrics.require_embedded_metrics(record)
     print(json.dumps(record))
 
 
@@ -1008,6 +1037,12 @@ def pilot_record(dev) -> dict:
     cp_gps, _ = bench_controller_path(
         size, budget_seconds=2.0, superstep=256, out_stats=cp_stats
     )
+    # The run's own telemetry rides the pilot record (ISSUE 4): hoisted to
+    # the top level so the driver artifact carries a lint-checked
+    # gol-metrics-v1 snapshot every round.
+    snap = cp_stats.pop("metrics", None)
+    if snap:
+        record["metrics"] = snap
     if cp_gps > 0:
         record["controller_path"] = {
             "metric": f"gol_bench_pilot_controller_path_{size}x{size}",

@@ -36,6 +36,7 @@ from distributed_gol_tpu.engine.events import (
     FinalTurnComplete,
     FrameReady,
     ImageOutputComplete,
+    MetricsReport,
     State,
     StateChange,
     TurnComplete,
@@ -59,6 +60,7 @@ __all__ = [
     "FinalTurnComplete",
     "FrameReady",
     "ImageOutputComplete",
+    "MetricsReport",
     "Params",
     "State",
     "StateChange",

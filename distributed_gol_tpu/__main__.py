@@ -138,6 +138,20 @@ def build_parser() -> argparse.ArgumentParser:
                          "dispatch boundaries (refused by multi-host runs)")
     ap.add_argument("--checkpoint-keep", type=int, default=3, metavar="K",
                     help="keep-last-K rotation for periodic checkpoints")
+    # Observability (docs/API.md "Observability").
+    ap.add_argument("--metrics", action="store_true", default=True,
+                    help="always-on run metrics: counters/gauges/histograms "
+                         "on the dispatch and failure paths, reported in the "
+                         "terminal MetricsReport event (on by default; the "
+                         "clean-path cost is noise)")
+    ap.add_argument("--no-metrics", action="store_false", dest="metrics",
+                    help="disable the metrics registry (see --metrics)")
+    ap.add_argument("--flight-recorder-depth", type=int, default=256,
+                    metavar="N",
+                    help="crash flight recorder: keep the last N structured "
+                         "records (dispatches, retries, watchdog fires, "
+                         "checkpoints) and dump flight-<ts>.json next to the "
+                         "checkpoint dir when a run dies; 0 disables")
     # Multi-host: launch the same command on every host (the reference's
     # hand-launched broker/worker fleet, broker/broker.go:191-205); process
     # 0 is the controller, the rest are followers.
@@ -186,6 +200,8 @@ def params_from_args(args) -> Params:
         checkpoint_every_turns=args.checkpoint_every_turns,
         checkpoint_every_seconds=args.checkpoint_every_seconds,
         checkpoint_keep=args.checkpoint_keep,
+        metrics=args.metrics,
+        flight_recorder_depth=args.flight_recorder_depth,
     )
 
 

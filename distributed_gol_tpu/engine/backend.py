@@ -21,6 +21,27 @@ from distributed_gol_tpu.ops import stencil
 from distributed_gol_tpu.parallel import halo, mesh as mesh_lib
 
 
+def _megakernel_cache_stats() -> tuple[int, int]:
+    """(hits, misses) summed over the bounded megakernel compile caches
+    (single-device frontier + sharded strip builders, lru maxsize=12) —
+    read at metrics-snapshot time only, so the dispatch path never touches
+    ``cache_info``."""
+    hits = misses = 0
+    from distributed_gol_tpu.ops import pallas_packed
+
+    infos = [pallas_packed._build_dispatch_frontier.cache_info()]
+    try:
+        from distributed_gol_tpu.parallel import pallas_halo
+
+        infos.append(pallas_halo._build_dispatch_frontier_strip.cache_info())
+    except ImportError:  # stripped jax build: the strip tier never loads
+        pass
+    for info in infos:
+        hits += info.hits
+        misses += info.misses
+    return hits, misses
+
+
 class Backend:
     """Holds compiled step programs for one (rule, engine, mesh) config.
 
@@ -181,6 +202,41 @@ class Backend:
             else:
                 _superstep = halo.sharded_superstep(self.mesh)
                 self._superstep = lambda b, k: _superstep(b, self.table, k)
+        self._init_metrics(params)
+
+    def _init_metrics(self, params: Params):
+        """Backend observability (ISSUE 4): a per-tier dispatch counter
+        bumped on the seam (one attribute add), plus snapshot-time
+        callback gauges for the lazy values — skip fraction and the
+        megakernel compile-cache hit/miss counts cost nothing until a
+        snapshot asks for them."""
+        from distributed_gol_tpu.obs import metrics as obs_metrics
+
+        # Run-scoped reset — on the REAL registry regardless of this
+        # run's metrics flag: a previous run's tier label / skip-fraction
+        # callback must not survive into later snapshots (and the stale
+        # bound methods must not pin the old Backend alive) just because
+        # THIS run happens to have metrics off.
+        obs_metrics.REGISTRY.clear_labels("backend.")
+        reg = obs_metrics.registry_for(params.metrics)
+        self._m_dispatches = reg.counter(f"backend.dispatches.{self.engine_used}")
+        reg.info("backend.engine", self.engine_used)
+        if self.sharded_tier is not None:
+            # The halo-exchange tier in use (and why) — the label every
+            # annotated span carries too.
+            reg.info("backend.sharded_tier", self.sharded_tier)
+            reg.info("backend.sharded_tier_policy", self.sharded_tier_policy)
+        if getattr(self, "_skip_fn", None) is not None:
+            reg.gauge_fn("backend.skip_fraction", self.skip_fraction)
+        if self.engine_used == "pallas-packed":
+            reg.gauge_fn(
+                "backend.megakernel_cache_hits",
+                lambda: _megakernel_cache_stats()[0],
+            )
+            reg.gauge_fn(
+                "backend.megakernel_cache_misses",
+                lambda: _megakernel_cache_stats()[1],
+            )
 
     def _skip_superstep(self, board, turns: int):
         """The adaptive pallas-packed engine with live skip telemetry.
@@ -410,6 +466,7 @@ class Backend:
         dispatch and do NOT route through here — override
         ``run_turn_with_flips`` / ``run_turn_with_frame`` to intercept
         those."""
+        self._m_dispatches.inc()
         if turns == 0:
             return board, stencil.alive_count(board)
         new_board = self._superstep(board, turns)

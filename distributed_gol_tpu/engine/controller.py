@@ -45,14 +45,17 @@ from distributed_gol_tpu.engine.events import (
     FinalTurnComplete,
     FrameReady,
     ImageOutputComplete,
+    MetricsReport,
     State,
     StateChange,
     TurnComplete,
     TurnsCompleted,
-    TurnTiming,
 )
 from distributed_gol_tpu.engine.params import Params
 from distributed_gol_tpu.engine.session import Session, default_session
+from distributed_gol_tpu.obs import flight as flight_lib
+from distributed_gol_tpu.obs import metrics as metrics_lib
+from distributed_gol_tpu.obs import spans
 from distributed_gol_tpu.utils.cell import AliveCells, Cell
 
 
@@ -83,14 +86,23 @@ class _Watchdog:
     caller abandons it at the deadline: JAX has no cancellation for an
     in-flight computation, so the wedged wait is left behind (daemon ⇒ it
     cannot block interpreter exit) and the controller gets its abort path
-    instead of wedging with it."""
+    instead of wedging with it.
 
-    def __init__(self, deadline: float):
+    ``on_arm`` / ``on_fire`` (optional zero-arg callables) are the
+    observability hooks: arm is counted per guarded wait, fire per
+    timeout — metrics bumps only, so the disabled (deadline 0) path stays
+    a plain call with zero overhead."""
+
+    def __init__(self, deadline: float, on_arm=None, on_fire=None):
         self.deadline = deadline
+        self._on_arm = on_arm
+        self._on_fire = on_fire
 
     def call(self, fn):
         if not self.deadline:
             return fn()
+        if self._on_arm is not None:
+            self._on_arm()
         box: list = []
         done = threading.Event()
 
@@ -105,6 +117,8 @@ class _Watchdog:
         t = threading.Thread(target=_runner, name="gol-watchdog", daemon=True)
         t.start()
         if not done.wait(self.deadline):
+            if self._on_fire is not None:
+                self._on_fire()
             raise DispatchTimeout(
                 f"dispatch did not resolve within {self.deadline}s "
                 "(device or collective wedged)"
@@ -210,8 +224,56 @@ class Controller:
         # "completed" | "detached" ('q') | "killed" ('k')
         self._outcome = "completed"
         self._paused = False
+        # -- observability (ISSUE 4) --
+        # Process-wide registry (or the no-op null registry); instruments
+        # are resolved HERE, the cold path, so hot-path bumps are plain
+        # attribute adds on pre-bound objects.
+        self.metrics = metrics_lib.registry_for(params.metrics)
+        self.flight = flight_lib.FlightRecorder(params.flight_recorder_depth)
+        # The tier label every span carries: the sharded exchange tier
+        # when one is in play, else the engine that actually runs.
+        self._tier = self.backend.sharded_tier or self.backend.engine_used
+        qsize = getattr(self.events, "qsize", None)
+        self._dispatch_rec = metrics_lib.DispatchRecorder(
+            self.metrics,
+            self.flight,
+            emit=self._emit,
+            emit_timing=params.emit_timing,
+            qsize=qsize,
+        )
+        self._m_pipeline_overlap = self.metrics.counter(
+            "controller.pipeline_overlap"
+        )
+        # Issue latency is host-side async-dispatch cost (~sub-ms when the
+        # pipeline is healthy); a growing issue time means the runtime's
+        # dispatch queue is backing up — distinct from resolve latency,
+        # which is device time.
+        self._h_issue_seconds = self.metrics.histogram(
+            "controller.issue_seconds"
+        )
+        self._m_backoff_s = self.metrics.counter("faults.backoff_seconds")
+        self._m_ckpt_saves = self.metrics.counter("faults.checkpoint_saves")
+        self._m_ckpt_bytes = self.metrics.counter("faults.checkpoint_bytes")
+        self._m_ckpt_failures = self.metrics.counter("faults.checkpoint_failures")
+        self._h_ckpt_seconds = self.metrics.histogram(
+            "faults.checkpoint_save_seconds"
+        )
+        self.flight.record(
+            "tier",
+            engine=self.backend.engine_used,
+            tier=self._tier,
+            mesh=list(params.mesh_shape),
+        )
+        # The per-run report is the DELTA against this start snapshot: the
+        # registry is process-wide (many runs per process), the report is
+        # this run's.
+        self._metrics_start = self.metrics.snapshot()
         # -- fault-tolerance state (ISSUE 2) --
-        self._watchdog = _Watchdog(params.dispatch_deadline_seconds)
+        self._watchdog = _Watchdog(
+            params.dispatch_deadline_seconds,
+            on_arm=self.metrics.counter("faults.watchdog_arms").inc,
+            on_fire=self._watchdog_fired,
+        )
         self._failures = 0  # per-run failed-dispatch count (failure_budget)
         self._ckpt_saved = False  # any periodic checkpoint parked this run
         self._ckpt_save_warned = False  # one warning per run for failed saves
@@ -315,6 +377,16 @@ class Controller:
                 return
 
     # -- failure surface -------------------------------------------------------
+    def _watchdog_fired(self):
+        """Watchdog-fire observability: counter + flight-ring transition
+        (the state change a postmortem needs to see)."""
+        self.metrics.counter("faults.watchdog_fires").inc()
+        self.flight.record(
+            "watchdog_fire",
+            deadline_s=self.params.dispatch_deadline_seconds,
+            turn=self._dispatch_rec.last_turn,
+        )
+
     def _dispatch(self, step, board, turn: int):
         """Run one device dispatch under the watchdog, with the retry
         policy on failure (``Params.retry_limit`` — the broker's re-queue,
@@ -322,7 +394,8 @@ class Controller:
         the last good board via :meth:`_retry_failed` — the single home of
         the retry contract."""
         try:
-            return self._watchdog.call(step)
+            with spans.span("gol.dispatch.sync", turn=turn, tier=self._tier):
+                return self._watchdog.call(step)
         except Exception as e:  # noqa: BLE001 — any device/runtime failure
             return self._retry_failed(step, board, turn, e)
 
@@ -341,6 +414,7 @@ class Controller:
         delay = p.retry_backoff_seconds * (2 ** (attempt - 1))
         if p.retry_backoff_max_seconds > 0:
             delay = min(delay, p.retry_backoff_max_seconds)
+        self._m_backoff_s.inc(delay)
         time.sleep(delay)
 
     def _retry_failed(self, step, board_in, turn: int, error: Exception):
@@ -360,12 +434,27 @@ class Controller:
         attempt = 1  # failed attempts for this dispatch so far
         while True:
             self._failures += 1
+            # Retries by cause (ISSUE 4): the cause key is the exception
+            # class — DispatchTimeout, RuntimeError (device errors),
+            # XlaRuntimeError... — a cold path, so the per-cause counter
+            # lookup is fine here.
+            self.metrics.counter(
+                f"faults.failures.{type(error).__name__}"
+            ).inc()
             terminal = (
                 isinstance(error, DispatchTimeout)
                 or attempt > p.retry_limit
                 or (p.failure_budget and self._failures > p.failure_budget)
             )
+            self.flight.record(
+                "retry" if not terminal else "terminal_failure",
+                turn=turn,
+                attempt=attempt,
+                cause=type(error).__name__,
+                error=str(error)[:200],
+            )
             if not terminal:
+                self.metrics.counter("faults.retries").inc()
                 self._emit(
                     DispatchError(
                         turn, error=str(error), will_retry=True, attempt=attempt
@@ -373,7 +462,8 @@ class Controller:
                 )
                 self._backoff(attempt)
                 try:
-                    return self._watchdog.call(step)
+                    with spans.span("gol.retry", turn=turn, attempt=attempt):
+                        return self._watchdog.call(step)
                 except Exception as e:  # noqa: BLE001
                     error = e
                     attempt += 1
@@ -385,11 +475,15 @@ class Controller:
             # truthful in every interleaving.
             guard = _ParkGuard()
             try:
-                checkpointed = self._watchdog.call(
-                    lambda: self._park_checkpoint(board_in, turn, guard)
-                )
+                with spans.span("gol.park", turn=turn):
+                    checkpointed = self._watchdog.call(
+                        lambda: self._park_checkpoint(board_in, turn, guard)
+                    )
             except Exception:  # device wedged: board unfetchable
                 checkpointed = guard.abandon()
+            self.flight.record(
+                "terminal_park", turn=turn, checkpointed=checkpointed
+            )
             self._emit(
                 DispatchError(
                     turn,
@@ -438,6 +532,10 @@ class Controller:
             turn,
             rule=self.params.rule.notation,
             keep=self.params.checkpoint_keep,
+            # The artifact embedding (ISSUE 4): the sidecar carries the
+            # run's metrics-so-far, so a postmortem can read a crashed
+            # run's telemetry off its last checkpoint.
+            metrics=self._run_metrics() if self.params.metrics else None,
         )
 
     def _checkpoint_due(self, turn: int) -> bool:
@@ -475,8 +573,10 @@ class Controller:
         # allgather): watchdog-bounded like every other blocking dispatch
         # wait, so a wedged device or dead peer surfaces as the terminal
         # DispatchTimeout abort, never a hang at the checkpoint.
+        t0 = time.perf_counter()
         try:
-            world = self._watchdog.call(lambda: self.backend.fetch(board))
+            with spans.span("gol.checkpoint.fetch", turn=turn, tier=self._tier):
+                world = self._watchdog.call(lambda: self.backend.fetch(board))
             self._save_checkpoint(world, turn)
         except DispatchTimeout as e:
             # Wedged device/collective: the watchdog abort policy.  Tell
@@ -492,6 +592,10 @@ class Controller:
             # dispatch schedule (multi-host processes decide `due`
             # independently, and the collective fetch above only lines up
             # if a save failure on one process cannot desync its anchors).
+            self._m_ckpt_failures.inc()
+            self.flight.record(
+                "checkpoint_failed", turn=turn, error=str(e)[:200]
+            )
             if not self._ckpt_save_warned:
                 self._ckpt_save_warned = True
                 import warnings
@@ -505,21 +609,80 @@ class Controller:
             self._last_ckpt_turn = turn
             self._last_ckpt_time = time.monotonic()
             return False
+        save_s = time.perf_counter() - t0
+        self._m_ckpt_saves.inc()
+        self._m_ckpt_bytes.inc(world.nbytes)
+        self._h_ckpt_seconds.observe(save_s)
+        self.flight.record(
+            "checkpoint",
+            turn=turn,
+            bytes=int(world.nbytes),
+            s=round(save_s, 6),
+        )
         self._ckpt_saved = True
         self._last_ckpt_turn = turn
         self._last_ckpt_time = time.monotonic()
         self._emit(CheckpointSaved(turn))
         return True
 
+    # -- observability plumbing (ISSUE 4) --------------------------------------
+    def _run_metrics(self) -> dict:
+        """This run's metrics so far: the registry delta against the
+        run-start snapshot, as a plain ``gol-metrics-v1`` dict."""
+        return self.metrics.snapshot().delta(self._metrics_start).to_dict()
+
+    def _gather_snapshots(self, snap: dict) -> list[dict]:
+        """The multihost aggregation seam: single-host, a run's snapshot
+        is the whole story; the multihost controller overrides this to
+        allgather every process's snapshot through the existing broadcast
+        transport (``parallel/multihost.py``)."""
+        return [snap]
+
+    def _flight_dir(self):
+        """Where the postmortem lands: next to the durable checkpoints
+        when the session has a directory, else the run's out_dir."""
+        return self.session.checkpoint_dir or self.params.out_dir
+
+    def _dump_flight(self, exc: BaseException) -> None:
+        """Terminal-path postmortem: dump the flight ring (with the run's
+        metrics delta) before the run dies.  Best-effort by contract —
+        never masks the abort being documented.  The snapshot here SKIPS
+        the lazy callback gauges (``include_lazy=False``): skip-fraction
+        and friends force on-device values, and on the very wedged device
+        this dump is documenting that force would hang the abort path
+        forever, outside any watchdog."""
+        try:
+            metrics = (
+                self.metrics.snapshot(include_lazy=False)
+                .delta(self._metrics_start)
+                .to_dict()
+                if self.params.metrics
+                else None
+            )
+            self.flight.dump(
+                self._flight_dir(),
+                cause=type(exc).__name__,
+                error=str(exc),
+                turn=self._dispatch_rec.last_turn,
+                metrics=metrics,
+            )
+        except Exception:  # noqa: BLE001 — the abort must still propagate
+            pass
+
     # -- the run (distributor, gol/distributor.go:194-262) ---------------------
     def run(self):
         """Drive the whole run; the event stream is always terminated with
         the ``None`` sentinel, even on error — a viewer blocked on the queue
         must never hang because the engine died (the reference relies on
-        ``close(events)`` for the same guarantee, ``gol/distributor.go:262``)."""
+        ``close(events)`` for the same guarantee, ``gol/distributor.go:262``).
+        Every terminal path additionally dumps the flight recorder
+        (``flight-<ts>.json`` next to the checkpoint dir) so a dead run
+        leaves its own postmortem; clean completions and q/k exits write
+        nothing."""
         try:
             self._run()
-        except BaseException:
+        except BaseException as e:
+            self._dump_flight(e)
             self.events.put(None)
             raise
 
@@ -589,7 +752,7 @@ class Controller:
             self._poll_keys(board, turn)
             if self._outcome != "completed":
                 break
-            t0 = time.perf_counter() if p.emit_timing else 0.0
+            t0 = time.perf_counter()
             if wants_flips:
                 k = 1
                 board, count, coords = self._dispatch(
@@ -623,8 +786,11 @@ class Controller:
                 state.set(turn, count)
                 self._emit(FrameReady(turn, frame, (fy, fx)))
             self._emit(TurnComplete(turn))
-            if p.emit_timing:
-                self._emit(TurnTiming(turn, k, time.perf_counter() - t0))
+            # The unified per-dispatch record (ISSUE 4 satellite): timing
+            # event, metrics bumps and flight-ring entry share ONE home
+            # with the pipelined headless path (DispatchRecorder), so the
+            # two can never drift again.
+            self._dispatch_rec.record(turn, k, time.perf_counter() - t0)
             self._maybe_checkpoint(board, turn)
         return board, turn
 
@@ -719,7 +885,10 @@ class Controller:
             board_in, board_out, count_dev, k, t_issue = pending
             pending = None
             try:
-                count = self._force(count_dev)
+                with spans.span(
+                    "gol.resolve", turn=turn + k, k=k, tier=self._tier
+                ):
+                    count = self._force(count_dev)
             except Exception as e:  # noqa: BLE001 — device/runtime failure
                 board_out, count = self._retry_failed(
                     lambda: self.backend.run_turns(board_in, k),
@@ -739,8 +908,9 @@ class Controller:
                 self._emit_turns(turn + 1, turn + k)
             turn += k
             state.set(turn, count)
-            if p.emit_timing:
-                self._emit(TurnTiming(turn, k, dt))
+            # The unified per-dispatch record — shared with the sync
+            # viewer path (ISSUE 4 satellite; see DispatchRecorder).
+            self._dispatch_rec.record(turn, k, dt)
             if adaptive and k == superstep:
                 superstep = self._next_superstep(k, dt, superstep, warm_sizes, cap)
             if self._maybe_checkpoint(board_out, turn):
@@ -783,20 +953,30 @@ class Controller:
             if probe_every and n_issued >= next_probe and issued_turn < p.turns:
                 next_probe = n_issued + probe_every
                 if probe_flag is not None:
-                    fired = self._force_probe(probe_flag)
+                    with spans.span("gol.cycle_probe.force", turn=turn):
+                        fired = self._force_probe(probe_flag)
                     probe_flag = None
                     if fired:
                         if pending is not None:
                             board = resolve()
                         return self._fast_forward(board, turn, state)
-                probe_flag = self.backend.cycle_probe_async(board)
+                with spans.span("gol.cycle_probe.issue", turn=issued_turn):
+                    probe_flag = self.backend.cycle_probe_async(board)
             if issued_turn >= p.turns:
                 break
             k = min(superstep, p.turns - issued_turn)
             n_issued += 1
             t0 = time.perf_counter()
             try:
-                new_board, count_dev = self.backend.run_turns_async(board, k)
+                with spans.step_span(
+                    "gol.issue",
+                    n_issued,
+                    turn=issued_turn,
+                    k=k,
+                    tier=self._tier,
+                ):
+                    new_board, count_dev = self.backend.run_turns_async(board, k)
+                self._h_issue_seconds.observe(time.perf_counter() - t0)
             except Exception as e:  # noqa: BLE001 — issue-time failure
                 # Settle what already ran, then apply the retry contract
                 # to the failed dispatch synchronously and route its
@@ -813,6 +993,9 @@ class Controller:
                 continue
             spec = (board, new_board, count_dev, k, t0)
             if pending is not None:
+                # Depth-2 occupancy: this issue overlapped an unresolved
+                # dispatch — the pipelining the headless path exists for.
+                self._m_pipeline_overlap.inc()
                 out_expected = pending[1]
                 settled = resolve()
                 if settled is not out_expected:
@@ -964,6 +1147,19 @@ class Controller:
 
     def _finalize(self, board, turn: int):
         p = self.params
+        if p.metrics:
+            # The terminal observability rollup, emitted FIRST (before the
+            # final fetch) so the multihost override's snapshot-gather
+            # collective lines up at the same schedule point on every
+            # process regardless of outcome.
+            snaps = self._gather_snapshots(self._run_metrics())
+            self._emit(
+                MetricsReport(
+                    turn,
+                    snapshot=metrics_lib.aggregate_snapshots(snaps),
+                    processes=len(snaps),
+                )
+            )
         if self._outcome == "completed":
             if self._ckpt_saved:
                 # The run the periodic checkpoints guarded finished:

@@ -246,6 +246,25 @@ class TurnTiming(Event):
         return f"{self.turns} turns in {self.seconds:.4f}s ({self.gens_per_sec:,.0f}/s)"
 
 
+@dataclass(frozen=True)
+class MetricsReport(Event):
+    """Terminal metrics snapshot (framework extension, ISSUE 4): the run's
+    observability rollup — dispatch counts and latency histograms, retry/
+    watchdog/checkpoint counters, skip fraction, compile-cache hits —
+    emitted just before FinalTurnComplete when ``Params.metrics`` is on.
+
+    ``snapshot`` is a ``gol-metrics-v1`` dict (the per-run DELTA of the
+    process-wide registry; schema in ``obs/metrics.py``, linted by
+    ``check_metrics_snapshot``).  Multi-host runs aggregate every
+    process's snapshot through the broadcast seam, so ``processes``
+    records how many were merged.  Excluded from equality like
+    ``FrameReady.frame``: two reports compare by (turn, processes) — the
+    snapshot carries wall-clock values no two runs share."""
+
+    snapshot: dict = field(default_factory=dict, compare=False)
+    processes: int = 1
+
+
 class _TurnRange:
     """Internal queue entry: the TurnComplete events for turns
     ``first..last`` (inclusive) compressed into one object.  Never reaches
@@ -389,4 +408,5 @@ AnyEvent = Union[
     DispatchError,
     CheckpointSaved,
     TurnTiming,
+    MetricsReport,
 ]

@@ -193,6 +193,22 @@ class Params:
     checkpoint_every_seconds: float = 0.0
     checkpoint_keep: int = 3
 
+    # --- observability (ISSUE 4; see docs/API.md "Observability") ---
+    # Always-on metrics registry: process-wide named counters/gauges/
+    # histograms bumped on the dispatch and failure paths (plain attribute
+    # adds, no locks — the clean-path cost is noise, verified by the quiet
+    # protocol), snapshotted into the terminal MetricsReport event, bench
+    # records, checkpoint sidecars, and flight records.  False swaps in
+    # no-op instruments and suppresses the MetricsReport.
+    metrics: bool = True
+    # Crash flight recorder: a bounded in-memory ring of the last N
+    # structured records (dispatches with timings, retries, watchdog
+    # transitions, checkpoint commits, tier decisions).  Every terminal
+    # path dumps it as flight-<ts>.json next to the checkpoint dir (the
+    # session's directory when durable, else out_dir) before the run
+    # dies; a clean run writes nothing.  0 disables.
+    flight_recorder_depth: int = 256
+
     # Input-source override: a random soup of this density instead of the
     # ``images/WxH.pgm`` file (framework extension — the reference ships
     # pre-made soups as PGMs, which stops being practical at 16384²+ where
@@ -253,6 +269,10 @@ class Params:
             raise ValueError("checkpoint cadences must be >= 0 (0 disables)")
         if self.checkpoint_keep < 1:
             raise ValueError("checkpoint_keep must be >= 1")
+        if self.flight_recorder_depth < 0:
+            raise ValueError(
+                "flight_recorder_depth must be >= 0 (0 disables the recorder)"
+            )
         # Paths may arrive as strings from CLI/config files.
         object.__setattr__(self, "images_dir", Path(self.images_dir))
         object.__setattr__(self, "out_dir", Path(self.out_dir))

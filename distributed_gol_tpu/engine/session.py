@@ -53,6 +53,10 @@ class Checkpoint:
     # size mismatch.  None = unknown (pre-extension checkpoints) matches
     # anything.
     rule: str | None = None
+    # Embedded gol-metrics-v1 snapshot of the run that parked this
+    # checkpoint (ISSUE 4): a crashed run's telemetry is readable off its
+    # last sidecar.  Never consulted for resume; purely an artifact field.
+    metrics: dict | None = None
 
 
 class Session:
@@ -106,6 +110,7 @@ class Session:
         turn: int,
         rule: str | None = None,
         keep: int = 3,
+        metrics: dict | None = None,
     ):
         """Park a periodic (crash-recovery) checkpoint: the same resumable
         state a 'q' detach leaves, under a rotated ``checkpoint-<turn>``
@@ -117,7 +122,7 @@ class Session:
             prev = (self._paused, self._checkpoint, self._ckpt_name)
             self._paused = True
             self._checkpoint = Checkpoint(
-                np.asarray(world, dtype=np.uint8), turn, rule
+                np.asarray(world, dtype=np.uint8), turn, rule, metrics
             )
             self._ckpt_name = f"checkpoint-{turn:012d}"
             try:
@@ -236,6 +241,12 @@ class Session:
             self._unlink_written(rotated_only=False)
 
     @property
+    def checkpoint_dir(self) -> Path | None:
+        """The durable checkpoint directory (None = in-memory session) —
+        where terminal-path flight records land too (ISSUE 4)."""
+        return self._dir
+
+    @property
     def paused(self) -> bool:
         with self._lock:
             return self._paused
@@ -288,6 +299,10 @@ class Session:
         }
         if self._checkpoint.rule is not None:
             meta["rule"] = self._checkpoint.rule
+        if self._checkpoint.metrics is not None:
+            # The run's telemetry rides the sidecar (ISSUE 4) — ignored by
+            # resume negotiation, read by postmortem tooling.
+            meta["metrics"] = self._checkpoint.metrics
         self._write_json(self._meta_path, meta)
 
     @staticmethod

@@ -1,0 +1,21 @@
+"""Run telemetry (ISSUE 4): the observability layer every serving stack has.
+
+Three parts, wired through every hot and failure path of the engine:
+
+- :mod:`~distributed_gol_tpu.obs.metrics` — process-wide named counters,
+  gauges and fixed-bucket histograms with near-zero clean-path cost
+  (plain attribute bumps, no locks on the dispatch path;
+  snapshot-on-read), plus the snapshot schema lint that guards every
+  artifact embedding.
+- :mod:`~distributed_gol_tpu.obs.spans` — ``jax.profiler`` trace
+  annotations naming WHICH dispatch each kernel launch belongs to, so a
+  ``--trace`` capture is attributable instead of anonymous kernel soup.
+- :mod:`~distributed_gol_tpu.obs.flight` — a bounded in-memory ring of
+  structured records that every terminal path dumps as
+  ``flight-<ts>.json`` before the run dies (the postmortem artifact).
+
+Everything degrades to a no-op: ``Params.metrics=False`` swaps in null
+instruments, ``Params.flight_recorder_depth=0`` disables the ring, and
+spans become ``nullcontext`` on profiler-less builds — exactly like
+``utils.profiling.trace``.
+"""

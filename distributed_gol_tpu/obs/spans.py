@@ -1,0 +1,68 @@
+"""Annotated device-trace spans (ISSUE 4): name the dispatch, not the kernel.
+
+A bare ``--trace`` capture shows every Pallas launch and remote DMA as
+anonymous kernel soup; these helpers wrap each controller-level operation
+— dispatch issue/resolve, checkpoint fetch, cycle probe, multihost
+broadcast — in ``jax.profiler.TraceAnnotation`` /
+``StepTraceAnnotation`` spans carrying turn/superstep/tier labels, so the
+Perfetto timeline reads "gol.resolve turn=4096 k=512 tier=ici-megakernel"
+above the kernels that dispatch produced.
+
+Naming convention (documented in docs/API.md "Observability"):
+``gol.<operation>`` with labels as TraceMe metadata — ``gol.issue``,
+``gol.resolve``, ``gol.dispatch.sync``, ``gol.checkpoint.fetch``,
+``gol.cycle_probe``, ``gol.park``, ``gol.broadcast.<what>``.
+
+Degrades exactly like ``utils.profiling.trace``: on a stripped jax build
+(no profiler backend) every helper returns ``contextlib.nullcontext`` —
+resolved once, cached, zero per-call import cost afterwards.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+_UNRESOLVED = object()
+_TRACE_CLS = _UNRESOLVED  # jax.profiler.TraceAnnotation, or None
+_STEP_CLS = _UNRESOLVED  # jax.profiler.StepTraceAnnotation, or None
+
+
+def _resolve():
+    global _TRACE_CLS, _STEP_CLS
+    if _TRACE_CLS is _UNRESOLVED:
+        try:
+            import jax
+
+            _TRACE_CLS = jax.profiler.TraceAnnotation
+            _STEP_CLS = getattr(jax.profiler, "StepTraceAnnotation", None)
+        except Exception:  # stripped build: spans are no-ops, like trace()
+            _TRACE_CLS = None
+            _STEP_CLS = None
+    return _TRACE_CLS, _STEP_CLS
+
+
+def span(name: str, **labels):
+    """A ``TraceAnnotation`` context manager for one host-side operation;
+    ``labels`` ride as TraceMe metadata (Perfetto args).  No-op without a
+    profiler backend."""
+    cls, _ = _resolve()
+    if cls is None:
+        return contextlib.nullcontext()
+    try:
+        return cls(name, **labels)
+    except Exception:  # an exotic label type must never take the run down
+        return contextlib.nullcontext()
+
+
+def step_span(name: str, step: int, **labels):
+    """A ``StepTraceAnnotation``: like :func:`span` but also marks a step
+    boundary (``step_num``), so trace viewers group one dispatch's kernels
+    under one step.  Falls back to a plain span when the build has no
+    StepTraceAnnotation."""
+    _, cls = _resolve()
+    if cls is None:
+        return span(name, **labels)
+    try:
+        return cls(name, step_num=step, **labels)
+    except Exception:
+        return contextlib.nullcontext()

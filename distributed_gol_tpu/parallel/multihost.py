@@ -28,10 +28,13 @@ mode does the same.  The same oracle discipline as every other tier.
 
 from __future__ import annotations
 
+import json
+
 import numpy as np
 
 import jax
 
+from distributed_gol_tpu.obs import spans
 from distributed_gol_tpu.parallel import mesh as mesh_lib
 
 
@@ -91,6 +94,31 @@ def fetch_global(arr: jax.Array) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
 
 
+def gather_metrics_snapshots(snapshot: dict) -> list[dict]:
+    """Allgather every process's metrics snapshot (ISSUE 4): each process
+    passes its own ``gol-metrics-v1`` dict; every process gets the full
+    per-process list back, in process order.  Rides the existing
+    collective transport — JSON bytes padded to the max length (two small
+    collectives per RUN, not per dispatch, so the cost is noise).  All
+    processes must call together, like every other collective."""
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(json.dumps(snapshot).encode(), dtype=np.uint8)
+    sizes = np.atleast_1d(
+        np.asarray(multihost_utils.process_allgather(np.int32(payload.size)))
+    )
+    width = int(sizes.max())
+    padded = np.zeros(width, dtype=np.uint8)
+    padded[: payload.size] = payload
+    rows = np.atleast_2d(
+        np.asarray(multihost_utils.process_allgather(padded))
+    )
+    return [
+        json.loads(bytes(rows[i, : int(sizes[i])]).decode())
+        for i in range(rows.shape[0])
+    ]
+
+
 # -- full controller runs across processes ------------------------------------
 #
 # The data plane above is enough for library users; ``run_distributed`` runs
@@ -134,7 +162,10 @@ class _BroadcastKeys:
         def do():
             return int(multihost_utils.broadcast_one_to_all(np.int32(value)))
 
-        return self._watchdog.call(do) if self._watchdog is not None else do()
+        # Annotated like every other blocking collective (ISSUE 4): a
+        # trace shows WHERE a survivor sat when the peer died.
+        with spans.span("gol.broadcast.keys"):
+            return self._watchdog.call(do) if self._watchdog is not None else do()
 
     def get(self, block=False, timeout=None):
         code = 0
@@ -327,6 +358,17 @@ def _run_distributed(params, events, key_presses, session):
             # the force itself, like every other blocking collective wait.
             return self._watchdog.call(lambda: bool(flag))
 
+        def _gather_snapshots(self, snap):
+            # The multihost half of the MetricsReport (ISSUE 4): every
+            # process contributes its own snapshot through the broadcast
+            # transport; the controller aggregates (counters sum, gauges
+            # max).  Reached at the same schedule point everywhere
+            # (_finalize emits the report before the final fetch), and
+            # watchdog-bounded like every other collective.
+            return self._watchdog.call(
+                lambda: gather_metrics_snapshots(snap)
+            )
+
         def _next_superstep(self, k, dt, superstep, warm_sizes, cap):
             # Deterministic adaptive sizing (round-3 verdict, missing-3):
             # dt is local wall-clock — the one input that differs between
@@ -344,10 +386,11 @@ def _run_distributed(params, events, key_presses, session):
             # Watchdog-bounded like the keys broadcast: this collective
             # runs once per resolved dispatch and must not become the
             # place a survivor hangs after a one-sided failure.
-            return self._watchdog.call(
-                lambda: int(
-                    multihost_utils.broadcast_one_to_all(np.int32(superstep))
+            with spans.span("gol.broadcast.superstep", k=k):
+                return self._watchdog.call(
+                    lambda: int(
+                        multihost_utils.broadcast_one_to_all(np.int32(superstep))
+                    )
                 )
-            )
 
     MultihostController(params, ev, keys, session, backend).run()

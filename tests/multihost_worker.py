@@ -283,9 +283,32 @@ def cycle_main(coordinator, nprocs, pid, okfile, out_dir):
         from dataclasses import replace
 
         ev2: queue.Queue = queue.Queue()
+        seen2 = []
         gol.run(replace(params, out_dir=single_out), ev2)
-        while ev2.get(timeout=120) is not None:
-            pass
+        while (e := ev2.get(timeout=120)) is not None:
+            seen2.append(e)
+
+        # Multi-host metrics aggregation (ISSUE 4): every process's
+        # snapshot travels the broadcast seam and the terminal report
+        # merges them — counters SUM across processes, so the aggregated
+        # dispatch count is exactly nprocs x the single-device run's (the
+        # dispatch schedule is deterministic and identical by SPMD
+        # construction).
+        from distributed_gol_tpu.obs.metrics import check_metrics_snapshot
+
+        reports = [e for e in seen if isinstance(e, gol.MetricsReport)]
+        assert len(reports) == 1, reports
+        assert reports[0].processes == nprocs
+        snap = reports[0].snapshot
+        assert check_metrics_snapshot(snap) == []
+        single_snap = [
+            e for e in seen2 if isinstance(e, gol.MetricsReport)
+        ][0].snapshot
+        want = nprocs * single_snap["counters"]["controller.dispatches"]
+        assert snap["counters"]["controller.dispatches"] == want, (
+            snap["counters"],
+            single_snap["counters"],
+        )
         got = open(f"{my_out}/64x64x{turns}.pgm", "rb").read()
         want = open(f"{single_out}/64x64x{turns}.pgm", "rb").read()
         assert got == want, "multi-host fast-forward differs from single-device"

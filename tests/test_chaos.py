@@ -30,6 +30,7 @@ from distributed_gol_tpu.engine.controller import DispatchTimeout
 from distributed_gol_tpu.engine.events import CheckpointSaved, DispatchError
 from distributed_gol_tpu.engine.pgm import read_pgm
 from distributed_gol_tpu.engine.session import Session
+from distributed_gol_tpu.obs import flight as flight_lib
 from distributed_gol_tpu.testing.faults import (
     Fault,
     FaultInjectionBackend,
@@ -124,6 +125,33 @@ def assert_matches_oracle(tier, params, stream, oracle):
     assert got == want_board, f"{tier}: final board differs from oracle"
 
 
+def assert_flight_explains(dirpath, cause: str):
+    """The flight-recorder half of the abort contract (ISSUE 4): every
+    abort scenario must leave a parseable ``flight-<ts>.json`` whose tail
+    record explains the abort cause — and whose embedded metrics snapshot
+    is schema-valid."""
+    from distributed_gol_tpu.obs.metrics import check_metrics_snapshot
+
+    path = flight_lib.latest_flight_record(dirpath)
+    assert path is not None, f"no flight record under {dirpath}"
+    doc = flight_lib.load_flight_record(path)  # parses + schema-checks
+    assert doc["cause"] == cause
+    tail = doc["records"][-1]
+    assert tail["kind"] == "abort" and tail["cause"] == cause
+    # The ring must show the failure history leading up to the abort, not
+    # just the abort itself.
+    kinds = {r["kind"] for r in doc["records"]}
+    assert "terminal_failure" in kinds
+    assert check_metrics_snapshot(doc["metrics"]) == []
+    return doc
+
+
+def assert_no_flight(dirpath):
+    """A run that did not die must leave NO flight record — absence is
+    the 'nothing went wrong' signal."""
+    assert flight_lib.latest_flight_record(dirpath) is None
+
+
 def resume_and_check(tier, tmp_path, session_dir_or_session, oracle):
     """A fresh controller resumes from the parked checkpoint and must land
     bit-identically on the oracle board."""
@@ -148,6 +176,11 @@ def test_issue_fault_recovers_bit_identically(tier, tmp_path, oracle):
     assert [e.will_retry for e in errors] == [True]
     assert_matches_oracle(tier, params, stream, oracle)
     assert session.check_states(params.image_width, params.image_height) is None
+    # Recovered (and fault-free) runs leave no postmortem artifact.
+    assert_no_flight(tmp_path)
+    # ...but the run's own telemetry shows the retry that saved it.
+    report = [e for e in stream if isinstance(e, gol.MetricsReport)][0]
+    assert report.snapshot["counters"]["faults.retries"] == 1
 
 
 @pytest.mark.parametrize("tier", TIERS)
@@ -161,6 +194,7 @@ def test_resolve_fault_recovers_bit_identically(tier, tmp_path, oracle):
     assert [e.will_retry for e in errors] == [True]
     assert "resolve-time" in errors[0].error
     assert_matches_oracle(tier, params, stream, oracle)
+    assert_no_flight(tmp_path)
 
 
 @pytest.mark.parametrize("tier", TIERS)
@@ -177,6 +211,9 @@ def test_burst_aborts_cleanly_and_resumes(tier, tmp_path, oracle):
     errors = [e for e in stream if isinstance(e, DispatchError)]
     assert [e.will_retry for e in errors] == [True, False]
     assert errors[-1].checkpointed
+    # In-memory session: the postmortem lands next to the run's out_dir.
+    doc = assert_flight_explains(tmp_path / "faulted", "RuntimeError")
+    assert doc["metrics"]["counters"]["faults.retries"] == 1
     ckpt = session.check_states(params.image_width, params.image_height)
     assert ckpt is not None and 0 < ckpt.turn < params.turns
     session.pause(True, world=ckpt.world, turn=ckpt.turn)  # re-park (consumed)
@@ -211,6 +248,11 @@ def test_hang_is_bounded_by_the_watchdog(tier, tmp_path, oracle):
         errors = [e for e in stream if isinstance(e, DispatchError)]
         assert len(errors) == 1 and not errors[0].will_retry  # never retried
         assert errors[0].checkpointed
+        doc = assert_flight_explains(tmp_path / "faulted", "DispatchTimeout")
+        # The watchdog transition made it into the ring AND the counters.
+        assert "watchdog_fire" in {r["kind"] for r in doc["records"]}
+        assert doc["metrics"]["counters"]["faults.watchdog_fires"] >= 1
+        assert doc["metrics"]["counters"]["faults.watchdog_arms"] >= 1
     finally:
         backend.release_hangs()
     ckpt = session.check_states(params.image_width, params.image_height)
@@ -236,6 +278,10 @@ def test_torn_checkpoint_skipped_for_older_intact_pair(tier, tmp_path, oracle):
     session = Session(ckpt_dir)
     stream = run_aborting(params, backend, session)
     assert [e for e in stream if isinstance(e, CheckpointSaved)]
+    # Durable session: the postmortem lands NEXT TO the checkpoints, and
+    # its ring shows the checkpoint commits that preceded the abort.
+    doc = assert_flight_explains(ckpt_dir, "RuntimeError")
+    assert "checkpoint" in {r["kind"] for r in doc["records"]}
 
     # Two dispatches completed: rotated pairs at turns s and 2s, plus the
     # terminal park (legacy stem) at 2s.  Tear the two newest worlds.

@@ -1,0 +1,511 @@
+"""The run-telemetry subsystem (ISSUE 4): metrics registry, annotated
+spans, flight recorder — unit contracts plus the controller integration
+(MetricsReport emission, clean runs leaving no flight record).
+
+The snapshot-schema rejection tests mirror ``tests/test_measure.py``'s
+malformed-record shape tests: the lint is the artifact contract, so a
+snapshot that drifts must FAIL the lint, not slide into a published
+record.
+"""
+
+import json
+import queue
+import tempfile
+from pathlib import Path
+
+import pytest
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.engine.session import Session
+from distributed_gol_tpu.obs import flight as flight_lib
+from distributed_gol_tpu.obs import metrics as m
+from distributed_gol_tpu.obs import spans
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram_roundtrip(self):
+        reg = m.MetricsRegistry()
+        reg.counter("c").inc()
+        reg.counter("c").inc(3)
+        reg.gauge("g").set(2.5)
+        h = reg.histogram("h", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(99.0)
+        reg.info("i", "label")
+        snap = reg.snapshot().to_dict()
+        assert m.check_metrics_snapshot(snap) == []
+        assert snap["counters"]["c"] == 4
+        assert snap["gauges"]["g"] == 2.5
+        assert snap["histograms"]["h"]["counts"] == [1, 1, 1]
+        assert snap["histograms"]["h"]["count"] == 3
+        assert snap["info"]["i"] == "label"
+
+    def test_same_name_same_instrument(self):
+        reg = m.MetricsRegistry()
+        assert reg.counter("x") is reg.counter("x")
+        assert reg.gauge("y") is reg.gauge("y")
+
+    def test_unset_gauge_omitted(self):
+        reg = m.MetricsRegistry()
+        reg.gauge("never")
+        assert "never" not in reg.snapshot().to_dict()["gauges"]
+
+    def test_gauge_fn_evaluated_at_snapshot_only(self):
+        reg = m.MetricsRegistry()
+        calls = []
+        reg.gauge_fn("lazy", lambda: calls.append(1) or 7.0)
+        assert calls == []
+        assert reg.snapshot().to_dict()["gauges"]["lazy"] == 7.0
+        assert calls == [1]
+
+    def test_snapshot_without_lazy_skips_callbacks(self):
+        """The abort-path contract (review finding): a flight-dump
+        snapshot must not invoke callback gauges — they force on-device
+        values, and the wedged device being documented would hang the
+        abort forever."""
+        reg = m.MetricsRegistry()
+        reg.counter("c").inc()
+        calls = []
+        reg.gauge_fn("device.bound", lambda: calls.append(1) or 1.0)
+        snap = reg.snapshot(include_lazy=False).to_dict()
+        assert calls == []
+        assert "device.bound" not in snap["gauges"]
+        assert snap["counters"]["c"] == 1  # instruments still copied
+
+    def test_gauge_fn_none_and_raising_are_omitted(self):
+        reg = m.MetricsRegistry()
+        reg.gauge_fn("none", lambda: None)
+        reg.gauge_fn("boom", lambda: 1 / 0)
+        snap = reg.snapshot().to_dict()
+        assert "none" not in snap["gauges"] and "boom" not in snap["gauges"]
+        assert m.check_metrics_snapshot(snap) == []
+
+    def test_null_registry_is_inert(self):
+        reg = m.NULL
+        reg.counter("c").inc()
+        reg.gauge("g").set(1)
+        reg.histogram("h").observe(2)
+        reg.info("i", "x")
+        reg.gauge_fn("f", lambda: 1.0)
+        snap = reg.snapshot().to_dict()
+        assert m.check_metrics_snapshot(snap) == []
+        assert not snap["counters"] and not snap["gauges"]
+        assert m.registry_for(False) is m.NULL
+        assert m.registry_for(True) is m.REGISTRY
+
+    def test_delta_subtracts_counters_and_histograms(self):
+        reg = m.MetricsRegistry()
+        c = reg.counter("c")
+        h = reg.histogram("h", buckets=(1.0,))
+        c.inc(5)
+        h.observe(0.5)
+        start = reg.snapshot()
+        c.inc(2)
+        h.observe(2.0)
+        delta = reg.snapshot().delta(start).to_dict()
+        assert m.check_metrics_snapshot(delta) == []
+        assert delta["counters"]["c"] == 2
+        assert delta["histograms"]["h"]["counts"] == [0, 1]
+        assert delta["histograms"]["h"]["count"] == 1
+
+    def test_aggregate_sums_counters_maxes_gauges(self):
+        a = {
+            "schema": m.SCHEMA,
+            "counters": {"c": 1},
+            "gauges": {"g": 3.0},
+            "histograms": {
+                "h": {"buckets": [1.0], "counts": [1, 0], "sum": 0.5, "count": 1}
+            },
+            "info": {"i": "a"},
+        }
+        b = {
+            "schema": m.SCHEMA,
+            "counters": {"c": 2, "d": 1},
+            "gauges": {"g": 1.0},
+            "histograms": {
+                "h": {"buckets": [1.0], "counts": [0, 2], "sum": 4.0, "count": 2}
+            },
+            "info": {"i": "b"},
+        }
+        agg = m.aggregate_snapshots([a, b])
+        assert m.check_metrics_snapshot(agg) == []
+        assert agg["counters"] == {"c": 3, "d": 1}
+        assert agg["gauges"]["g"] == 3.0
+        assert agg["histograms"]["h"]["counts"] == [1, 2]
+        assert agg["histograms"]["h"]["count"] == 3
+        assert agg["info"]["i"] == "a"  # first process wins
+
+    def test_clear_labels_drops_prefixed_gauges_only(self):
+        reg = m.MetricsRegistry()
+        reg.counter("backend.dispatches.roll").inc()
+        reg.gauge("backend.skip_fraction").set(0.9)
+        reg.gauge_fn("backend.megakernel_cache_hits", lambda: 1.0)
+        reg.info("backend.sharded_tier", "ici-megakernel")
+        reg.gauge("controller.superstep").set(50)
+        reg.clear_labels("backend.")
+        snap = reg.snapshot().to_dict()
+        assert "backend.skip_fraction" not in snap["gauges"]
+        assert "backend.megakernel_cache_hits" not in snap["gauges"]
+        assert snap["info"] == {}
+        # Counters are cumulative and survive; other prefixes untouched.
+        assert snap["counters"]["backend.dispatches.roll"] == 1
+        assert snap["gauges"]["controller.superstep"] == 50
+
+    def test_new_backend_clears_previous_runs_labels(self, tmp_path):
+        """A run must not inherit a previous backend's tier label or skip
+        fraction in its own snapshots (review finding): constructing a
+        plain-engine Backend after an adaptive sharded one leaves no
+        stale backend.* gauges/info behind."""
+        from distributed_gol_tpu.engine.backend import Backend
+
+        adaptive = gol.Params(
+            image_width=128,
+            image_height=64,
+            engine="pallas-packed",
+            mesh_shape=(2, 1),
+            skip_stable=True,
+            superstep=6,
+        )
+        Backend(adaptive)
+        snap = m.REGISTRY.snapshot().to_dict()
+        assert snap["info"]["backend.sharded_tier"]
+        # The callback is registered (it reports None = omitted until
+        # enough dispatches have run; membership is what matters here).
+        assert "backend.skip_fraction" in m.REGISTRY._gauge_fns
+        Backend(gol.Params(image_width=16, image_height=16, engine="roll"))
+        snap = m.REGISTRY.snapshot().to_dict()
+        assert "backend.sharded_tier" not in snap["info"]
+        assert "backend.skip_fraction" not in m.REGISTRY._gauge_fns
+        assert "backend.skip_fraction" not in snap["gauges"]
+        assert snap["info"]["backend.engine"] == "roll"
+        # And a metrics=OFF backend still clears the REAL registry — the
+        # clear must not be gated on this run's metrics flag (review
+        # finding: the stale callbacks would pin the old Backend alive).
+        Backend(adaptive)
+        assert "backend.skip_fraction" in m.REGISTRY._gauge_fns
+        from dataclasses import replace
+
+        Backend(
+            replace(
+                gol.Params(image_width=16, image_height=16, engine="roll"),
+                metrics=False,
+            )
+        )
+        assert "backend.skip_fraction" not in m.REGISTRY._gauge_fns
+        assert "backend.sharded_tier" not in m.REGISTRY.snapshot().to_dict()["info"]
+
+    def test_to_json_roundtrip(self):
+        reg = m.MetricsRegistry()
+        reg.counter("c").inc()
+        snap = m.MetricsSnapshot.from_json(reg.snapshot().to_json())
+        assert snap.to_dict()["counters"]["c"] == 1
+
+
+class TestSnapshotSchemaLint:
+    """Malformed-snapshot rejection — the test_measure.py bare-value shape
+    test, transplanted to the metrics schema."""
+
+    def good(self):
+        return {
+            "schema": m.SCHEMA,
+            "counters": {"c": 1},
+            "gauges": {"g": 0.5},
+            "histograms": {
+                "h": {"buckets": [1.0], "counts": [1, 0], "sum": 0.1, "count": 1}
+            },
+            "info": {"i": "x"},
+        }
+
+    def test_good_snapshot_is_clean(self):
+        assert m.check_metrics_snapshot(self.good()) == []
+
+    @pytest.mark.parametrize(
+        "mutate, fragment",
+        [
+            (lambda s: s.update(schema="bogus"), "schema"),
+            (lambda s: s["counters"].update(c=-1), "counters.c"),
+            (lambda s: s["counters"].update(c=float("nan")), "counters.c"),
+            (lambda s: s["gauges"].update(g="fast"), "gauges.g"),
+            (lambda s: s["histograms"]["h"].update(counts=[1]), "counts"),
+            (lambda s: s["histograms"]["h"].update(count=99), "count"),
+            (lambda s: s["histograms"]["h"].update(buckets=[2.0, 1.0]),
+             "increasing"),
+            (lambda s: s["info"].update(i=3), "info.i"),
+        ],
+    )
+    def test_malformed_snapshots_rejected(self, mutate, fragment):
+        snap = self.good()
+        mutate(snap)
+        problems = m.check_metrics_snapshot(snap)
+        assert problems and any(fragment in p for p in problems), problems
+        with pytest.raises(m.MalformedSnapshot):
+            m.require_metrics_snapshot(snap)
+
+    def test_not_a_dict_rejected(self):
+        assert m.check_metrics_snapshot(42)
+
+    @pytest.mark.parametrize("section", ["counters", "gauges", "histograms", "info"])
+    def test_non_dict_section_is_a_violation_not_a_crash(self, section):
+        """A corrupted on-disk snapshot (flight record, sidecar) whose
+        section is a list/string must lint as a violation — the loader
+        path must raise MalformedFlightRecord/MalformedSnapshot, never an
+        AttributeError out of the lint itself (review finding)."""
+        snap = self.good()
+        snap[section] = [1, 2]
+        problems = m.check_metrics_snapshot(snap)
+        assert problems and any(section in p for p in problems), problems
+
+    def test_embedded_walk_finds_nested_snapshots(self):
+        record = {"rows": [{"metrics": self.good()}]}
+        assert m.check_embedded_metrics(record) == []
+        record["rows"][0]["metrics"]["counters"]["c"] = -5
+        with pytest.raises(m.MalformedSnapshot):
+            m.require_embedded_metrics(record)
+
+
+class TestSpans:
+    def test_span_and_step_span_enter_exit(self):
+        # With jax importable these are real TraceAnnotations; either way
+        # they must behave as context managers and never raise.
+        with spans.span("gol.test", turn=3, tier="roll"):
+            pass
+        with spans.step_span("gol.test.step", 7, k=50):
+            pass
+
+    def test_span_degrades_to_noop_without_profiler(self, monkeypatch):
+        monkeypatch.setattr(spans, "_TRACE_CLS", None)
+        monkeypatch.setattr(spans, "_STEP_CLS", None)
+        with spans.span("gol.test"):
+            pass
+        with spans.step_span("gol.test", 1):
+            pass
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_ordered(self):
+        fr = flight_lib.FlightRecorder(depth=3)
+        for i in range(10):
+            fr.record("dispatch", turn=i)
+        recs = fr.records()
+        assert [r["turn"] for r in recs] == [7, 8, 9]
+        assert all(r["kind"] == "dispatch" and r["t"] > 0 for r in recs)
+
+    def test_depth_zero_disables(self, tmp_path):
+        fr = flight_lib.FlightRecorder(depth=0)
+        fr.record("dispatch", turn=1)
+        assert fr.records() == []
+        assert fr.dump(tmp_path, cause="X") is None
+        assert not list(tmp_path.glob("flight-*.json"))
+
+    def test_dump_appends_abort_tail_and_parses(self, tmp_path):
+        fr = flight_lib.FlightRecorder(depth=8)
+        fr.record("dispatch", turn=4, k=4, s=0.01)
+        path = fr.dump(tmp_path, cause="RuntimeError", error="boom", turn=4)
+        assert path is not None and path.name.startswith("flight-")
+        doc = flight_lib.load_flight_record(path)
+        assert doc["cause"] == "RuntimeError" and doc["turn"] == 4
+        assert doc["records"][-1]["kind"] == "abort"
+        assert doc["records"][-1]["cause"] == "RuntimeError"
+
+    def test_malformed_flight_records_rejected(self, tmp_path):
+        good = {
+            "schema": flight_lib.SCHEMA,
+            "cause": "X",
+            "error": "",
+            "turn": 0,
+            "written_at": 0.0,
+            "records": [{"kind": "abort", "t": 1.0, "cause": "X"}],
+        }
+        assert flight_lib.check_flight_record(good) == []
+        for mutate in (
+            lambda d: d.update(schema="nope"),
+            lambda d: d.update(cause=""),
+            lambda d: d.update(turn="four"),
+            lambda d: d.update(records=[]),
+            lambda d: d.update(records=[{"kind": "dispatch", "t": 1.0}]),
+            lambda d: d.update(records=[{"t": 1.0}]),
+        ):
+            bad = json.loads(json.dumps(good))
+            mutate(bad)
+            assert flight_lib.check_flight_record(bad), mutate
+        p = tmp_path / "flight-1.json"
+        p.write_text(json.dumps({"schema": "nope"}))
+        with pytest.raises(flight_lib.MalformedFlightRecord):
+            flight_lib.load_flight_record(p)
+
+    def test_flight_report_renders(self, tmp_path, capsys):
+        from tools import flight_report
+
+        fr = flight_lib.FlightRecorder(depth=8)
+        fr.record("dispatch", turn=4, k=4, s=0.01)
+        fr.record("retry", turn=4, attempt=1, cause="RuntimeError")
+        fr.dump(
+            tmp_path,
+            cause="DispatchTimeout",
+            error="wedged",
+            turn=4,
+            metrics={
+                "schema": m.SCHEMA,
+                "counters": {"faults.retries": 1},
+                "gauges": {},
+                "histograms": {},
+                "info": {"backend.engine": "roll"},
+            },
+        )
+        assert flight_report.main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "cause: DispatchTimeout at turn 4" in out
+        assert "retry" in out and "faults.retries = 1" in out
+
+    def test_flight_report_empty_dir_fails_cleanly(self, tmp_path):
+        from tools import flight_report
+
+        assert flight_report.main([str(tmp_path)]) == 1
+
+
+def _run(params, session=None):
+    ev = queue.Queue()
+    gol.run(params, ev, session=session if session is not None else Session())
+    out = []
+    while (e := ev.get(timeout=60)) is not None:
+        out.append(e)
+    return out
+
+
+def _params(out_dir, **kw):
+    base = dict(
+        turns=20,
+        image_width=16,
+        image_height=16,
+        soup_density=0.3,
+        soup_seed=3,
+        out_dir=out_dir,
+        superstep=4,
+        cycle_check=0,
+        ticker_period=60.0,
+    )
+    base.update(kw)
+    return gol.Params(**base)
+
+
+class TestControllerIntegration:
+    def test_metrics_report_emitted_with_run_delta(self, tmp_path):
+        stream = _run(_params(tmp_path))
+        reports = [e for e in stream if isinstance(e, gol.MetricsReport)]
+        assert len(reports) == 1
+        snap = reports[0].snapshot
+        assert m.check_metrics_snapshot(snap) == []
+        # The per-run DELTA: exactly this run's 5 dispatches of 4 turns,
+        # not the process-wide totals.
+        assert snap["counters"]["controller.dispatches"] == 5
+        assert snap["counters"]["controller.turns"] == 20
+        assert snap["histograms"]["controller.dispatch_seconds"]["count"] == 5
+        assert snap["counters"]["backend.dispatches.roll"] == 5
+        assert snap["info"]["backend.engine"] == "roll"
+        assert reports[0].processes == 1
+        # MetricsReport precedes FinalTurnComplete (terminal rollup).
+        kinds = [type(e).__name__ for e in stream]
+        assert kinds.index("MetricsReport") < kinds.index("FinalTurnComplete")
+
+    def test_metrics_off_suppresses_report(self, tmp_path):
+        stream = _run(_params(tmp_path, metrics=False))
+        assert not [e for e in stream if isinstance(e, gol.MetricsReport)]
+
+    def test_clean_run_leaves_no_flight_record(self, tmp_path):
+        _run(_params(tmp_path))
+        assert not list(Path(tmp_path).glob("flight-*.json"))
+
+    def test_timing_events_identical_from_unified_helper(self, tmp_path):
+        """The two old hand-rolled TurnTiming sites are one helper now;
+        both the sync viewer path and the pipelined headless path must
+        still produce the exact per-dispatch stream."""
+        headless = _run(_params(tmp_path, emit_timing=True))
+        timings = [e for e in headless if isinstance(e, gol.TurnTiming)]
+        assert [t.turns for t in timings] == [4] * 5
+        assert [t.completed_turns for t in timings] == [4, 8, 12, 16, 20]
+        viewer = _run(
+            _params(
+                tmp_path, emit_timing=True, no_vis=False, flip_events="batch"
+            )
+        )
+        vtimings = [e for e in viewer if isinstance(e, gol.TurnTiming)]
+        assert [t.completed_turns for t in vtimings] == list(range(1, 21))
+        assert all(t.seconds > 0 for t in timings + vtimings)
+
+    def test_sidecar_embeds_metrics_snapshot(self, tmp_path):
+        ckpt = tmp_path / "ckpt"
+        session = Session(ckpt)
+        _run(
+            _params(
+                tmp_path,
+                turns=8,
+                checkpoint_every_turns=4,
+                checkpoint_keep=2,
+            ),
+            session=session,
+        )
+        # The completed run discards its periodic pairs; write one more
+        # directly to inspect the sidecar contract.
+        import numpy as np
+
+        session.save_checkpoint(
+            np.zeros((16, 16), np.uint8),
+            4,
+            rule="B3/S23",
+            metrics=m.REGISTRY.snapshot().to_dict(),
+        )
+        meta = json.loads((ckpt / "checkpoint-000000000004.json").read_text())
+        assert m.check_metrics_snapshot(meta["metrics"]) == []
+
+    def test_gather_snapshots_seam_single_process(self, tmp_path):
+        """The multihost aggregation transport, exercised at world size 1:
+        gather returns this process's snapshot and the aggregate equals
+        it (the real multi-process path rides the same collectives in
+        tests/test_multihost.py's worker)."""
+        from distributed_gol_tpu.parallel.multihost import (
+            gather_metrics_snapshots,
+        )
+
+        snap = {
+            "schema": m.SCHEMA,
+            "counters": {"c": 2},
+            "gauges": {},
+            "histograms": {},
+            "info": {},
+        }
+        got = gather_metrics_snapshots(snap)
+        assert got == [snap]
+        assert m.aggregate_snapshots(got)["counters"]["c"] == 2
+
+
+class TestCliFlags:
+    def test_observability_flags_map_to_params(self):
+        from distributed_gol_tpu.__main__ import build_parser, params_from_args
+
+        args = build_parser().parse_args(
+            ["-noVis", "--no-metrics", "--flight-recorder-depth", "7"]
+        )
+        p = params_from_args(args)
+        assert p.metrics is False
+        assert p.flight_recorder_depth == 7
+        # And the defaults: always-on metrics, 256-deep recorder.
+        p = params_from_args(build_parser().parse_args(["-noVis"]))
+        assert p.metrics is True
+        assert p.flight_recorder_depth == 256
+
+    def test_flight_depth_validated(self):
+        with pytest.raises(ValueError):
+            gol.Params(flight_recorder_depth=-1)
+
+
+def test_metrics_registry_clean_path_is_plain_attributes():
+    """The overhead contract, structurally: a counter bump is one
+    attribute add on a pre-resolved object (no locks, no dict lookups) —
+    pin the shape so a 'helpful' refactor can't sneak a lock onto the
+    dispatch path.  (The rate-level check is
+    tests/test_bench_pilot.py::test_metrics_overhead_within_rep_spread.)"""
+    assert m.Counter.__slots__ == ("value",)
+    assert m.Gauge.__slots__ == ("value",)
+    assert "buckets" in m.Histogram.__slots__
+    assert not hasattr(m.Counter(), "__dict__")

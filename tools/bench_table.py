@@ -97,8 +97,11 @@ def main():
     # per dispatch (one compile, no adaptive ladder) for the headless
     # rows; the viewer rows are per-turn by construction.
     print()
-    print("| Board | Path | gens/s | spread | reps | vs engine |")
-    print("|---|---|---|---|---|---|")
+    print(
+        "| Board | Path | gens/s | spread | reps | vs engine | "
+        "cache hit | retries | skip frac |"
+    )
+    print("|---|---|---|---|---|---|---|---|---|")
     for size in sizes:
         best = engine_gps.get(size, 0.0)
         ss = superstep_for(best) if best else 0
@@ -121,12 +124,32 @@ def main():
                 size, budget_seconds=budget, out_stats=st, **kw
             )
             ratio = f"{gps / best:.0%}" if best else "n/a"
-            spread = f"{st['spread']:.1%}" if st else "n/a"
+            spread = f"{st['spread']:.1%}" if "spread" in st else "n/a"
             reps = st.get("reps", "n/a")
+            cache, retries, skip = metrics_cells(st.get("metrics"))
             print(
                 f"| {size}² | {label} | {gps:,.0f} | {spread} | {reps} | "
-                f"{ratio} |"
+                f"{ratio} | {cache} | {retries} | {skip} |"
             )
+
+
+def metrics_cells(snap: dict | None) -> tuple[str, str, str]:
+    """Render the embedded gol-metrics-v1 snapshot of one path row (ISSUE
+    4 satellite): megakernel compile-cache hit rate, total retries, and
+    the live skip fraction — 'n/a' where the run had no such machinery."""
+    if not snap:
+        return "n/a", "n/a", "n/a"
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    hits = gauges.get("backend.megakernel_cache_hits")
+    misses = gauges.get("backend.megakernel_cache_misses")
+    if hits is None or misses is None or not (hits + misses):
+        cache = "n/a"
+    else:
+        cache = f"{hits / (hits + misses):.0%}"
+    retries = str(int(counters.get("faults.retries", 0)))
+    skip = gauges.get("backend.skip_fraction")
+    return cache, retries, f"{skip:.1%}" if skip is not None else "n/a"
 
 
 if __name__ == "__main__":

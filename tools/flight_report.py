@@ -1,0 +1,94 @@
+"""Render a crash flight record (``flight-<ts>.json``) for humans.
+
+The flight recorder (``obs/flight.py``) dumps a bounded ring of
+structured records when a run dies; this is the postmortem reader: what
+killed the run, at which turn, the tail of dispatch/retry/watchdog/
+checkpoint history leading up to it, and the run's metrics highlights.
+
+Usage:
+    python tools/flight_report.py <flight-....json | dir containing one>
+    python tools/flight_report.py --tail 40 out/
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from distributed_gol_tpu.obs import flight  # noqa: E402
+
+
+def _fmt_t(t: float, t0: float) -> str:
+    return f"+{t - t0:8.3f}s"
+
+
+def _fmt_record(r: dict, t0: float) -> str:
+    kind = r["kind"]
+    rest = " ".join(
+        f"{k}={v}" for k, v in r.items() if k not in ("kind", "t")
+    )
+    return f"  {_fmt_t(r['t'], t0)}  {kind:<16} {rest}"
+
+
+def render(doc: dict, tail: int = 20) -> str:
+    out = []
+    records = doc["records"]
+    t0 = records[0]["t"] if records else doc.get("written_at", 0.0)
+    when = time.strftime(
+        "%Y-%m-%d %H:%M:%S", time.gmtime(doc.get("written_at", 0))
+    )
+    out.append(f"flight record ({doc['schema']}) written {when} UTC")
+    out.append(
+        f"cause: {doc['cause']} at turn {doc['turn']}"
+        + (f" — {doc['error']}" if doc.get("error") else "")
+    )
+    shown = records[-tail:]
+    if len(shown) < len(records):
+        out.append(f"... {len(records) - len(shown)} earlier records elided ...")
+    out.extend(_fmt_record(r, t0) for r in shown)
+    snap = doc.get("metrics")
+    if snap:
+        out.append("metrics highlights:")
+        counters = snap.get("counters", {})
+        for name in sorted(counters):
+            if counters[name]:
+                out.append(f"  {name} = {counters[name]}")
+        gauges = snap.get("gauges", {})
+        for name in sorted(gauges):
+            out.append(f"  {name} = {gauges[name]:g}")
+        for name, v in sorted(snap.get("info", {}).items()):
+            out.append(f"  {name} = {v}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="a flight-*.json, or a directory holding some "
+                                 "(newest is rendered)")
+    ap.add_argument("--tail", type=int, default=20,
+                    help="how many trailing ring records to show")
+    args = ap.parse_args(argv)
+
+    path = Path(args.path)
+    if path.is_dir():
+        found = flight.latest_flight_record(path)
+        if found is None:
+            print(f"no flight-*.json under {path}", file=sys.stderr)
+            return 1
+        path = found
+    try:
+        doc = flight.load_flight_record(path)
+    except (OSError, ValueError) as e:
+        print(f"{path}: not a readable flight record ({e})", file=sys.stderr)
+        return 1
+    print(f"== {path}")
+    print(render(doc, tail=args.tail))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
