@@ -43,8 +43,12 @@ from distributed_gol_tpu.engine.events import (
     TurnsCompleted,
     TurnTiming,
 )
-from distributed_gol_tpu.engine.controller import DispatchTimeout
+from distributed_gol_tpu.engine.controller import (
+    CorruptionDetected,
+    DispatchTimeout,
+)
 from distributed_gol_tpu.engine.gol import run, start
+from distributed_gol_tpu.engine.supervisor import GracefulStop, supervise
 
 __all__ = [
     "AliveCellsCount",
@@ -52,6 +56,7 @@ __all__ = [
     "CellFlipped",
     "CellsFlipped",
     "CheckpointSaved",
+    "CorruptionDetected",
     "CycleDetected",
     "DispatchError",
     "DispatchTimeout",
@@ -59,6 +64,7 @@ __all__ = [
     "EventQueue",
     "FinalTurnComplete",
     "FrameReady",
+    "GracefulStop",
     "ImageOutputComplete",
     "MetricsReport",
     "Params",
@@ -69,6 +75,7 @@ __all__ = [
     "TurnTiming",
     "run",
     "start",
+    "supervise",
 ]
 
 __version__ = "0.4.0"

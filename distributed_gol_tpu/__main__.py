@@ -138,6 +138,27 @@ def build_parser() -> argparse.ArgumentParser:
                          "dispatch boundaries (refused by multi-host runs)")
     ap.add_argument("--checkpoint-keep", type=int, default=3, metavar="K",
                     help="keep-last-K rotation for periodic checkpoints")
+    # Resilience (docs/API.md "Resilience").
+    ap.add_argument("--restart-limit", type=int, default=0, metavar="N",
+                    help="rollback-recovery supervisor: survive up to N "
+                         "terminal dispatch failures by restoring the "
+                         "newest checkpoint and resuming (rebuilding the "
+                         "backend, escalating to the ppermute exchange "
+                         "tier from the second restart); 0 = off, every "
+                         "terminal failure aborts as before")
+    ap.add_argument("--restart-window", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="restart-rate budget: with a window, "
+                         "--restart-limit bounds restarts per trailing "
+                         "window instead of per run (0 = per-run total)")
+    ap.add_argument("--sdc-check-every-turns", type=int, default=0,
+                    metavar="N",
+                    help="SDC sentinel: every N turns cross-check the "
+                         "resolved dispatch against a redundant stripe "
+                         "recompute + popcount fingerprint; a mismatch "
+                         "is terminal (CorruptionDetected) and rolls "
+                         "back under --restart-limit; keep N <= "
+                         "--checkpoint-every-turns; 0 disables")
     # Observability (docs/API.md "Observability").
     ap.add_argument("--metrics", action="store_true", default=True,
                     help="always-on run metrics: counters/gauges/histograms "
@@ -200,6 +221,9 @@ def params_from_args(args) -> Params:
         checkpoint_every_turns=args.checkpoint_every_turns,
         checkpoint_every_seconds=args.checkpoint_every_seconds,
         checkpoint_keep=args.checkpoint_keep,
+        restart_limit=args.restart_limit,
+        restart_window_seconds=args.restart_window,
+        sdc_check_every_turns=args.sdc_check_every_turns,
         metrics=args.metrics,
         flight_recorder_depth=args.flight_recorder_depth,
     )
@@ -223,14 +247,16 @@ def main(argv=None) -> int:
     return _drive(
         args,
         params,
-        lambda events, keys: start(params, events, keys, session),
+        lambda events, keys, stop: start(params, events, keys, session, stop=stop),
     )
 
 
 def _drive(args, params, start_engine) -> int:
     """The controller-process tail shared by single-host and multi-host
     entries: keyboard listener, viewer/drain loop, Ctrl-C → graceful 'q'
-    detach, optional profiler trace, final print + exit code."""
+    detach, SIGTERM → graceful-stop emergency checkpoint (the preemption
+    contract, docs/API.md "Resilience"), optional profiler trace, final
+    print + exit code."""
     # EventQueue: per-turn TurnComplete streams cost one queue entry per
     # dispatch instead of one per generation (consumer-side expansion keeps
     # the exact reference stream) — the CLI should ride the fast path.
@@ -242,12 +268,21 @@ def _drive(args, params, start_engine) -> int:
     restore_tty = keyboard_listener(key_presses, stop)
 
     import contextlib
+    import signal
 
+    from distributed_gol_tpu.engine.supervisor import GracefulStop
     from distributed_gol_tpu.utils.profiling import trace
+
+    # SIGTERM (a preemption notice) → graceful stop: the engine drains at
+    # the next turn boundary, forces an emergency checkpoint, and exits
+    # paused-and-resumable.  Ctrl-C keeps its reference-faithful 'q'
+    # detach below, so only SIGTERM is routed to the latch here.
+    graceful = GracefulStop()
+    restore_signals = graceful.install((signal.SIGTERM,))
 
     tracer = trace(args.trace) if args.trace else contextlib.nullcontext()
     with tracer:
-        engine_thread = start_engine(events, key_presses)
+        engine_thread = start_engine(events, key_presses, graceful)
         try:
             if params.no_vis:
                 final = run_headless(params, events)
@@ -262,6 +297,7 @@ def _drive(args, params, start_engine) -> int:
             final = run_headless(params, events)
         finally:
             stop.set()
+            restore_signals()
             if restore_tty is not None:
                 restore_tty()
         engine_thread.join(timeout=30)
@@ -286,15 +322,35 @@ def run_multihost(args, params, session) -> int:
         print("error: multi-host runs are headless; pass -noVis",
               file=sys.stderr)
         return 2
+    if params.restart_limit:
+        print("error: --restart-limit is single-host only for now "
+              "(multi-host backend rebuilds would need collective restart "
+              "coordination); use --checkpoint-every-turns + SIGTERM "
+              "preemption for multi-host resumability",
+              file=sys.stderr)
+        return 2
     multihost.initialize(args.coordinator, args.num_processes, args.process_id)
     if args.process_id != 0:
-        multihost.run_distributed(params)
+        # Followers arm their own preemption latch: the stop poll is a
+        # collective, so arming must be uniform across processes (process
+        # 0 arms in _drive), and a SIGTERM landing on ANY rank drains the
+        # whole mesh together.
+        import signal
+
+        from distributed_gol_tpu.engine.supervisor import GracefulStop
+
+        graceful = GracefulStop()
+        restore_signals = graceful.install((signal.SIGTERM,))
+        try:
+            multihost.run_distributed(params, stop=graceful)
+        finally:
+            restore_signals()
         return 0
 
-    def start_engine(events, keys):
+    def start_engine(events, keys, stop):
         t = threading.Thread(
             target=multihost.run_distributed,
-            args=(params, events, keys, session),
+            args=(params, events, keys, session, stop),
             daemon=True,
         )
         t.start()

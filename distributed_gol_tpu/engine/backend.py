@@ -42,6 +42,18 @@ def _megakernel_cache_stats() -> tuple[int, int]:
     return hits, misses
 
 
+def _board_fingerprint(bo):
+    """Position-weighted rolling hash of a board (mod 2^32), traced inside
+    the SDC probe jits.  ONE definition for both probe forms (full and
+    fingerprint-only): flight records compare fingerprints across runs, so
+    the two paths must stay bit-identical."""
+    bits = (bo != 0).astype(jnp.uint32)
+    hh, ww = bo.shape
+    wy = (jnp.arange(hh, dtype=jnp.uint32) * jnp.uint32(2654435761))[:, None]
+    wx = (jnp.arange(ww, dtype=jnp.uint32) * jnp.uint32(2246822519))[None, :]
+    return jnp.sum(bits * (wy ^ wx), dtype=jnp.uint32)
+
+
 class Backend:
     """Holds compiled step programs for one (rule, engine, mesh) config.
 
@@ -51,7 +63,10 @@ class Backend:
     "auto" prefers packed (fastest everywhere) then pallas (TPU) then roll.
     """
 
-    def __init__(self, params: Params, devices=None):
+    def __init__(self, params: Params, devices=None, in_kernel: bool | None = None):
+        # ``in_kernel=False`` forces the ppermute sharded exchange tier —
+        # the supervisor's escalation ladder rebuilds on it after a first
+        # same-tier restart fails (ISSUE 5); None = the normal tier policy.
         self.params = params
         self.table = jnp.asarray(params.rule.table)
         self._viewer_fns = {}  # fused per-turn step+count+view dispatches
@@ -167,6 +182,7 @@ class Backend:
                             params.image_width // 32,
                         ),
                         tile_cap=self._skip_cap,
+                        in_kernel=in_kernel,
                     )
                     self.sharded_tier = (
                         "ici-megakernel" if use_ici else "ppermute"
@@ -178,6 +194,7 @@ class Backend:
                         skip_stable=True,
                         skip_tile_cap=self._skip_cap,
                         with_stats=True,
+                        in_kernel=in_kernel,
                     )
                     self._skip_stats = []
                     self._superstep = self._skip_superstep
@@ -574,6 +591,114 @@ class Backend:
 
     def count(self, board: jax.Array) -> int:
         return int(stencil.alive_count(board))
+
+    # -- SDC sentinel probe (Params.sdc_check_every_turns; ISSUE 5) ------------
+    # Sampled-stripe height of the redundant recompute.  The recompute
+    # needs a ``turns``-row halo above and below the stripe (the light
+    # cone of one dispatch), so its device cost is
+    # ~min(1, (rows + 2·turns)/H) of one full dispatch — on the roll
+    # stencil, the independent slow-but-always-correct formulation, so
+    # the sentinel cross-checks the fast engine against a second
+    # implementation, not against itself.
+    _SDC_STRIPE_ROWS = 64
+    # Deepest dispatch the stripe recompute is allowed to replay.  The
+    # light-cone halo grows with depth, so past ~H/2 the "sampled
+    # stripe" is the whole board and the probe replays the ENTIRE
+    # dispatch on the slow formulation — adaptive batching grows k to
+    # 2^20, where that replay would outcost the run by orders of
+    # magnitude and trip a dispatch-sized watchdog deadline.  Beyond the
+    # cap the controller drops to the popcount/fingerprint leg only
+    # (``sdc_stripe_affordable``).
+    _SDC_MAX_STRIPE_TURNS = 512
+
+    def sdc_stripe_affordable(self, turns: int) -> bool:
+        """Whether the SDC stripe recompute stays a bounded, sampled
+        check for a ``turns``-deep dispatch (see
+        ``_SDC_MAX_STRIPE_TURNS``).  Pure function of the dispatch
+        depth, so multi-host processes decide identically."""
+        return turns <= self._SDC_MAX_STRIPE_TURNS
+
+    def sdc_probe(
+        self,
+        board_in: jax.Array,
+        board_out: jax.Array,
+        turns: int,
+        y0: int,
+        *,
+        stripe: bool = True,
+    ) -> tuple[bool, int, int]:
+        """One SDC sentinel check of a resolved dispatch
+        (``board_in`` --turns--> ``board_out``): returns
+        ``(stripe_ok, popcount, fingerprint)``.
+
+        ``stripe_ok``: recomputing the dispatch on the row stripe starting
+        at ``y0`` (toroidal window, exact by light-cone containment)
+        through the roll stencil reproduces ``board_out`` there.
+        ``popcount``: alive count of ``board_out`` — the caller
+        cross-checks it against the count the dispatch already forced.
+        ``fingerprint``: a position-weighted rolling hash of
+        ``board_out`` (mod 2^32), recorded in flight records so two runs
+        claiming the same turn can be compared cheaply.
+
+        ``stripe=False`` skips the recompute leg entirely (``stripe_ok``
+        is vacuously True): the controller's escape hatch for dispatches
+        deeper than ``_SDC_MAX_STRIPE_TURNS``, where the replay would
+        dominate the run.  The fingerprint-only jit is shared across all
+        depths, so deep adaptive runs stop minting one compiled probe
+        per distinct k.
+
+        One fused dispatch, one host fetch; sharded boards reduce under
+        jit (collectives line up because the sentinel cadence is a pure
+        function of the turn)."""
+        if not stripe:
+            fn = self._viewer_fns.get("sdc_fp")
+            if fn is None:
+
+                @jax.jit
+                def fn(bo):
+                    return stencil.alive_count(bo), _board_fingerprint(bo)
+
+                self._viewer_fns["sdc_fp"] = fn
+            pop, fp = self.fetch_many(*fn(board_out))
+            return True, int(pop), int(fp)
+        h = self.params.image_height
+        rows = min(h, self._SDC_STRIPE_ROWS)
+        pad = turns
+        window_rows = min(h, rows + 2 * pad)
+        fn = self._viewer_fns.get(("sdc", turns))
+        if fn is None:
+            table = self.table
+
+            @jax.jit
+            def fn(bi, bo, shift):
+                # Window rows y0-pad .. y0-pad+window_rows-1 (toroidal).
+                # After ``turns`` toroidal steps of the window, rows
+                # pad..pad+rows-1 are exact: the window's own row wrap is
+                # outside their light cone (or the window IS the whole
+                # rolled board, where the wrap is the true torus).
+                win = jnp.roll(bi, shift, axis=0)[:window_rows]
+                stepped = stencil.superstep(win, table, turns)
+                if window_rows == h:
+                    # The window IS the whole (rolled) torus — e.g. a
+                    # dispatch deeper than the board: compare it all.
+                    # Slicing [pad : pad + rows] here would clip (or, at
+                    # pad >= H, EMPTY) the comparison into a vacuous pass.
+                    got = stepped
+                    want = jnp.roll(bo, shift, axis=0)
+                else:
+                    # Partial window: rows pad..pad+rows-1 are exactly the
+                    # stripe (window_rows = rows + 2·pad, so the slice is
+                    # always full-height and non-empty here).
+                    got = stepped[pad : pad + rows]
+                    want = jnp.roll(bo, shift, axis=0)[pad : pad + rows]
+                ok = jnp.array_equal(got, want)
+                return ok, stencil.alive_count(bo), _board_fingerprint(bo)
+
+            self._viewer_fns[("sdc", turns)] = fn
+        ok, pop, fp = self.fetch_many(
+            *fn(board_in, board_out, jnp.int32(pad - y0))
+        )
+        return bool(ok), int(pop), int(fp)
 
     # -- whole-board cycle detection (Params.cycle_check) ----------------------
     _CYCLE_PERIOD = 6  # lcm(1, 2, 3): still lifes, blinkers, pulsars

@@ -77,6 +77,24 @@ class DispatchTimeout(RuntimeError):
     and raises this."""
 
 
+class CorruptionDetected(RuntimeError):
+    """The SDC sentinel (``Params.sdc_check_every_turns``) caught the
+    device state diverging from a redundant recompute — silent data
+    corruption, or a broken engine.  Terminal by policy and, unlike every
+    other terminal failure, the current board is NOT parked as a
+    checkpoint (it is the corrupt state); the rollback target is the last
+    periodic checkpoint, which the supervisor restores when armed
+    (``Params.restart_limit``)."""
+
+
+# ``Controller._maybe_sdc_check`` outcomes (both truthy — the probe hit
+# the device, so pipeline callers re-latch their clocks either way; only
+# a parking boundary distinguishes them: a skipped check is NOT a verify
+# and must withhold the park).
+_SDC_VERIFIED = "verified"
+_SDC_SKIPPED = "skipped"
+
+
 class _Watchdog:
     """Bounds blocking waits on dispatch results (the dispatch watchdog,
     ``Params.dispatch_deadline_seconds``).
@@ -215,21 +233,47 @@ class Controller:
         key_presses: Optional[queue.Queue] = None,
         session: Optional[Session] = None,
         backend: Optional[Backend] = None,
+        flight=None,
+        stop=None,
     ):
         self.params = params
         self.events = events
         self.key_presses = key_presses
         self.session = session if session is not None else default_session()
         self.backend = backend if backend is not None else Backend(params)
-        # "completed" | "detached" ('q') | "killed" ('k')
+        # "completed" | "detached" ('q') | "killed" ('k') | "preempted"
+        # (graceful stop: SIGTERM/SIGINT → emergency checkpoint → exit
+        # paused-and-resumable)
         self._outcome = "completed"
         self._paused = False
+        # Graceful-stop latch (ISSUE 5): any object with a ``requested``
+        # attribute (supervisor.GracefulStop); checked at turn boundaries.
+        # None = no preemption handling armed, zero clean-path cost.
+        self._stop = stop
+        # Sticky record of _stop_now() having returned True.  On
+        # multi-host runs _stop_now is a COLLECTIVE — call sites that
+        # need to act on an already-observed stop (the paused keys loop)
+        # consult this purely-local latch instead of issuing another
+        # collective off-schedule.  Every rank latches at the same
+        # schedule point (the allgather returned the same max), so reads
+        # stay deterministic across processes.
+        self._stop_seen = False
+        # Set by the supervisor: intermediate (restartable) aborts must
+        # not dump the flight ring or end the event stream — the
+        # supervisor owns both on the FINAL outcome.
+        self._supervised = False
         # -- observability (ISSUE 4) --
         # Process-wide registry (or the no-op null registry); instruments
         # are resolved HERE, the cold path, so hot-path bumps are plain
         # attribute adds on pre-bound objects.
         self.metrics = metrics_lib.registry_for(params.metrics)
-        self.flight = flight_lib.FlightRecorder(params.flight_recorder_depth)
+        # The supervisor passes its shared ring so restart history and the
+        # next attempt's records land in ONE postmortem artifact.
+        self.flight = (
+            flight
+            if flight is not None
+            else flight_lib.FlightRecorder(params.flight_recorder_depth)
+        )
         # The tier label every span carries: the sharded exchange tier
         # when one is in play, else the engine that actually runs.
         self._tier = self.backend.sharded_tier or self.backend.engine_used
@@ -279,6 +323,25 @@ class Controller:
         self._ckpt_save_warned = False  # one warning per run for failed saves
         self._last_ckpt_turn = 0
         self._last_ckpt_time = time.monotonic()
+        # Last SUCCESSFULLY saved checkpoint turn.  Distinct from the
+        # cadence anchor above, which advances on FAILED saves too (the
+        # retry-at-next-cadence policy): the emergency-checkpoint guard
+        # must ask "is the session resumable at this turn", not "did we
+        # recently try".
+        self._saved_ckpt_turn = 0
+        self._resumed = False  # did _initial_world CONSUME a checkpoint?
+        self._sdc_probe_warned = False  # one warning per run for probe errors
+        # -- resilience state (ISSUE 5) --
+        self._last_sdc_turn = 0
+        # (board_out, forced count) of the newest resolved dispatch —
+        # board_out is the live current board (no extra device pinning);
+        # the count lets a preemption cross-check the board it is about
+        # to park (``_preempt_exit``) without the long-dropped
+        # pre-dispatch board a stripe recompute would need.
+        self._last_resolved = None
+        self._m_sdc_checks = self.metrics.counter("sdc.checks")
+        self._m_sdc_mismatches = self.metrics.counter("sdc.mismatches")
+        self._m_preempt = self.metrics.counter("preempt.signals")
 
     # -- event helpers ---------------------------------------------------------
     def _emit(self, event):
@@ -368,6 +431,14 @@ class Controller:
                 key = self.key_presses.get(block=self._paused, timeout=0.05)
             except queue.Empty:
                 if not self._paused:
+                    return
+                if self._stop_now():
+                    # A graceful stop must drain a PAUSED run too: return
+                    # with the stop latched in _stop_seen — the call site
+                    # preempts at THIS turn, before any further dispatch
+                    # can advance the state the user froze (the paused
+                    # flag is identical on every process, so the
+                    # multi-host collective poll stays deterministic).
                     return
                 continue
             self._handle_key(key, board, turn)
@@ -551,24 +622,60 @@ class Controller:
             >= p.checkpoint_every_seconds
         )
 
-    def _maybe_checkpoint(self, board, turn: int) -> bool:
-        """Park a durable periodic checkpoint when one is due
-        (``Params.checkpoint_every_turns`` / ``checkpoint_every_seconds``)
-        so a crash at any instant leaves a resumable state.  Called only
-        with a settled board at an exact turn boundary; the turn cadence
-        is deterministic in the dispatch schedule, so on multi-host runs
+    def _ckpt_due_now(self, turn: int) -> bool:
+        """Whether THIS boundary will park a periodic checkpoint
+        (``Params.checkpoint_every_turns`` / ``checkpoint_every_seconds``).
+        Evaluated exactly once per boundary — the wall-clock cadence
+        reads ``time.monotonic()``, so deciding, running the (possibly
+        seconds-long) SDC probe, then re-deciding could flip the answer
+        between the sentinel and the save.  The turn cadence is
+        deterministic in the dispatch schedule, so on multi-host runs
         every process enters the collective ``fetch`` together (the
-        wall-clock cadence is refused there — ``run_distributed``).
-        Returns whether a checkpoint was written (callers re-latch their
-        pipeline clocks so the fetch stall is not billed to the next
-        dispatch)."""
+        wall-clock cadence is refused there — ``run_distributed``)."""
         if turn <= self._last_ckpt_turn or turn >= self.params.turns:
             # Nothing new to guard — and the final turn is about to become
             # the durable final PGM anyway (a completed run discards its
             # periodic checkpoints in _finalize).
             return False
-        if not self._checkpoint_due(turn):
-            return False
+        return self._checkpoint_due(turn)
+
+    def _guard_boundary(self, board_in, board_out, turn, k, count) -> bool:
+        """The turn-boundary resilience pair: SDC-check the dispatch that
+        just resolved, then park a periodic checkpoint if one is due —
+        in that order, with the sentinel FORCED (out of cadence) at any
+        boundary about to park.  Verify-before-park is what makes the
+        checkpoint trustworthy: without it the wall-clock cadence could
+        persist a board corrupted since the last check, and the
+        supervisor would roll back INTO corruption (``Params`` refuses
+        the analogous turn-cadence misconfiguration outright).  A
+        CorruptionDetected raised by the forced check propagates before
+        the save runs, so a corrupt board is never parked.  Returns
+        whether either leg stalled the pipeline on a device fetch
+        (callers re-latch their pipeline clocks)."""
+        self._last_resolved = (board_out, count)
+        due = self._ckpt_due_now(turn)
+        checked = self._maybe_sdc_check(
+            board_in, board_out, turn, k, count, force=due
+        )
+        if due and checked is _SDC_SKIPPED:
+            # The verify is what makes the park trustworthy: a transient
+            # probe error at a parking boundary (the correlated-failure
+            # case — a sick device corrupting state AND failing its own
+            # health check) must not park the never-verified board.
+            # Older checkpoints stay authoritative, and the cadence
+            # anchors are left alone, so the very next boundary is due
+            # again and parks once a forced check passes.
+            self.flight.record("ckpt_skipped_unverified", turn=turn)
+            due = False
+        wrote = due and self._checkpoint_now(board_out, turn)
+        return wrote or bool(checked)
+
+    def _checkpoint_now(self, board, turn: int) -> bool:
+        """The guarded fetch-and-save half of a checkpoint, shared by the
+        periodic cadence (``_guard_boundary``) and the out-of-cadence
+        emergency checkpoint a graceful stop forces (``_preempt_exit``) —
+        one home for the watchdog bound, the failure degradation, and the
+        obs records."""
         # The fetch blocks on the device (and, multi-host, is a collective
         # allgather): watchdog-bounded like every other blocking dispatch
         # wait, so a wedged device or dead peer surfaces as the terminal
@@ -621,9 +728,194 @@ class Controller:
         )
         self._ckpt_saved = True
         self._last_ckpt_turn = turn
+        self._saved_ckpt_turn = turn
         self._last_ckpt_time = time.monotonic()
         self._emit(CheckpointSaved(turn))
         return True
+
+    # -- graceful stop / preemption (ISSUE 5) ----------------------------------
+    def _stop_now(self) -> bool:
+        """Whether a graceful stop (SIGTERM/SIGINT latch) is pending —
+        polled at turn boundaries.  A seam: the multi-host controller
+        overrides this with a tiny allgather so ANY signalled rank stops
+        the whole collective together instead of vanishing mid-allgather
+        (``parallel/multihost.py``).  A True result is latched in
+        ``_stop_seen`` (here and in the override) so later code can act
+        on it without another poll."""
+        if self._stop is not None and bool(self._stop.requested):
+            self._stop_seen = True
+        return self._stop_seen
+
+    def _preempt_exit(self, board, turn: int):
+        """The preemption contract: a graceful stop observed at a turn
+        boundary forces an out-of-cadence EMERGENCY checkpoint (the same
+        guarded fetch path as the periodic cadence) and exits
+        paused-and-resumable — a fresh run with the same session resumes
+        at ``turn`` exactly.  If a periodic checkpoint at this very turn
+        already exists the save is skipped (the session is already
+        resumable); a failed save degrades exactly like a failed periodic
+        one (older checkpoints stay authoritative)."""
+        self._m_preempt.inc()
+        self.flight.record("preempt", turn=turn)
+        due = self._emergency_save_due(turn)
+        if due and self._last_sdc_turn != turn:
+            # Verify-before-park holds for the EMERGENCY checkpoint too:
+            # when the sentinel is armed and this boundary was not already
+            # checked, cross-check the board about to be parked against
+            # its dispatch's forced count (k=0: popcount/fingerprint leg
+            # only — the stripe recompute would need the pre-dispatch
+            # board, dropped long ago, and pinning it for the whole run
+            # would double peak board memory).  A CorruptionDetected here
+            # propagates BEFORE the save: the corrupt board is never
+            # parked, older checkpoints stay authoritative, and a
+            # supervisor rolls back instead of resuming into corruption.
+            lr = self._last_resolved
+            if lr is not None and lr[0] is board:
+                checked = self._maybe_sdc_check(
+                    board, board, turn, 0, lr[1], force=True
+                )
+                if checked is _SDC_SKIPPED:
+                    # A transient probe error means the board about to be
+                    # parked was never verified: withhold the emergency
+                    # save (same policy as _guard_boundary) — the exit
+                    # stays resumable from the last GOOD checkpoint
+                    # rather than durably committing an unverified board.
+                    self.flight.record("preempt_save_skipped", turn=turn)
+                    due = False
+        self._emit(StateChange(turn, State.QUITTING))
+        if due:
+            with spans.span("gol.preempt.checkpoint", turn=turn):
+                self._checkpoint_now(board, turn)
+        self._outcome = "preempted"
+
+    def _emergency_save_due(self, turn: int) -> bool:
+        """Whether the preemption needs an out-of-cadence save: gate on
+        the last SUCCESSFUL save — a failed periodic save at this same
+        boundary advanced the cadence anchor but left nothing resumable
+        here, so the emergency save must still be attempted (the failure
+        may have been transient, e.g. freed disk space).  A seam: the
+        answer depends on process-LOCAL disk outcomes (a follower's no-op
+        save "succeeds" while process 0's hits ENOSPC), and
+        ``_checkpoint_now``'s fetch is a collective — so the multi-host
+        controller overrides this to broadcast process 0's decision,
+        keeping every rank on the same side of that collective."""
+        return turn > self._saved_ckpt_turn
+
+    # -- SDC sentinel (ISSUE 5) ------------------------------------------------
+    def _maybe_sdc_check(
+        self,
+        board_in,
+        board_out,
+        turn: int,
+        k: int,
+        count: int,
+        force: bool = False,
+    ):
+        """Every ``Params.sdc_check_every_turns``, cross-check the
+        dispatch that just resolved (``board_in`` --k turns--> ``board_out``
+        with forced alive ``count``) against redundant on-device work:
+
+        - a recompute of the whole dispatch on a sampled row stripe
+          through the independent roll-stencil formulation, and
+        - a popcount + rolling-hash fingerprint of ``board_out``, whose
+          popcount must equal the count the dispatch already forced.
+
+        ``force=True`` runs the check out of cadence (still only when
+        the sentinel is armed): ``_guard_boundary`` forces it at every
+        boundary about to park a checkpoint, so nothing durable is ever
+        written unverified.  For dispatches too deep for the stripe
+        recompute to stay a sampled check
+        (``Backend.sdc_stripe_affordable``) only the popcount/fingerprint
+        leg runs — counted in ``sdc.stripe_skipped`` — instead of a
+        full-board slow-formulation replay that could outcost the run
+        and trip the dispatch watchdog.
+
+        The stripe start is a pure function of the turn, so multi-host
+        processes issue the identical collective.  A mismatch raises
+        :class:`CorruptionDetected` — terminal, never retried (the state
+        is corrupt; retrying computes garbage forward), and the board is
+        deliberately NOT parked; the supervisor rolls back to the last
+        periodic checkpoint instead.
+
+        Returns ``False`` when no probe ran (sentinel off / not due),
+        ``_SDC_VERIFIED`` on a passing check, or ``_SDC_SKIPPED`` when a
+        transient probe error skipped it — both truthy (the device was
+        hit either way, so pipeline callers re-latch their clocks), but
+        a parking boundary must treat ``_SDC_SKIPPED`` as NOT verified
+        and withhold the park (``_guard_boundary``, ``_preempt_exit``)."""
+        p = self.params
+        if not p.sdc_check_every_turns:
+            return False
+        if not force and turn - self._last_sdc_turn < p.sdc_check_every_turns:
+            return False
+        self._last_sdc_turn = turn
+        self._m_sdc_checks.inc()
+        # k == 0 is the preemption cross-check: board_out IS board_in, so
+        # only the popcount/fingerprint leg carries information.
+        stripe = k > 0 and self.backend.sdc_stripe_affordable(k)
+        if not stripe:
+            self.metrics.counter("sdc.stripe_skipped").inc()
+        # Golden-ratio hash of the turn: a deterministic, schedule-pure
+        # stripe sample (identical on every process of a multi-host run).
+        y0 = (turn * 2654435761) % p.image_height
+        with spans.span("gol.sdc.check", turn=turn, k=k):
+            try:
+                ok, pop, fp = self._watchdog.call(
+                    lambda: self.backend.sdc_probe(
+                        board_in, board_out, k, y0, stripe=stripe
+                    )
+                )
+            except DispatchTimeout as e:
+                # Wedged device: the watchdog abort policy — announce the
+                # cause on the stream like every other timed-out fetch,
+                # then let the terminal path run.
+                self._emit(DispatchError(turn, error=str(e), checkpointed=False))
+                raise
+            except Exception as e:  # noqa: BLE001 — transient device error
+                # The health check must not BE the failure: a transient
+                # probe error (the class the retry policy exists to
+                # absorb) skips this check — the data path's own
+                # retry/sentinel machinery owns real failures.  Warn once,
+                # count it, retry at the next cadence.
+                self.metrics.counter("sdc.probe_failures").inc()
+                self.flight.record(
+                    "sdc_probe_failed", turn=turn, error=str(e)[:200]
+                )
+                if not self._sdc_probe_warned:
+                    self._sdc_probe_warned = True
+                    import warnings
+
+                    warnings.warn(
+                        f"SDC probe at turn {turn} failed ({e}); check "
+                        "skipped, will retry at the next cadence",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+                return _SDC_SKIPPED
+        self.flight.record(
+            "sdc_check",
+            turn=turn,
+            ok=bool(ok),
+            fingerprint=int(fp),
+            stripe=stripe,
+        )
+        if ok and pop == count:
+            return _SDC_VERIFIED
+        self._m_sdc_mismatches.inc()
+        self.flight.record(
+            "sdc_mismatch",
+            turn=turn,
+            stripe_ok=bool(ok),
+            popcount=int(pop),
+            count=int(count),
+        )
+        err = CorruptionDetected(
+            f"SDC sentinel: device state at turn {turn} fails its redundant "
+            f"recompute (stripe y0={y0} ok={bool(ok)}, popcount {pop} vs "
+            f"forced count {count})"
+        )
+        self._emit(DispatchError(turn, error=str(err), checkpointed=False))
+        raise err
 
     # -- observability plumbing (ISSUE 4) --------------------------------------
     def _run_metrics(self) -> dict:
@@ -682,15 +974,27 @@ class Controller:
         try:
             self._run()
         except BaseException as e:
-            self._dump_flight(e)
-            self.events.put(None)
+            # Supervised attempts defer both the postmortem dump and the
+            # stream sentinel to the supervisor: a restartable abort is
+            # not the end of the stream, and a RECOVERED run must write no
+            # flight record at all (absence = nothing went wrong).
+            if not self._supervised:
+                self._dump_flight(e)
+                self.events.put(None)
             raise
 
     def _run(self):
         p = self.params
         board_np, start_turn = self._initial_world()
         self._last_ckpt_turn = start_turn
+        # A RESUMED run just CONSUMED the pair it started from (resume is
+        # consume-once), so the session is NOT resumable at start_turn —
+        # a preemption before the first new save must re-park the board,
+        # not skip on "already saved here".  Fresh runs (nothing consumed)
+        # keep the skip: preempting at turn 0 loses nothing.
+        self._saved_ckpt_turn = start_turn - 1 if self._resumed else start_turn
         self._last_ckpt_time = time.monotonic()
+        self._last_sdc_turn = start_turn
         viewer = p.wants_flips() or p.wants_frames()
 
         # Initial flips: one per alive cell of the *actual* starting world
@@ -749,10 +1053,21 @@ class Controller:
         self.frame_stride_effective = stride
         warm_frames = 0
         while turn < p.turns:
+            if self._stop_now():
+                self._preempt_exit(board, turn)
+                break
             self._poll_keys(board, turn)
             if self._outcome != "completed":
                 break
+            if self._stop_seen:
+                # A stop observed inside the paused keys loop must preempt
+                # at the turn the user froze — falling through would
+                # compute one more dispatch first (local latch; no extra
+                # collective, see _stop_now).
+                self._preempt_exit(board, turn)
+                break
             t0 = time.perf_counter()
+            board_in = board
             if wants_flips:
                 k = 1
                 board, count, coords = self._dispatch(
@@ -791,7 +1106,7 @@ class Controller:
             # with the pipelined headless path (DispatchRecorder), so the
             # two can never drift again.
             self._dispatch_rec.record(turn, k, time.perf_counter() - t0)
-            self._maybe_checkpoint(board, turn)
+            self._guard_boundary(board_in, board, turn, k, count)
         return board, turn
 
     def _measure_frame_rtt(
@@ -913,9 +1228,10 @@ class Controller:
             self._dispatch_rec.record(turn, k, dt)
             if adaptive and k == superstep:
                 superstep = self._next_superstep(k, dt, superstep, warm_sizes, cap)
-            if self._maybe_checkpoint(board_out, turn):
-                # The checkpoint's fetch stalled the pipeline; don't bill
-                # that host time to the next dispatch's adaptive sizing.
+            if self._guard_boundary(board_in, board_out, turn, k, count):
+                # The checkpoint/sentinel fetch stalled the pipeline;
+                # don't bill that host time to the next dispatch's
+                # adaptive sizing.
                 prev_resolve = time.perf_counter()
             return board_out
 
@@ -936,6 +1252,17 @@ class Controller:
 
         issued_turn = turn
         while True:
+            # Graceful stop (ISSUE 5): polled at the top of every
+            # iteration — a turn boundary, like the keys poll below.  On
+            # multi-host runs _stop_now is a tiny collective (any rank's
+            # SIGTERM stops everyone together), so it must be evaluated
+            # unconditionally at this schedule point on every process.
+            if self._stop_now():
+                if pending is not None:
+                    board = resolve()
+                if turn < p.turns:
+                    self._preempt_exit(board, turn)
+                    return board, turn
             # Keys are handled against a settled board and exact turn:
             # drain the pipeline first whenever a key is waiting (or we
             # are paused).  ``empty()`` is deterministic across processes
@@ -949,6 +1276,11 @@ class Controller:
                     issued_turn = turn
                 self._poll_keys(board, turn)
                 if self._outcome != "completed":
+                    return board, turn
+                if self._stop_seen and turn < p.turns:
+                    # Stop observed while paused: preempt at the frozen
+                    # turn (the pipeline was drained before _poll_keys).
+                    self._preempt_exit(board, turn)
                     return board, turn
             if probe_every and n_issued >= next_probe and issued_turn < p.turns:
                 next_probe = n_issued + probe_every
@@ -1085,6 +1417,19 @@ class Controller:
         else:
             t = turn
             while t < p.turns:
+                if self._stop_now():
+                    phase = (t - turn) % period
+                    board_t = (
+                        self._dispatch(
+                            lambda: self.backend.run_turns(board, phase)[0],
+                            board,
+                            t,
+                        )
+                        if phase
+                        else board
+                    )
+                    self._preempt_exit(board_t, t)
+                    return board_t, t
                 if self.key_presses is not None and (
                     self._paused or not self.key_presses.empty()
                 ):
@@ -1100,6 +1445,12 @@ class Controller:
                     )
                     self._poll_keys(board_t, t)
                     if self._outcome != "completed":
+                        return board_t, t
+                    if self._stop_seen:
+                        # Stop observed while paused mid-fast-forward:
+                        # preempt at the settled phase board, not one
+                        # chunk later.
+                        self._preempt_exit(board_t, t)
                         return board_t, t
                 end = min(t + self._FF_CHUNK, p.turns)
                 self._emit_turns(t + 1, end)
@@ -1122,6 +1473,7 @@ class Controller:
                 p.image_width, p.image_height, p.rule.notation
             )
             if ckpt is not None:
+                self._resumed = True
                 return ckpt.world, ckpt.turn
         return self._load_input(), 0
 

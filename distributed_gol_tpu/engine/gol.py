@@ -31,9 +31,23 @@ def run(
     key_presses: Optional[queue.Queue] = None,
     session: Optional[Session] = None,
     backend: Optional[Backend] = None,
+    stop=None,
 ) -> None:
-    """Drive one whole simulation, blocking until the event stream ends."""
-    Controller(params, events, key_presses, session, backend).run()
+    """Drive one whole simulation, blocking until the event stream ends.
+
+    ``stop`` (a ``supervisor.GracefulStop``, optional) arms preemption
+    handling: when its flag is raised — typically by a SIGTERM handler —
+    the run forces an emergency checkpoint at the next turn boundary and
+    exits paused-and-resumable.  With ``params.restart_limit > 0`` the
+    whole run is additionally supervised: terminal dispatch failures
+    roll back to the newest checkpoint and resume instead of aborting
+    (see ``engine/supervisor.py``; docs/API.md "Resilience")."""
+    if params.restart_limit > 0:
+        from distributed_gol_tpu.engine.supervisor import supervise
+
+        supervise(params, events, key_presses, session, backend, stop=stop)
+    else:
+        Controller(params, events, key_presses, session, backend, stop=stop).run()
 
 
 def start(
@@ -42,11 +56,12 @@ def start(
     key_presses: Optional[queue.Queue] = None,
     session: Optional[Session] = None,
     backend: Optional[Backend] = None,
+    stop=None,
 ) -> threading.Thread:
     """``go gol.Run(...)``: run in a daemon thread, return it."""
     t = threading.Thread(
         target=run,
-        args=(params, events, key_presses, session, backend),
+        args=(params, events, key_presses, session, backend, stop),
         name="gol-run",
         daemon=True,
     )

@@ -193,6 +193,38 @@ class Params:
     checkpoint_every_seconds: float = 0.0
     checkpoint_keep: int = 3
 
+    # --- resilience: the self-healing runtime (ISSUE 5; docs/API.md
+    # "Resilience").  PR 2 made every failure terminal-but-clean; these
+    # knobs make a production run SURVIVE them. ---
+    # Rollback-recovery supervisor: a terminal dispatch failure with a
+    # resumable checkpoint available no longer aborts the run — the
+    # supervisor tears the backend down, rebuilds it (escalating to the
+    # forced-ppermute exchange tier from the second restart), restores the
+    # newest intact checkpoint via the existing Session.check_states scan,
+    # and resumes.  This many restarts are allowed before the run degrades
+    # to today's sentinel abort (with the full restart history in the
+    # flight record).  0 (default) disables the supervisor entirely:
+    # gol.run() is exactly the PR-2 terminal-but-clean controller.
+    restart_limit: int = 0
+    # Restart-rate budget: with a window > 0, restart_limit bounds the
+    # restarts within any trailing window of this many seconds (a steady
+    # trickle of recoverable faults keeps being survived; a flap faster
+    # than the budget aborts).  0 (default) makes restart_limit a per-run
+    # total instead.
+    restart_window_seconds: float = 0.0
+    # SDC sentinel: every N completed turns (checked at dispatch
+    # boundaries against the settled board, like the checkpoint cadence)
+    # the controller cross-checks the dispatch it just resolved — a
+    # redundant recompute of the dispatch on a sampled row stripe through
+    # the independent roll-stencil formulation, plus an on-device
+    # popcount/rolling-hash fingerprint whose popcount must equal the
+    # count the dispatch already forced.  A mismatch raises
+    # CorruptionDetected: terminal WITHOUT parking the (corrupt) board,
+    # which the supervisor treats as a rollback trigger.  Keep the
+    # cadence <= checkpoint_every_turns so a corruption is caught before
+    # it can be checkpointed.  0 (default) disables.
+    sdc_check_every_turns: int = 0
+
     # --- observability (ISSUE 4; see docs/API.md "Observability") ---
     # Always-on metrics registry: process-wide named counters/gauges/
     # histograms bumped on the dispatch and failure paths (plain attribute
@@ -269,6 +301,38 @@ class Params:
             raise ValueError("checkpoint cadences must be >= 0 (0 disables)")
         if self.checkpoint_keep < 1:
             raise ValueError("checkpoint_keep must be >= 1")
+        if self.restart_limit < 0:
+            raise ValueError(
+                "restart_limit must be >= 0 (0 disables the supervisor)"
+            )
+        if self.restart_window_seconds < 0:
+            raise ValueError(
+                "restart_window_seconds must be >= 0 (0 = per-run total)"
+            )
+        if self.sdc_check_every_turns < 0:
+            raise ValueError(
+                "sdc_check_every_turns must be >= 0 (0 disables the sentinel)"
+            )
+        if (
+            self.sdc_check_every_turns
+            and self.checkpoint_every_turns
+            and self.sdc_check_every_turns > self.checkpoint_every_turns
+        ):
+            # A checkpoint cadence finer than the sentinel's can persist
+            # corruption BEFORE it is checked; the rollback would then
+            # "recover" into corrupt state — silently defeating both
+            # features the user armed.  (The wall-clock cadence
+            # ``checkpoint_every_seconds`` cannot be ordered against a
+            # turn cadence here; the controller instead FORCES an
+            # out-of-cadence SDC check at any boundary about to park —
+            # verify-before-park, ``Controller._guard_boundary`` — so no
+            # unverified board is ever durably written while the
+            # sentinel is armed.)
+            raise ValueError(
+                "sdc_check_every_turns must be <= checkpoint_every_turns "
+                "when both are set: a corruption must be caught before it "
+                "can be checkpointed"
+            )
         if self.flight_recorder_depth < 0:
             raise ValueError(
                 "flight_recorder_depth must be >= 0 (0 disables the recorder)"

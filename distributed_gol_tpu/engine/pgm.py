@@ -84,12 +84,57 @@ def encode_pgm(board: np.ndarray) -> bytes:
     return buf.getvalue()
 
 
-def write_pgm(path: str | os.PathLike, board: np.ndarray) -> None:
+def write_pgm(
+    path: str | os.PathLike, board: np.ndarray, durable: bool = False
+) -> None:
     """Write a board to ``path``, creating parent directories (the reference
     mkdirs ``out/``, ``gol/io.go:44``).  Write is atomic (tmp + rename) so a
-    crash mid-snapshot never leaves a torn checkpoint."""
+    crash mid-snapshot never leaves a torn checkpoint.
+
+    ``durable=True`` additionally fsyncs the file before the rename and
+    the directory after it — without the directory fsync a machine-kill
+    right after ``os.replace`` can lose the RENAME itself (the data made
+    it, the directory entry didn't), which would defeat the emergency-
+    checkpoint guarantee the Session paths rely on (ISSUE 5 satellite).
+    Plain snapshots keep the cheap non-durable form."""
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
+    if durable:
+        write_bytes_durable(path, encode_pgm(board))
+        return
     tmp = path.with_name(path.name + ".tmp")
     tmp.write_bytes(encode_pgm(board))
     os.replace(tmp, path)
+
+
+def write_bytes_durable(path: str | os.PathLike, data: bytes) -> None:
+    """Machine-kill-durable atomic write: tmp + fsync(file) before the
+    rename, fsync(directory) after it.  ONE home for that ordering — the
+    checkpoint commit protocol (world, then sidecar as the commit record)
+    relies on it from two writers (``write_pgm(durable=True)`` and the
+    Session's JSON sidecars), and a fix to the sequence must reach both."""
+    path = Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(path.parent)
+
+
+def fsync_dir(directory: str | os.PathLike) -> None:
+    """fsync a directory so a completed ``os.replace`` into it survives a
+    machine kill.  Best-effort: platforms that cannot open or fsync a
+    directory (e.g. Windows) degrade silently — the write is still atomic,
+    just not machine-kill-durable there."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)

@@ -15,9 +15,12 @@ directory it also persists checkpoints as PGM + sidecar metadata, so a brand
 new process can resume — strictly more durable than the reference, whose
 checkpoint dies with the broker process.
 
-Durability contract (ISSUE 2): every persisted checkpoint is crash-safe.
-The world PGM is written first, then the sidecar — each atomically
-(tmp + ``os.replace``) — and the sidecar carries the world's CRC32, so the
+Durability contract (ISSUE 2, hardened in ISSUE 5): every persisted
+checkpoint is crash-safe AND machine-kill-safe.  The world PGM is written
+first, then the sidecar — each atomically (tmp + ``os.replace``) and each
+fsync'd, file and directory, so a preemption that kills the machine right
+after the replace cannot lose the rename — and the sidecar carries the
+world's CRC32, so the
 sidecar is the commit record: it never points at a world that is not fully
 on disk, and a torn world left by a crash (or a corrupt/truncated sidecar)
 is detected at resume, warned about once, and skipped rather than resumed.
@@ -281,7 +284,11 @@ class Session:
         # the commit record.  A crash before the meta replace leaves the
         # previous pair (or no pair) authoritative; a torn world under an
         # existing sidecar fails the sidecar's CRC and is skipped at resume.
-        pgm.write_pgm(self._world_path, self._checkpoint.world)
+        # Both writes are DURABLE (fsync file + directory, ISSUE 5
+        # satellite): a preemption that kills the machine right after the
+        # replace must not lose the rename, or the emergency-checkpoint
+        # guarantee is a lie.
+        pgm.write_pgm(self._world_path, self._checkpoint.world, durable=True)
         self._persist_meta(paused=True)
         self._written_stems.add(self._ckpt_name)
 
@@ -307,9 +314,10 @@ class Session:
 
     @staticmethod
     def _write_json(path: Path, meta: dict):
-        tmp = path.with_name(path.name + ".tmp")
-        tmp.write_text(json.dumps(meta))
-        os.replace(tmp, path)
+        # Durable like the world write: the sidecar is the COMMIT record,
+        # so losing its rename to a machine kill un-commits a checkpoint
+        # the caller was told exists.
+        pgm.write_bytes_durable(path, json.dumps(meta).encode())
 
     def _rotate(self, keep: int):
         """Prune THIS session's rotated pairs beyond the newest ``keep``

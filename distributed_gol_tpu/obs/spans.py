@@ -11,7 +11,9 @@ above the kernels that dispatch produced.
 Naming convention (documented in docs/API.md "Observability"):
 ``gol.<operation>`` with labels as TraceMe metadata — ``gol.issue``,
 ``gol.resolve``, ``gol.dispatch.sync``, ``gol.checkpoint.fetch``,
-``gol.cycle_probe``, ``gol.park``, ``gol.broadcast.<what>``.
+``gol.cycle_probe``, ``gol.park``, ``gol.broadcast.<what>``, and the
+resilience layer's ``gol.supervisor.restore``, ``gol.sdc.check``,
+``gol.preempt.checkpoint`` (ISSUE 5).
 
 Degrades exactly like ``utils.profiling.trace``: on a stripped jax build
 (no profiler backend) every helper returns ``contextlib.nullcontext`` —

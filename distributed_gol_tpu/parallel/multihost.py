@@ -210,7 +210,7 @@ def make_backend(params):
     return MultihostBackend(params, devices=jax.devices())
 
 
-def run_distributed(params, events=None, key_presses=None, session=None):
+def run_distributed(params, events=None, key_presses=None, session=None, stop=None):
     """The full controller contract over a process-spanning mesh.
 
     Call on EVERY process after :func:`initialize`.  Process 0 drives:
@@ -229,7 +229,32 @@ def run_distributed(params, events=None, key_presses=None, session=None):
     keypress broadcast) and every process runs the identical dispatch
     schedule.  The auto ``skip_stable`` long-run policy rides on this: it
     resolves from Params alone, identically everywhere.
+
+    ``stop`` (a ``supervisor.GracefulStop``, ISSUE 5): pass one on EVERY
+    process (or none) to arm preemption handling — each process installs
+    its own SIGTERM handler against its own latch, and the controller's
+    turn-boundary stop poll becomes a tiny allgather
+    (``MultihostController._stop_now``), so ONE signalled rank drains the
+    whole collective together: every process forces the emergency
+    checkpoint fetch in lockstep (process 0 persists it) and exits
+    paused-and-resumable, instead of the signalled rank vanishing
+    mid-allgather and wedging the survivors.  Arming must be uniform —
+    the poll is a collective, so stop-armed and stop-less processes would
+    diverge the schedule.
     """
+    try:
+        return _validate_and_run(params, events, key_presses, session, stop)
+    except BaseException:
+        # The controller guarantees the stream sentinel for failures inside
+        # its run; failures BEFORE it starts — params validation, backend
+        # construction, resume negotiation — must not leave a listener
+        # blocked forever either.
+        if events is not None:
+            events.put(None)
+        raise
+
+
+def _validate_and_run(params, events, key_presses, session, stop):
     if not params.no_vis or params.wants_flips() or params.wants_frames():
         raise ValueError("multi-host runs are headless (no_vis=True)")
     if params.checkpoint_every_seconds:
@@ -239,19 +264,22 @@ def run_distributed(params, events=None, key_presses=None, session=None):
             "diverge the SPMD dispatch schedule between processes (the "
             "checkpoint fetch is a collective)"
         )
+    if params.restart_limit:
+        raise ValueError(
+            "multi-host runs do not support the rollback-recovery "
+            "supervisor yet (restart_limit must be 0): a restart tears "
+            "down and rebuilds the backend, which on a process-spanning "
+            "mesh is a collective act every process would have to "
+            "coordinate through a failure the runtime may only have "
+            "surfaced on one rank.  Refusing loudly beats silently "
+            "running without the recovery the flag promised; preemption "
+            "handling (stop=) and periodic checkpoints cover the "
+            "resumability story across hosts."
+        )
+    return _run_distributed(params, events, key_presses, session, stop)
 
-    try:
-        return _run_distributed(params, events, key_presses, session)
-    except BaseException:
-        # The controller guarantees the stream sentinel for failures inside
-        # its run; failures BEFORE it starts (backend construction, resume
-        # negotiation) must not leave a listener blocked forever.
-        if events is not None:
-            events.put(None)
-        raise
 
-
-def _run_distributed(params, events, key_presses, session):
+def _run_distributed(params, events, key_presses, session, stop=None):
     from jax.experimental import multihost_utils
 
     from distributed_gol_tpu.engine.controller import Controller, _Watchdog
@@ -317,7 +345,7 @@ def _run_distributed(params, events, key_presses, session):
             # instead of aborting with the sentinel.  Skip checkpointing:
             # the terminal DispatchError still reports checkpointed=False
             # and the stream still ends.  (PERIODIC checkpoints —
-            # Controller._maybe_checkpoint — do fetch collectively: their
+            # Controller._guard_boundary — do fetch collectively: their
             # turn cadence is deterministic in the dispatch schedule, so
             # every process enters that allgather together; they are the
             # resumable state a one-sided abort leaves behind.)
@@ -343,6 +371,9 @@ def _run_distributed(params, events, key_presses, session):
 
         def _initial_world(self):
             if negotiated is not None:
+                # The negotiation CONSUMED process 0's pair — same
+                # re-park-on-early-preempt semantics as the base class.
+                self._resumed = True
                 return negotiated
             return self._load_input(), 0
 
@@ -357,6 +388,53 @@ def _run_distributed(params, events, key_presses, session):
             # (same policy as _park_checkpoint above); the watchdog bounds
             # the force itself, like every other blocking collective wait.
             return self._watchdog.call(lambda: bool(flag))
+
+        def _stop_now(self):
+            # The preemption poll is COLLECTIVE (ISSUE 5): each process
+            # contributes its own latch and everyone acts on the max, so
+            # one signalled rank stops the whole mesh together — the
+            # emergency-checkpoint fetch that follows is a collective and
+            # must be entered by every process.  Called at schedule-
+            # deterministic turn boundaries only (same cadence as the
+            # keys broadcast), watchdog-bounded like every collective.
+            # stop=None on every process keeps this a no-op (arming must
+            # be uniform across processes — see run_distributed).
+            if self._stop is None:
+                return False
+            if self._stop_seen:
+                # Already observed collectively: every rank latched at the
+                # same allgather, so the short-circuit is identical
+                # everywhere and issues no further collective.
+                return True
+            mine = np.int32(1 if self._stop.requested else 0)
+            with spans.span("gol.broadcast.stop"):
+                flags = self._watchdog.call(
+                    lambda: np.atleast_1d(
+                        np.asarray(multihost_utils.process_allgather(mine))
+                    )
+                )
+            if flags.max():
+                self._stop_seen = True
+            return self._stop_seen
+
+        def _emergency_save_due(self, turn):
+            # Process 0 owns the durable session, so ITS last-successful-
+            # save state decides — broadcast, watchdog-bounded, reached by
+            # every rank together (the stop decision above was collective).
+            # Deciding locally would let a one-sided save failure (ENOSPC
+            # on process 0, while followers' no-op saves "succeed") split
+            # the ranks around _checkpoint_now's collective fetch.
+            mine = super()._emergency_save_due(turn)
+            with spans.span("gol.broadcast.emergency_due", turn=turn):
+                return bool(
+                    self._watchdog.call(
+                        lambda: int(
+                            multihost_utils.broadcast_one_to_all(
+                                np.int32(1 if mine else 0)
+                            )
+                        )
+                    )
+                )
 
         def _gather_snapshots(self, snap):
             # The multihost half of the MetricsReport (ISSUE 4): every
@@ -393,4 +471,4 @@ def _run_distributed(params, events, key_presses, session):
                     )
                 )
 
-    MultihostController(params, ev, keys, session, backend).run()
+    MultihostController(params, ev, keys, session, backend, stop=stop).run()

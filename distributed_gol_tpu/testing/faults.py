@@ -25,6 +25,16 @@ Fault kinds:
   dispatch watchdog exists for).  A safety timeout (``seconds``, default
   30) bounds the injected hang itself so an abandoned watchdog thread
   cannot outlive its test run.
+- ``corrupt`` — the dispatch succeeds but its RESULT is silently wrong:
+  ``cells`` seeded bit-flips are applied to the returned board at the
+  resolve seam (no error is raised — the silent-data-corruption mode the
+  SDC sentinel, ``Params.sdc_check_every_turns``, exists to catch).  The
+  flip locations are drawn from the plan RNG (``random.Random`` seeded
+  from the fault's own index), so the same plan corrupts the same cells
+  everywhere.  Use an odd ``cells`` count when the test relies on the
+  sentinel's popcount cross-check alone (an even mix of births/deaths
+  could cancel in the count; the stripe recompute has no such parity
+  blind spot).
 
 Determinism: a plan is a pure value.  Scripted plans are literal fault
 lists; :meth:`FaultPlan.random` derives the schedule from a seed via
@@ -48,7 +58,9 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Iterable, Sequence
 
-FAULT_KINDS = ("issue", "resolve", "latency", "hang")
+import numpy as np
+
+FAULT_KINDS = ("issue", "resolve", "latency", "hang", "corrupt")
 
 # Injected hangs self-release after this long if nothing (watchdog, test
 # teardown) got there first: a leaked daemon thread must not outlive the
@@ -63,6 +75,7 @@ class Fault:
     at: int
     kind: str
     seconds: float = 0.0  # latency duration / hang self-release timeout
+    cells: int = 1  # corrupt: number of seeded bit-flips
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -71,6 +84,8 @@ class Fault:
             raise ValueError(f"fault index must be >= 0, got {self.at}")
         if self.seconds < 0:
             raise ValueError(f"fault seconds must be >= 0, got {self.seconds}")
+        if self.cells < 1:
+            raise ValueError(f"fault cells must be >= 1, got {self.cells}")
 
 
 class FaultPlan:
@@ -168,6 +183,7 @@ class FaultPlan:
                 int(f["at"]),
                 str(f["kind"]),
                 seconds=float(f.get("seconds", 0.0)),
+                cells=int(f.get("cells", 1)),
             )
             for f in obj.get("faults", ())
         )
@@ -244,7 +260,27 @@ class FaultInjectionBackend:
             return new_board, _PoisonedScalar(
                 f"injected resolve-time failure (dispatch {i})"
             )
+        if fault.kind == "corrupt":
+            return self._corrupt(new_board, fault), count
         return new_board, _HangingScalar(self._release, fault.seconds)
+
+    def _corrupt(self, new_board, fault: Fault):
+        """Silently flip ``fault.cells`` seeded cells of the settled
+        result (the SDC injection): fetched to host, toggled, and re-put
+        through the wrapped backend so sharding/placement stay exactly
+        what the real backend would produce.  The count scalar is left as
+        computed from the UNCORRUPTED board — modelling corruption after
+        the count reduction, which the sentinel's popcount cross-check
+        exists to catch.  Deterministic: locations come from
+        ``random.Random`` seeded by the fault's own dispatch index."""
+        import jax
+
+        world = np.asarray(jax.device_get(new_board)).copy()
+        rng = random.Random(0xC0FFEE ^ (fault.at * 1000003))
+        h, w = world.shape
+        for _ in range(fault.cells):
+            world[rng.randrange(h), rng.randrange(w)] ^= 255
+        return self._inner.put(world)
 
     def run_turns(self, board, turns: int):
         # Through the seam above so retries are counted (and faultable).

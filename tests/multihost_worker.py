@@ -44,6 +44,8 @@ def main():
         return frontier_main(coordinator, nprocs, pid, okfile, sys.argv[6])
     if mode == "faults":
         return faults_main(coordinator, nprocs, pid, okfile, sys.argv[6])
+    if mode == "preempt":
+        return preempt_main(coordinator, nprocs, pid, okfile, sys.argv[6])
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -611,6 +613,158 @@ def faults_main(coordinator, nprocs, pid, okfile, out_dir):
     deadline = time.time() + 60
     while not os.path.exists(peer) and time.time() < deadline:
         time.sleep(0.5)
+    os._exit(0)
+
+
+def preempt_main(coordinator, nprocs, pid, okfile, out_dir):
+    """One-sided SIGTERM mid-run (ISSUE 5 tentpole leg 2, multi-host):
+    process 1 — a FOLLOWER, not the controller — receives a real SIGTERM
+    while the collective is mid-flight.  Its GracefulStop latch is polled
+    collectively (MultihostController._stop_now allgathers the flags), so
+    BOTH ranks observe the stop at the same turn boundary, enter the
+    emergency-checkpoint fetch together, and exit paused-and-resumable
+    within a bound — instead of the signalled rank vanishing and wedging
+    the survivor in a dead collective.  A resumed multi-host run then
+    completes and lands byte-identically on a single-device run of the
+    same parameters."""
+    import queue
+    import signal
+    import threading
+    import time
+    import traceback
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import distributed_gol_tpu as gol
+    from distributed_gol_tpu.engine.session import Session
+    from distributed_gol_tpu.engine.supervisor import GracefulStop
+    from distributed_gol_tpu.parallel import multihost
+
+    try:
+        multihost.initialize(coordinator, nprocs, pid)
+        my_out = os.path.join(out_dir, f"p{pid}")
+        os.makedirs(my_out, exist_ok=True)
+        # turns is effectively unbounded for phase 1 (the stop ends it);
+        # cycle_check=0 keeps the run dispatching until then.  Phase 2
+        # (resume) re-enables the cycle probe, so the settled 64² soup
+        # fast-forwards the tail and the whole test stays bounded.
+        params = gol.Params(
+            turns=10**6,
+            image_width=64,
+            image_height=64,
+            soup_density=0.3,
+            soup_seed=7,
+            out_dir=my_out,
+            superstep=10,
+            cycle_check=0,
+            turn_events="batch",
+            ticker_period=60.0,
+        )
+        stop = GracefulStop()
+        stop.install((signal.SIGTERM,))
+        ckpt_dir = os.path.join(out_dir, "ckpt")
+        started_marker = os.path.join(out_dir, "started")
+
+        if pid == 1:
+            # The one-sided signal: SIGTERM to SELF once process 0 has
+            # seen real progress (the marker), i.e. genuinely mid-run.
+            def send_sigterm():
+                deadline = time.time() + 120
+                while not os.path.exists(started_marker) and time.time() < deadline:
+                    time.sleep(0.05)
+                time.sleep(0.3)  # land between turn boundaries
+                os.kill(os.getpid(), signal.SIGTERM)
+
+            threading.Thread(target=send_sigterm, daemon=True).start()
+
+        t0 = time.monotonic()
+        if pid == 0:
+            ses = Session(ckpt_dir)
+            events: queue.Queue = queue.Queue()
+            seen = []
+
+            def pump():
+                while (e := events.get(timeout=180)) is not None:
+                    seen.append(e)
+                    if isinstance(
+                        e, (gol.TurnComplete, gol.TurnsCompleted)
+                    ) and not os.path.exists(started_marker):
+                        open(started_marker, "w").write("go")
+
+            t = threading.Thread(target=pump, daemon=True)
+            t.start()
+            multihost.run_distributed(params, events, None, ses, stop=stop)
+            t.join(timeout=30)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 120, f"preempt drain took {elapsed:.0f}s"
+            final = [e for e in seen if isinstance(e, gol.FinalTurnComplete)][0]
+            assert final.alive == (), "preempt must exit paused, not complete"
+            preempt_turn = final.completed_turns
+            assert 0 < preempt_turn < params.turns, preempt_turn
+            saved = [e for e in seen if isinstance(e, gol.CheckpointSaved)]
+            assert saved and saved[-1].completed_turns == preempt_turn
+            report = [e for e in seen if isinstance(e, gol.MetricsReport)][0]
+            # The signal landed on rank 1 only; the aggregated report
+            # (counters sum across processes) must show exactly one latch
+            # observed by the collective.
+            assert report.snapshot["counters"]["preempt.signals"] == nprocs
+        else:
+            multihost.run_distributed(params, stop=stop)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 120, f"preempt drain took {elapsed:.0f}s"
+            assert stop.requested and stop.signum == signal.SIGTERM
+
+        # Phase 2: the resumed multi-host run completes from the emergency
+        # checkpoint and lands byte-identically on a single-device run.
+        from dataclasses import replace
+
+        resumed = replace(params, cycle_check=8)
+        if pid == 0:
+            events2: queue.Queue = queue.Queue()
+            seen2 = []
+
+            def pump2():
+                while (e := events2.get(timeout=180)) is not None:
+                    seen2.append(e)
+
+            t2 = threading.Thread(target=pump2, daemon=True)
+            t2.start()
+            multihost.run_distributed(resumed, events2, None, Session(ckpt_dir))
+            t2.join(timeout=30)
+            final2 = [e for e in seen2 if isinstance(e, gol.FinalTurnComplete)][0]
+            assert final2.completed_turns == params.turns
+            first_turns = [
+                e
+                for e in seen2
+                if isinstance(e, (gol.TurnComplete, gol.TurnsCompleted))
+            ][0]
+            first = (
+                first_turns.first_turn
+                if isinstance(first_turns, gol.TurnsCompleted)
+                else first_turns.completed_turns
+            )
+            assert first == preempt_turn + 1, (first, preempt_turn)
+
+            single_out = os.path.join(out_dir, "single")
+            os.makedirs(single_out, exist_ok=True)
+            ev3: queue.Queue = queue.Queue()
+            gol.run(replace(resumed, out_dir=single_out), ev3)
+            while ev3.get(timeout=180) is not None:
+                pass
+            got = open(f"{my_out}/64x64x{params.turns}.pgm", "rb").read()
+            want = open(f"{single_out}/64x64x{params.turns}.pgm", "rb").read()
+            assert got == want, "preempted+resumed run differs from single-device"
+        else:
+            multihost.run_distributed(resumed)
+
+        with open(okfile, "w") as f:
+            f.write("ok")
+        print(f"[{pid}] one-sided SIGTERM: collective drain + resume ok", flush=True)
+    except BaseException:
+        traceback.print_exc()
+        os._exit(1)
     os._exit(0)
 
 

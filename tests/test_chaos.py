@@ -30,6 +30,7 @@ from distributed_gol_tpu.engine.controller import DispatchTimeout
 from distributed_gol_tpu.engine.events import CheckpointSaved, DispatchError
 from distributed_gol_tpu.engine.pgm import read_pgm
 from distributed_gol_tpu.engine.session import Session
+from distributed_gol_tpu.engine.supervisor import GracefulStop, supervise
 from distributed_gol_tpu.obs import flight as flight_lib
 from distributed_gol_tpu.testing.faults import (
     Fault,
@@ -331,3 +332,279 @@ def test_torn_sidecar_and_torn_world_degrade_to_no_checkpoint(tmp_path):
         gol.run(params, events, session=s2)
         final = [e for e in drain(events) if isinstance(e, gol.FinalTurnComplete)]
         assert final and final[0].completed_turns == params.turns
+
+
+# -- ISSUE 5: the self-healing runtime rows -----------------------------------
+#
+# The three legs of the resilience layer, hermetically: (1) the supervisor
+# survives a post-retry TERMINAL fault with a bit-identical final board,
+# (2) a graceful stop (the SIGTERM latch) mid-run yields a resumable
+# emergency checkpoint whose resumed run equals the oracle, (3) an
+# injected `corrupt` fault is caught by the SDC sentinel within its
+# cadence and rolled back to oracle-identical state — plus the ladder-
+# exhaustion degradation to PR 2's clean abort with the restart history
+# in the flight tail.  Supervisor-OFF preservation is the rest of this
+# file: every pre-existing row runs with restart_limit=0 (the default)
+# and still expects the PR-2 terminal-but-clean contract.
+
+
+def _fault_first_attempt(plan: FaultPlan):
+    """A supervisor backend factory: attempt 0 gets the fault harness,
+    every rebuilt attempt gets a clean backend of the same params."""
+
+    def factory(params, attempt):
+        backend = Backend(params)
+        return FaultInjectionBackend(backend, plan) if attempt == 0 else backend
+
+    return factory
+
+
+def run_supervised(params, backend_factory, session=None):
+    session = session if session is not None else Session()
+    events: queue.Queue = queue.Queue()
+    sup = supervise(
+        params, events, session=session, backend_factory=backend_factory
+    )
+    return drain(events), sup, session
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_supervisor_survives_terminal_burst(tier, tmp_path, oracle):
+    """Tentpole leg 1: a 2-failure burst defeats the retry budget — a
+    TERMINAL failure under PR 2 — but the supervisor restores the parked
+    checkpoint, rebuilds the backend, resumes, and the final board is
+    bit-identical to the fault-free oracle.  A recovered run writes no
+    flight record; its terminal MetricsReport documents the restart."""
+    s = TIERS[tier]["superstep"]
+    params = tier_params(
+        tier, tmp_path, checkpoint_every_turns=s, restart_limit=2
+    )
+    stream, sup, session = run_supervised(
+        params,
+        _fault_first_attempt(FaultPlan([Fault(2, "issue"), Fault(3, "issue")])),
+    )
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    # The retry and the terminal failure are still announced; the stream
+    # then CONTINUES through the recovery instead of ending.
+    assert [e.will_retry for e in errors] == [True, False]
+    assert_matches_oracle(tier, params, stream, oracle)
+    assert_no_flight(tmp_path)
+    assert len(sup.history) == 1
+    assert sup.history[0]["cause"] == "RuntimeError"
+    assert sup.recovery_times(), "restart left no measurable recovery gap"
+    report = [e for e in stream if isinstance(e, gol.MetricsReport)][0]
+    counters = report.snapshot["counters"]
+    assert counters["supervisor.restarts"] == 1
+    assert counters["faults.retries"] == 1
+    # Nothing left parked: the recovered run completed and consumed its
+    # own rollback state.
+    assert session.check_states(params.image_width, params.image_height) is None
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_corrupt_is_detected_and_rolled_back(tier, tmp_path, oracle):
+    """Tentpole leg 3: seeded bit-flips at the resolve seam (the `corrupt`
+    fault kind) are silent — no exception — so only the SDC sentinel can
+    see them.  It must catch the corruption within sdc_check_every_turns
+    turns (here: at the corrupted dispatch's own boundary), raise
+    CorruptionDetected WITHOUT checkpointing the corrupt board, and the
+    supervisor must roll back to the last clean checkpoint and land
+    bit-identically on the oracle."""
+    s = TIERS[tier]["superstep"]
+    params = tier_params(
+        tier,
+        tmp_path,
+        checkpoint_every_turns=s,
+        sdc_check_every_turns=s,
+        restart_limit=2,
+    )
+    stream, sup, _ = run_supervised(
+        params, _fault_first_attempt(FaultPlan([Fault(2, "corrupt", cells=3)]))
+    )
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert any("SDC sentinel" in e.error for e in errors)
+    assert not any(e.checkpointed for e in errors)  # corrupt board never parked
+    assert_matches_oracle(tier, params, stream, oracle)
+    assert_no_flight(tmp_path)
+    report = [e for e in stream if isinstance(e, gol.MetricsReport)][0]
+    counters = report.snapshot["counters"]
+    assert counters["sdc.mismatches"] == 1
+    assert counters["sdc.checks"] >= 2  # post-rollback checks pass again
+    assert counters["supervisor.restarts"] == 1
+    # Caught at the corrupted dispatch's own boundary, rolled back exactly
+    # one dispatch (the corruption struck dispatch 2 -> turn 3s; the last
+    # clean checkpoint is turn 2s).
+    assert sup.history[0]["cause"] == "CorruptionDetected"
+    assert sup.history[0]["from_turn"] == 3 * s
+    assert sup.history[0]["resume_turn"] == 2 * s
+    assert counters["supervisor.rollback_turns"] == s
+
+
+def test_restart_exhaustion_degrades_to_clean_abort(tmp_path):
+    """The restart-ladder bound: a backend that keeps producing terminal
+    failures exhausts restart_limit and the run degrades to PR 2's
+    sentinel abort — with every restart documented in the flight record
+    leading up to the abort tail."""
+    params = tier_params(
+        "single", tmp_path / "faulted", checkpoint_every_turns=4,
+        restart_limit=2,
+    )
+    (tmp_path / "faulted").mkdir()
+    # Terminal on the very first dispatch of EVERY attempt: no attempt
+    # makes progress, so the budget must genuinely exhaust.
+    plan = FaultPlan([Fault(0, "issue"), Fault(1, "issue")])
+
+    def always_faulty(p, attempt):
+        return FaultInjectionBackend(Backend(p), plan)
+
+    session = Session()
+    events: queue.Queue = queue.Queue()
+    with pytest.raises(RuntimeError):
+        supervise(params, events, session=session, backend_factory=always_faulty)
+    stream = drain(events)  # sentinel still guaranteed on the abort path
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert sum(1 for e in errors if not e.will_retry) == 3  # one per attempt
+    doc = assert_flight_explains(tmp_path / "faulted", "RuntimeError")
+    restarts = [r for r in doc["records"] if r["kind"] == "restart"]
+    assert [r["attempt"] for r in restarts] == [1, 2]
+    assert "supervisor_exhausted" in {r["kind"] for r in doc["records"]}
+    assert doc["metrics"]["counters"]["supervisor.restarts"] == 2
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_preempt_mid_run_yields_resumable_checkpoint(tier, tmp_path, oracle):
+    """Tentpole leg 2: a graceful stop (what the SIGTERM handler latches)
+    observed mid-run forces an out-of-cadence emergency checkpoint and
+    exits paused-and-resumable; a fresh controller on the same session
+    completes the run bit-identically to the never-preempted oracle.
+    Latency faults pace the run so the stop deterministically lands
+    before completion."""
+    ckpt_dir = tmp_path / "ckpt"
+    out = tmp_path / "preempted"
+    out.mkdir()
+    params = tier_params(tier, out)
+    superstep = TIERS[tier]["superstep"]
+    # 0.3 s per dispatch from dispatch 1 on: the stop (sent on the first
+    # TurnComplete) has seconds of margin before the run could finish.
+    backend = FaultInjectionBackend(
+        Backend(params),
+        FaultPlan([Fault(i, "latency", seconds=0.3) for i in range(1, 8)]),
+    )
+    stop = GracefulStop()
+    session = Session(ckpt_dir)
+    events: queue.Queue = queue.Queue()
+    thread = gol.start(params, events, session=session, backend=backend, stop=stop)
+    seen = []
+    while (e := events.get(timeout=60)) is not None:
+        seen.append(e)
+        if isinstance(e, gol.TurnComplete) and not stop.requested:
+            stop.request()
+    thread.join(timeout=60)
+    assert not thread.is_alive()
+
+    final = [e for e in seen if isinstance(e, gol.FinalTurnComplete)][0]
+    assert final.alive == ()  # paused exit, not a completion
+    assert superstep <= final.completed_turns < params.turns
+    saved = [e for e in seen if isinstance(e, CheckpointSaved)]
+    assert saved and saved[-1].completed_turns == final.completed_turns
+    report = [e for e in seen if isinstance(e, gol.MetricsReport)][0]
+    assert report.snapshot["counters"]["preempt.signals"] == 1
+    # A preempted run is a CLEAN exit: no postmortem artifact anywhere.
+    assert_no_flight(out)
+    assert_no_flight(ckpt_dir)
+
+    # Fresh-process analog: a new durable Session adopts the emergency
+    # checkpoint and the resumed run lands exactly on the oracle board.
+    resume_and_check(tier, tmp_path, Session(ckpt_dir), oracle)
+
+
+def test_stop_while_paused_preempts_at_the_frozen_turn(tmp_path, oracle):
+    """A graceful stop observed while the run is PAUSED must preempt at
+    the exact turn the user froze — not one dispatch later.  The paused
+    keys loop returns with the stop latched and the call site preempts
+    immediately; a fall-through would compute one more superstep and
+    park the emergency checkpoint past the frozen state."""
+    from distributed_gol_tpu.engine.events import State, StateChange
+
+    ckpt_dir = tmp_path / "ckpt"
+    out = tmp_path / "preempted"
+    out.mkdir()
+    params = tier_params("single", out)
+    # 0.3 s per dispatch: the 'p' sent on the first TurnComplete lands
+    # at a boundary with most of the run still ahead.
+    backend = FaultInjectionBackend(
+        Backend(params),
+        FaultPlan([Fault(i, "latency", seconds=0.3) for i in range(1, 8)]),
+    )
+    stop = GracefulStop()
+    keys: queue.Queue = queue.Queue()
+    session = Session(ckpt_dir)
+    events: queue.Queue = queue.Queue()
+    thread = gol.start(
+        params, events, keys, session=session, backend=backend, stop=stop
+    )
+    seen = []
+    paused_turn = None
+    pause_sent = False
+    while (e := events.get(timeout=60)) is not None:
+        seen.append(e)
+        if isinstance(e, gol.TurnComplete) and not pause_sent:
+            pause_sent = True
+            keys.put("p")
+        if (
+            isinstance(e, StateChange)
+            and e.new_state is State.PAUSED
+            and paused_turn is None
+        ):
+            paused_turn = e.completed_turns
+            stop.request()
+    thread.join(timeout=60)
+    assert not thread.is_alive()
+
+    assert paused_turn is not None and 0 < paused_turn < params.turns
+    final = [e for e in seen if isinstance(e, gol.FinalTurnComplete)][0]
+    assert final.alive == ()  # paused exit, not a completion
+    # The whole point: the run froze at paused_turn and stayed there.
+    assert final.completed_turns == paused_turn
+    saved = [e for e in seen if isinstance(e, CheckpointSaved)]
+    assert saved and saved[-1].completed_turns == paused_turn
+    report = [e for e in seen if isinstance(e, gol.MetricsReport)][0]
+    assert report.snapshot["counters"]["preempt.signals"] == 1
+    assert_no_flight(out)
+    assert_no_flight(ckpt_dir)
+    resume_and_check("single", tmp_path, Session(ckpt_dir), oracle)
+
+
+def test_wallclock_checkpoint_is_verified_before_park(tmp_path, oracle):
+    """Verify-before-park: with the sentinel armed, a wall-clock
+    checkpoint cadence (which cannot be ordered against the SDC turn
+    cadence at validation time) must never persist an unverified board.
+    The sentinel's own cadence here is far coarser than the run, so the
+    ONLY checks that can catch the corruption are the ones forced at
+    parking boundaries — without them the seconds cadence checkpoints
+    the corrupt board and the supervisor 'recovers' into corruption."""
+    s = TIERS["single"]["superstep"]
+    params = tier_params(
+        "single",
+        tmp_path,
+        checkpoint_every_seconds=1e-6,  # every boundary parks
+        sdc_check_every_turns=10**6,  # cadence alone would never check
+        restart_limit=2,
+    )
+    stream, sup, _ = run_supervised(
+        params, _fault_first_attempt(FaultPlan([Fault(2, "corrupt", cells=3)]))
+    )
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert any("SDC sentinel" in e.error for e in errors)
+    assert not any(e.checkpointed for e in errors)  # corrupt board never parked
+    assert_matches_oracle("single", params, stream, oracle)
+    assert_no_flight(tmp_path)
+    report = [e for e in stream if isinstance(e, gol.MetricsReport)][0]
+    counters = report.snapshot["counters"]
+    assert counters["sdc.mismatches"] == 1
+    assert counters["supervisor.restarts"] == 1
+    # Caught at the corrupted dispatch's own parking boundary: rollback is
+    # exactly one dispatch, to the verified checkpoint before it.
+    assert sup.history[0]["cause"] == "CorruptionDetected"
+    assert sup.history[0]["from_turn"] == 3 * s
+    assert sup.history[0]["resume_turn"] == 2 * s

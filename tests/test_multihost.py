@@ -151,6 +151,18 @@ def test_one_sided_failure_aborts_every_process(tmp_path):
     _launch_workers(tmp_path, "faults", extra=(str(out),))
 
 
+def test_one_sided_sigterm_drains_the_collective(tmp_path):
+    """ISSUE 5 tentpole leg 2, multi-host: a real SIGTERM lands on a
+    FOLLOWER rank mid-run; the collective stop poll makes every rank
+    observe it at the same turn boundary, force the emergency checkpoint
+    together (process 0 persists it), and exit paused-and-resumable,
+    bounded — then a resumed multi-host run completes byte-identically to
+    a single-device run (see multihost_worker.preempt_main)."""
+    out = tmp_path / "out"
+    out.mkdir()
+    _launch_workers(tmp_path, "preempt", extra=(str(out),))
+
+
 def test_two_process_frontier_parity(tmp_path):
     """Round-5 frontier strip kernel across a process-spanning mesh:
     skip_stable + superstep=0 on 512-row strips (frontier plan engaged),

@@ -1,0 +1,589 @@
+"""Unit tests for the self-healing runtime (ISSUE 5): the GracefulStop
+latch (including a real SIGTERM through the installed handler), the
+supervisor's restart budget and escalation ladder, the SDC probe's
+detection math, and the `corrupt` fault kind's determinism.  The
+end-to-end detect→rollback→bit-identical-recovery proofs live in the
+chaos matrix (tests/test_chaos.py); these pin the pieces."""
+
+import os
+import queue
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.engine.backend import Backend
+from distributed_gol_tpu.engine.session import Session
+from distributed_gol_tpu.engine.supervisor import (
+    GracefulStop,
+    Supervisor,
+    supervise,
+)
+from distributed_gol_tpu.testing.faults import Fault, FaultInjectionBackend, FaultPlan
+
+
+def small_params(**kw):
+    cfg = dict(
+        turns=24,
+        image_width=16,
+        image_height=16,
+        engine="roll",
+        superstep=4,
+        soup_density=0.25,
+        soup_seed=11,
+        cycle_check=0,
+        ticker_period=60.0,
+    )
+    cfg.update(kw)
+    return gol.Params(**cfg)
+
+
+# -- GracefulStop --------------------------------------------------------------
+
+def test_graceful_stop_latch_and_request():
+    stop = GracefulStop()
+    assert not stop.requested
+    stop.request()
+    assert stop.requested and stop.signum is None
+
+
+def test_graceful_stop_install_routes_a_real_sigterm():
+    """install() must route an actual delivered signal to the latch and
+    hand back a restore that reinstates the previous handler."""
+    prev = signal.getsignal(signal.SIGTERM)
+    stop = GracefulStop()
+    restore = stop.install((signal.SIGTERM,))
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        while not stop.requested and time.monotonic() < deadline:
+            time.sleep(0.01)  # delivery happens between bytecodes
+        assert stop.requested
+        assert stop.signum == signal.SIGTERM
+    finally:
+        restore()
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+# -- Params validation ---------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(restart_limit=-1),
+        dict(restart_window_seconds=-0.1),
+        dict(sdc_check_every_turns=-1),
+        # Sentinel coarser than the checkpoint cadence: corruption could
+        # be checkpointed before it is ever checked — refused.
+        dict(sdc_check_every_turns=8, checkpoint_every_turns=4),
+    ],
+)
+def test_resilience_params_validated(kw):
+    with pytest.raises(ValueError):
+        small_params(**kw)
+
+
+# -- restart budget ------------------------------------------------------------
+
+def _bare_supervisor(**kw) -> Supervisor:
+    return Supervisor(small_params(restart_limit=2, **kw), queue.Queue())
+
+
+def test_budget_total_mode():
+    sup = _bare_supervisor()
+    now = time.monotonic()
+    assert sup._budget_allows(now)
+    sup.history = [{}, {}]  # two restarts spent
+    assert not sup._budget_allows(now)
+
+
+def test_budget_rate_window_mode():
+    """With a window, the limit bounds restarts per trailing window —
+    old restarts age out, so a steady trickle keeps being survived."""
+    sup = _bare_supervisor(restart_window_seconds=10.0)
+    now = time.monotonic()
+    sup.history = [{}, {}, {}]  # total is NOT the bound in window mode
+    sup._restart_times = [now - 60.0, now - 30.0, now - 2.0]  # one recent
+    assert sup._budget_allows(now)
+    sup._restart_times = [now - 8.0, now - 2.0]  # two inside the window
+    assert not sup._budget_allows(now)
+
+
+# -- escalation ladder ---------------------------------------------------------
+
+def test_ladder_escalates_to_forced_ppermute():
+    """The default rebuild: restart 1 keeps the tier, restart 2 forces
+    the ppermute exchange fallback — recorded by the tier policy string
+    on a sharded adaptive config."""
+    params = small_params(
+        engine="pallas-packed",
+        mesh_shape=(2, 1),
+        skip_stable=True,
+        image_width=128,
+        image_height=64,
+        superstep=6,
+        turns=36,
+        restart_limit=3,
+    )
+    sup = Supervisor(params, queue.Queue())
+    assert sup._ladder_tier(1) == "same"
+    assert sup._ladder_tier(2) == "forced-ppermute"
+    b2 = sup._build_backend(2)
+    assert b2.sharded_tier == "ppermute"
+    assert b2.sharded_tier_policy == "forced-ppermute (in_kernel=False)"
+
+
+def test_first_attempt_uses_given_backend():
+    params = small_params(restart_limit=1)
+    backend = Backend(params)
+    sup = Supervisor(params, queue.Queue(), backend=backend)
+    assert sup._build_backend(0) is backend
+    assert sup._build_backend(1) is not backend
+
+
+# -- SDC probe -----------------------------------------------------------------
+
+def test_sdc_probe_passes_on_clean_dispatch(rng):
+    params = small_params()
+    backend = Backend(params)
+    board = backend.put(
+        np.where(rng.random((16, 16)) < 0.3, 255, 0).astype(np.uint8)
+    )
+    out, count = backend.run_turns(board, 4)
+    for y0 in (0, 5, 15):  # any stripe start, wraparound included
+        ok, pop, fp = backend.sdc_probe(board, out, 4, y0)
+        assert ok and pop == count
+
+
+def test_sdc_probe_catches_bit_flips(rng):
+    """Any single toggled cell must be caught: the 16-row board fits one
+    stripe, so the redundant roll-stencil recompute sees every cell (and
+    the popcount cross-check is parity-protected for odd flip counts)."""
+    params = small_params()
+    backend = Backend(params)
+    board = backend.put(
+        np.where(rng.random((16, 16)) < 0.3, 255, 0).astype(np.uint8)
+    )
+    out, count = backend.run_turns(board, 4)
+    import jax
+
+    for y, x in ((0, 0), (7, 3), (15, 15)):
+        world = np.asarray(jax.device_get(out)).copy()
+        world[y, x] ^= 255
+        ok, pop, fp = backend.sdc_probe(board, backend.put(world), 4, 5)
+        assert not ok or pop != count, f"flip at {(y, x)} went undetected"
+
+
+def test_sdc_probe_stripe_is_exact_on_tall_boards(rng):
+    """A board taller than stripe+2·halo exercises the windowed (partial)
+    recompute: it must still pass on clean data for stripes that wrap the
+    torus edge."""
+    params = small_params(image_width=32, image_height=256, turns=12)
+    backend = Backend(params)
+    board = backend.put(
+        np.where(rng.random((256, 32)) < 0.3, 255, 0).astype(np.uint8)
+    )
+    out, count = backend.run_turns(board, 4)
+    for y0 in (0, 130, 250):
+        ok, pop, fp = backend.sdc_probe(board, out, 4, y0)
+        assert ok and pop == count
+
+
+def test_sdc_probe_not_vacuous_on_deep_dispatches(rng):
+    """A dispatch deeper than the board (k >= H) collapses the recompute
+    window to the whole torus; the comparison must become a FULL-board
+    compare, never an empty (vacuously true) slice — clean still passes,
+    a popcount-preserving two-cell swap is still caught."""
+    params = small_params()
+    backend = Backend(params)
+    board = backend.put(
+        np.where(rng.random((16, 16)) < 0.3, 255, 0).astype(np.uint8)
+    )
+    k = 20  # > H = 16: pad alone exceeds the board
+    out, count = backend.run_turns(board, k)
+    ok, pop, fp = backend.sdc_probe(board, out, k, 5)
+    assert ok and pop == count
+    import jax
+
+    world = np.asarray(jax.device_get(out)).copy()
+    alive = np.argwhere(world != 0)
+    dead = np.argwhere(world == 0)
+    world[tuple(alive[0])] ^= 255
+    world[tuple(dead[0])] ^= 255  # popcount unchanged: only the stripe can see it
+    ok2, pop2, _ = backend.sdc_probe(board, backend.put(world), k, 5)
+    assert pop2 == count  # the swap really is popcount-neutral...
+    assert not ok2, "popcount-neutral corruption went undetected"
+
+
+def test_sdc_probe_fingerprint_only_mode(rng):
+    """``stripe=False`` (the deep-dispatch escape hatch): the stripe
+    recompute is skipped — ``stripe_ok`` is vacuously True even for
+    corruption only the stripe could see — while the popcount and
+    fingerprint legs still run and match the full probe's."""
+    params = small_params()
+    backend = Backend(params)
+    assert backend.sdc_stripe_affordable(backend._SDC_MAX_STRIPE_TURNS)
+    assert not backend.sdc_stripe_affordable(backend._SDC_MAX_STRIPE_TURNS + 1)
+    board = backend.put(
+        np.where(rng.random((16, 16)) < 0.3, 255, 0).astype(np.uint8)
+    )
+    out, count = backend.run_turns(board, 4)
+    ok_full, pop_full, fp_full = backend.sdc_probe(board, out, 4, 5)
+    ok, pop, fp = backend.sdc_probe(board, out, 4, 5, stripe=False)
+    assert (ok, pop, fp) == (True, pop_full, fp_full)
+    import jax
+
+    world = np.asarray(jax.device_get(out)).copy()
+    alive = np.argwhere(world != 0)
+    dead = np.argwhere(world == 0)
+    world[tuple(alive[0])] ^= 255
+    world[tuple(dead[0])] ^= 255  # popcount-neutral: invisible to this mode
+    corrupted = backend.put(world)
+    assert backend.sdc_probe(board, corrupted, 4, 5, stripe=False)[0]
+    # ...but an odd flip still trips the popcount leg.
+    world[tuple(dead[1])] ^= 255
+    _, pop3, _ = backend.sdc_probe(board, backend.put(world), 4, 5, stripe=False)
+    assert pop3 != count
+
+
+def test_deep_dispatch_check_skips_stripe_leg(tmp_path):
+    """A dispatch deeper than ``_SDC_MAX_STRIPE_TURNS`` must not replay
+    the whole run through the slow formulation: the sentinel drops to
+    the popcount/fingerprint leg, counts the skip, and the run completes
+    (cap lowered below the superstep to keep the test fast)."""
+    params = small_params(sdc_check_every_turns=4, out_dir=tmp_path)
+    backend = Backend(params)
+    backend._SDC_MAX_STRIPE_TURNS = params.superstep - 1
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events, session=Session(), backend=backend)
+    stream = []
+    while (e := events.get(timeout=30)) is not None:
+        stream.append(e)
+    report = [e for e in stream if isinstance(e, gol.MetricsReport)][0]
+    counters = report.snapshot["counters"]
+    assert counters["sdc.checks"] > 0
+    assert counters["sdc.stripe_skipped"] == counters["sdc.checks"]
+    assert "sdc.mismatches" not in counters
+
+
+def test_preempt_never_parks_an_unverified_corrupt_board(rng, tmp_path):
+    """Verify-before-park covers the EMERGENCY checkpoint too: with the
+    sentinel armed, a preemption whose board disagrees with its
+    dispatch's forced count (the corrupt-fault signature) raises
+    CorruptionDetected BEFORE the save — the corrupt board is never
+    durably parked; a truthful count parks normally."""
+    from distributed_gol_tpu.engine.controller import (
+        Controller,
+        CorruptionDetected,
+    )
+
+    params = small_params(sdc_check_every_turns=4, out_dir=tmp_path)
+    backend = Backend(params)
+    board0 = backend.put(
+        np.where(rng.random((16, 16)) < 0.3, 255, 0).astype(np.uint8)
+    )
+    board, count = backend.run_turns(board0, 4)
+    session = Session(tmp_path / "ckpt")
+    ctl = Controller(
+        params, queue.Queue(), None, session, backend, stop=GracefulStop()
+    )
+    ctl._last_resolved = (board, count + 1)  # count no longer matches
+    with pytest.raises(CorruptionDetected):
+        ctl._preempt_exit(board, 8)
+    assert session.check_states(params.image_width, params.image_height) is None
+
+    ctl2 = Controller(
+        params, queue.Queue(), None, session, backend, stop=GracefulStop()
+    )
+    ctl2._last_resolved = (board, count)
+    ctl2._preempt_exit(board, 8)
+    ckpt = session.check_states(params.image_width, params.image_height)
+    assert ckpt is not None and ckpt.turn == 8
+
+
+class _FailingProbe:
+    """Backend proxy whose ``sdc_probe`` raises until told to recover —
+    the correlated-failure case: a sick device that corrupts state AND
+    fails its own health check."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.healthy = False
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def sdc_probe(self, *a, **kw):
+        if not self.healthy:
+            raise RuntimeError("transient probe failure")
+        return self._inner.sdc_probe(*a, **kw)
+
+
+def test_parking_boundary_with_failed_probe_withholds_the_park(rng, tmp_path):
+    """Verify-before-park is only as good as the verify: a parking
+    boundary whose FORCED check was skipped (transient probe error) must
+    not park the never-verified board — the cadence anchor stays put, so
+    the next boundary retries and parks once a probe passes."""
+    import warnings as warnings_mod
+
+    from distributed_gol_tpu.engine.controller import Controller
+
+    params = small_params(
+        sdc_check_every_turns=4, checkpoint_every_turns=4, out_dir=tmp_path
+    )
+    backend = Backend(params)
+    flaky = _FailingProbe(backend)
+    board0 = backend.put(
+        np.where(rng.random((16, 16)) < 0.3, 255, 0).astype(np.uint8)
+    )
+    board, count = backend.run_turns(board0, 4)
+    session = Session(tmp_path / "ckpt")
+    ctl = Controller(params, queue.Queue(), None, session, flaky)
+    with warnings_mod.catch_warnings():
+        warnings_mod.simplefilter("ignore", RuntimeWarning)
+        stalled = ctl._guard_boundary(board0, board, 4, 4, count)
+    assert stalled  # the probe attempt still hit the device
+    assert session.check_states(params.image_width, params.image_height) is None
+    assert ctl._last_ckpt_turn == 0  # anchor untouched: next boundary is due
+    kinds = [r["kind"] for r in ctl.flight.records()]
+    assert "ckpt_skipped_unverified" in kinds
+
+    flaky.healthy = True  # probe recovers: the retried boundary parks
+    board2, count2 = backend.run_turns(board, 4)
+    ctl._guard_boundary(board, board2, 8, 4, count2)
+    ckpt = session.check_states(params.image_width, params.image_height)
+    assert ckpt is not None and ckpt.turn == 8
+
+
+def test_preempt_with_failed_probe_withholds_the_emergency_save(rng, tmp_path):
+    """Same policy at the preemption boundary: a skipped forced check
+    means the emergency save is withheld — the exit stays resumable from
+    the last GOOD checkpoint instead of durably committing an unverified
+    board."""
+    import warnings as warnings_mod
+
+    from distributed_gol_tpu.engine.controller import Controller
+
+    params = small_params(sdc_check_every_turns=4, out_dir=tmp_path)
+    backend = Backend(params)
+    flaky = _FailingProbe(backend)
+    board0 = backend.put(
+        np.where(rng.random((16, 16)) < 0.3, 255, 0).astype(np.uint8)
+    )
+    board, count = backend.run_turns(board0, 4)
+    session = Session(tmp_path / "ckpt")
+    ctl = Controller(
+        params, queue.Queue(), None, session, flaky, stop=GracefulStop()
+    )
+    ctl._last_resolved = (board, count)
+    with warnings_mod.catch_warnings():
+        warnings_mod.simplefilter("ignore", RuntimeWarning)
+        ctl._preempt_exit(board, 8)
+    assert ctl._outcome == "preempted"
+    assert session.check_states(params.image_width, params.image_height) is None
+    kinds = [r["kind"] for r in ctl.flight.records()]
+    assert "preempt_save_skipped" in kinds
+
+
+def test_sdc_fingerprint_is_deterministic(rng):
+    params = small_params()
+    backend = Backend(params)
+    board = backend.put(
+        np.where(rng.random((16, 16)) < 0.3, 255, 0).astype(np.uint8)
+    )
+    out, _ = backend.run_turns(board, 4)
+    fp1 = backend.sdc_probe(board, out, 4, 3)[2]
+    fp2 = backend.sdc_probe(board, out, 4, 9)[2]  # y0 moves the stripe only
+    assert fp1 == fp2  # the fingerprint hashes board_out, not the stripe
+
+
+# -- the corrupt fault kind ----------------------------------------------------
+
+def test_corrupt_fault_is_deterministic_and_silent(rng):
+    """Same plan, same cells: two corrupted runs produce byte-identical
+    boards, differing from the clean board in exactly `cells` cells — and
+    no exception is raised at the seam."""
+    params = small_params()
+    plan = FaultPlan([Fault(1, "corrupt", cells=3)])
+    boards = []
+    for _ in range(2):
+        harness = FaultInjectionBackend(Backend(params), plan)
+        board = harness.put(
+            np.where(
+                np.random.default_rng(42).random((16, 16)) < 0.3, 255, 0
+            ).astype(np.uint8)
+        )
+        board, _ = harness.run_turns(board, 4)  # dispatch 0: clean
+        board, _ = harness.run_turns(board, 4)  # dispatch 1: corrupted
+        boards.append(np.asarray(harness.fetch(board)))
+        assert [f.kind for f in harness.injected] == ["corrupt"]
+    assert np.array_equal(boards[0], boards[1])
+
+    clean = FaultInjectionBackend(Backend(params), FaultPlan())
+    board = clean.put(
+        np.where(np.random.default_rng(42).random((16, 16)) < 0.3, 255, 0).astype(
+            np.uint8
+        )
+    )
+    board, _ = clean.run_turns(board, 4)
+    board, _ = clean.run_turns(board, 4)
+    diff = boards[0] != np.asarray(clean.fetch(board))
+    assert int(diff.sum()) == 3
+
+
+def test_corrupt_fault_json_schedulable(tmp_path):
+    plan = FaultPlan.from_json('{"faults": [{"at": 2, "kind": "corrupt", "cells": 5}]}')
+    assert plan.faults == (Fault(2, "corrupt", cells=5),)
+    with pytest.raises(ValueError):
+        Fault(0, "corrupt", cells=0)
+
+
+# -- supervise() plumbing ------------------------------------------------------
+
+def test_sentinel_abort_unsupervised_is_terminal_but_clean(tmp_path):
+    """With the supervisor OFF (restart_limit=0, the default), a sentinel
+    mismatch keeps PR 2's contract: CorruptionDetected raises, the stream
+    ends with the sentinel, the flight record explains the abort — and
+    the corrupt board is NOT parked as a resumable checkpoint."""
+    params = small_params(sdc_check_every_turns=4, out_dir=tmp_path)
+    backend = FaultInjectionBackend(
+        Backend(params), FaultPlan([Fault(1, "corrupt", cells=3)])
+    )
+    session = Session()
+    events: queue.Queue = queue.Queue()
+    with pytest.raises(gol.CorruptionDetected):
+        gol.run(params, events, session=session, backend=backend)
+    stream = []
+    while (e := events.get(timeout=30)) is not None:  # sentinel guaranteed
+        stream.append(e)
+    errors = [e for e in stream if isinstance(e, gol.DispatchError)]
+    assert errors and "SDC sentinel" in errors[-1].error
+    assert not errors[-1].checkpointed
+    assert session.check_states(16, 16) is None  # corrupt state never parked
+    from distributed_gol_tpu.obs import flight as flight_lib
+
+    path = flight_lib.latest_flight_record(tmp_path)
+    assert path is not None
+    doc = flight_lib.load_flight_record(path)
+    assert doc["cause"] == "CorruptionDetected"
+    assert "sdc_mismatch" in {r["kind"] for r in doc["records"]}
+
+
+def test_supervise_returns_supervisor_and_preserves_clean_runs(tmp_path):
+    """restart_limit>0 with no faults: the supervised run is byte-for-byte
+    a clean run (no restarts, no flight record, stream ends once)."""
+    params = small_params(
+        restart_limit=2, checkpoint_every_turns=4, out_dir=tmp_path
+    )
+    events: queue.Queue = queue.Queue()
+    sup = supervise(params, events, session=Session())
+    stream = []
+    while (e := events.get(timeout=30)) is not None:
+        stream.append(e)
+    assert sup.history == []
+    final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)][0]
+    assert final.completed_turns == params.turns
+    assert not list(tmp_path.glob("flight-*.json"))
+
+
+def test_preempt_at_resume_point_re_parks(tmp_path):
+    """Resume is consume-once: a run preempted at its resume point (before
+    any new checkpoint) has just CONSUMED the only resumable pair, so the
+    emergency checkpoint must re-park the board — skipping on 'already
+    saved here' would exit 0 claiming resumable while nothing is."""
+    params = small_params(out_dir=tmp_path)
+    session = Session()
+    world = np.where(
+        np.random.default_rng(3).random((16, 16)) < 0.3, 255, 0
+    ).astype(np.uint8)
+    session.pause(True, world=world, turn=8, rule=params.rule.notation)
+    stop = GracefulStop()
+    stop.request()  # preemption lands before the first new dispatch
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events, session=session, stop=stop)
+    stream = []
+    while (e := events.get(timeout=30)) is not None:
+        stream.append(e)
+    final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)][0]
+    assert final.alive == () and final.completed_turns == 8
+    ckpt = session.check_states(16, 16, params.rule.notation)
+    assert ckpt is not None and ckpt.turn == 8, "resume point not re-parked"
+    assert np.array_equal(ckpt.world, world)
+
+
+def test_sdc_probe_error_degrades_to_skipped_check(tmp_path):
+    """A transient SDC-probe error must not kill the healthy run it was
+    checking: the check is skipped with a one-time warning and a counter,
+    and the run completes normally."""
+    import warnings as warnings_mod
+
+    params = small_params(sdc_check_every_turns=4, out_dir=tmp_path)
+    backend = Backend(params)
+
+    class FlakyProbe:
+        def __init__(self, inner):
+            self._inner = inner
+            self.probe_calls = 0
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def sdc_probe(self, *a, **kw):
+            self.probe_calls += 1
+            if self.probe_calls <= 2:
+                raise RuntimeError("transient probe failure")
+            return self._inner.sdc_probe(*a, **kw)
+
+    flaky = FlakyProbe(backend)
+    events: queue.Queue = queue.Queue()
+    with warnings_mod.catch_warnings(record=True) as caught:
+        warnings_mod.simplefilter("always")
+        gol.run(params, events, session=Session(), backend=flaky)
+    stream = []
+    while (e := events.get(timeout=30)) is not None:
+        stream.append(e)
+    final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)][0]
+    assert final.completed_turns == params.turns  # run survived its checkup
+    report = [e for e in stream if isinstance(e, gol.MetricsReport)][0]
+    counters = report.snapshot["counters"]
+    assert counters["sdc.probe_failures"] == 2
+    assert counters["sdc.checks"] > 2  # later checks ran (and passed)
+    assert "sdc.mismatches" not in counters
+    warned = [w for w in caught if "SDC probe" in str(w.message)]
+    assert len(warned) == 1  # one warning per run, not per failure
+    assert not list(tmp_path.glob("flight-*.json"))
+
+
+def test_multihost_refuses_restart_limit():
+    """The supervisor is single-host for now: run_distributed must refuse
+    restart_limit > 0 loudly (validation precedes any collective, so this
+    needs no distributed runtime) — silently running WITHOUT the recovery
+    the flag promised would be worse than an error."""
+    from distributed_gol_tpu.parallel import multihost
+
+    events: queue.Queue = queue.Queue()
+    with pytest.raises(ValueError, match="restart_limit"):
+        multihost.run_distributed(small_params(restart_limit=1), events)
+    assert events.get(timeout=5) is None  # pre-start failures still sentinel
+
+
+def test_gol_run_routes_to_supervisor(tmp_path):
+    """gol.run(params) with restart_limit>0 must survive a terminal burst
+    through the DEFAULT rebuild ladder (no factory injection)."""
+    params = small_params(
+        restart_limit=2, checkpoint_every_turns=4, out_dir=tmp_path
+    )
+    backend = FaultInjectionBackend(
+        Backend(params), FaultPlan([Fault(2, "issue"), Fault(3, "issue")])
+    )
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events, session=Session(), backend=backend)
+    stream = []
+    while (e := events.get(timeout=30)) is not None:
+        stream.append(e)
+    final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)][0]
+    assert final.completed_turns == params.turns
+    report = [e for e in stream if isinstance(e, gol.MetricsReport)][0]
+    assert report.snapshot["counters"]["supervisor.restarts"] == 1

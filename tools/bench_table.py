@@ -45,6 +45,11 @@ def main():
                     help="wall-clock seconds per controller-path row "
                     "(0 = auto: scales with board size so the jit compile "
                     "— ~20-40 s at 16384² — fits inside the window)")
+    ap.add_argument("--faults", metavar="PLAN", default=None,
+                    help="also run bench.bench_faults (ISSUE 2 + 5) and "
+                    "render the fault-tolerance arms: clean vs armed "
+                    "controller-path rates plus the supervisor arm's "
+                    "MTTR and restart columns ('{}' = empty plan)")
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -89,6 +94,11 @@ def main():
             f"{cups:.3e} | {'n/a' if ok is None else ok} |"
         )
 
+    if args.faults is not None:
+        from bench import bench_faults
+
+        print_faults_table(bench_faults(sizes[0], args.faults))
+
     if not args.paths:
         return
     # Product-surface rows: what a library user gets from gol.run() with a
@@ -131,6 +141,32 @@ def main():
                 f"| {size}² | {label} | {gps:,.0f} | {spread} | {reps} | "
                 f"{ratio} | {cache} | {retries} | {skip} |"
             )
+
+
+def print_faults_table(rec: dict) -> None:
+    """Render a ``bench.bench_faults`` record (ISSUE 2 + 5) as markdown:
+    the clean/armed controller-path rates plus the supervisor arm's MTTR
+    and restart columns."""
+    sup = rec["supervisor"]
+    clean = rec["clean"]
+    print()
+    print(
+        "| Fault arm | gens/s (median) | spread | reps | "
+        "MTTR (median s) | restarts | rollback turns |"
+    )
+    print("|---|---|---|---|---|---|---|")
+    print(
+        f"| clean | {clean['median']:,.0f} | {clean['spread']:.1%} | "
+        f"{clean['reps']} | n/a | n/a | n/a |"
+    )
+    print(
+        f"| armed | {rec['median']:,.0f} | {rec['spread']:.1%} | "
+        f"{rec['reps']} | n/a | n/a | n/a |"
+    )
+    print(
+        f"| supervisor | n/a | {sup['spread']:.1%} | {sup['reps']} | "
+        f"{sup['median']:.4f} | {sup['restarts']} | {sup['rollback_turns']} |"
+    )
 
 
 def metrics_cells(snap: dict | None) -> tuple[str, str, str]:
