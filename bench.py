@@ -732,6 +732,124 @@ def bench_supervisor(size: int, superstep: int, bursts: int = 3) -> dict:
     return record
 
 
+def bench_serve(
+    n_max: int,
+    size: int = 256,
+    superstep: int = 16,
+    target_seconds: float = 2.0,
+) -> dict:
+    """``--serve N``: per-tenant and aggregate gens/s through the
+    multi-tenant serving plane (ISSUE 6) at tenant counts {1, 4, 16}
+    capped at N.
+
+    Every tenant runs the same fixed-turn workload (distinct soup seeds)
+    with its own session, event stream, and ``tenant=``-labelled
+    metrics, multiplexed onto one pod; the published rows are the
+    per-tenant rate distribution ({reps=N, median, spread} — the
+    fairness picture) plus the aggregate pod throughput.  Turns are
+    sized from a single-tenant calibration run so one ladder step takes
+    ~``target_seconds``; the workload is fixed turns, not wall-clock, so
+    every tenant computes the identical generation count and rates are
+    comparable across N.  The embedded metrics snapshot carries the
+    ``serve.*`` admission/outcome counters and the per-tenant labelled
+    dispatch counters, lint-checked like every other artifact."""
+    import tempfile
+    from pathlib import Path
+
+    from distributed_gol_tpu.engine.params import Params
+    from distributed_gol_tpu.obs import metrics as obs_metrics
+    from distributed_gol_tpu.serve import ServeConfig, ServePlane
+    from distributed_gol_tpu.utils import measure
+
+    out_root = Path(tempfile.mkdtemp(prefix="gol_bench_serve_"))
+
+    def make_params(tenant: str, seed: int, turns: int) -> Params:
+        return Params(
+            turns=turns,
+            image_width=size,
+            image_height=size,
+            soup_density=0.3,
+            soup_seed=seed,
+            out_dir=out_root / tenant,
+            superstep=superstep,
+            turn_events="batch",
+            cycle_check=0,
+            ticker_period=60.0,
+        )
+
+    def run_pod(n: int, turns: int) -> tuple[list, float]:
+        """n tenants through one pod; returns (handles, wall seconds)."""
+        config = ServeConfig(
+            max_sessions=n, max_queued=0, max_total_cells=0
+        )
+        with ServePlane(config) as plane:
+            t0 = time.perf_counter()
+            handles = [
+                plane.submit(f"t{i}", make_params(f"t{i}", i, turns))
+                for i in range(n)
+            ]
+            if not plane.wait_idle(timeout=600):
+                sys.exit("error: --serve pod did not go idle within 600s")
+            wall = max(h.t_end for h in handles) - t0
+        bad = [h for h in handles if h.status != "completed"]
+        if bad:
+            sys.exit(f"error: --serve sessions did not complete: {bad}")
+        return handles, wall
+
+    # Calibration: one tenant, a few supersteps — warms the jit cache and
+    # sizes the ladder's fixed turn count to ~target_seconds per step.
+    cal_turns = 8 * superstep
+    handles, wall = run_pod(1, cal_turns)
+    rate = cal_turns / max(wall, 1e-6)
+    turns = int(max(cal_turns, min(rate * target_seconds, 200_000)))
+    turns -= turns % superstep
+    log(f"  serve calibration: {rate:,.0f} gens/s -> {turns} turns/tenant")
+
+    counts = sorted({c for c in (1, 4, 16) if c <= n_max} | {n_max})
+    metrics_before = obs_metrics.REGISTRY.snapshot()
+    rows = {}
+    agg_max = 0.0
+    stats_max: dict = {}
+    for n in counts:
+        handles, wall = run_pod(n, turns)
+        per_tenant = [turns / h.duration for h in handles]
+        aggregate = n * turns / wall
+        stats = measure.summarize(per_tenant)
+        rows[f"n{n}"] = {
+            "metric": f"gol_serve_{size}x{size}_n{n}",
+            "unit": "generations/sec",
+            # The headline is the pod's aggregate throughput; the stats
+            # block is the per-tenant distribution (reps = N tenants).
+            "value": round(aggregate, 2),
+            **stats,
+            "aggregate_gps": round(aggregate, 2),
+            "per_tenant_median_gps": round(stats["median"], 2),
+            "tenants": n,
+            "wall_s": round(wall, 3),
+        }
+        log(
+            f"  serve n={n}: aggregate {aggregate:,.0f} gens/s, "
+            f"per-tenant median {stats['median']:,.0f} "
+            f"(spread {stats['spread']:.1%})"
+        )
+        if n == counts[-1]:
+            agg_max, stats_max = aggregate, stats
+    record = {
+        "metric": f"gol_serve_{size}x{size}",
+        "unit": "generations/sec",
+        "value": round(agg_max, 2),
+        **stats_max,
+        "turns_per_tenant": turns,
+        "superstep": superstep,
+        "tenant_counts": rows,
+        "metrics": obs_metrics.REGISTRY.snapshot()
+        .delta(metrics_before)
+        .to_dict(),
+    }
+    log(f"  serve record: {json.dumps(record)[:400]}...")
+    return record
+
+
 def verify_engine(
     size: int,
     engine: str,
@@ -980,6 +1098,18 @@ def main():
         "(ops/pallas_packed.geometry_candidates).",
     )
     ap.add_argument(
+        "--serve",
+        type=int,
+        default=0,
+        metavar="N",
+        help="multi-tenant serving-plane mode (ISSUE 6): per-tenant and "
+        "aggregate gens/s at tenant counts {1,4,16} capped at N, each "
+        "tenant a fixed-turn small-board run multiplexed through "
+        "serve.ServePlane with its own session and tenant=-labelled "
+        "metrics.  Prints one lint-checked JSON line and exits "
+        "(BENCH_SERVE artifact).",
+    )
+    ap.add_argument(
         "--faults",
         metavar="PLAN",
         default=None,
@@ -1026,6 +1156,17 @@ def main():
         # The metrics-snapshot lint (ISSUE 4): same contract as the stats
         # lint above — a malformed embedded snapshot fails the run rather
         # than shipping a broken artifact.
+        obs_metrics.require_embedded_metrics(record)
+        print(json.dumps(record))
+        return
+
+    if args.serve:
+        # Small boards by design: the serving plane's value proposition
+        # is many small independent runs on one pod (per-launch overhead
+        # amortisation is the batched-board lever, ROADMAP item 1); an
+        # explicit --size <= 1024 is honoured for experiments.
+        record = bench_serve(args.serve, size=size if size <= 1024 else 256)
+        measure.require_headline_stats(record)
         obs_metrics.require_embedded_metrics(record)
         print(json.dumps(record))
         return

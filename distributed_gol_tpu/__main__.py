@@ -229,8 +229,168 @@ def params_from_args(args) -> Params:
     )
 
 
+def build_serve_parser() -> argparse.ArgumentParser:
+    """The ``serve`` subcommand (ISSUE 6): run one pod of the
+    multi-tenant serving plane — scripted tenants and/or re-adopted
+    parked ones — until every session reaches a terminal state or a
+    SIGTERM drains the pod (docs/API.md "Serving")."""
+    ap = argparse.ArgumentParser(
+        prog="distributed_gol_tpu serve",
+        description="multi-tenant serving pod: admission control, "
+        "per-session fault isolation, graceful SIGTERM drain",
+    )
+    ap.add_argument("--tenant", action="append", default=[],
+                    metavar="NAME:WxHxTURNS",
+                    help="submit one tenant session (repeatable), e.g. "
+                    "alice:512x512x10000; each gets a seeded soup board "
+                    "(seed derived from the name) and its own scoped "
+                    "checkpoint dir under --checkpoint-root")
+    ap.add_argument("--checkpoint-root", default=None, metavar="DIR",
+                    help="per-tenant checkpoint directories live under "
+                    "DIR/<tenant>; required for drain durability and "
+                    "--readopt")
+    ap.add_argument("--readopt", action="store_true",
+                    help="re-adopt every parked (resumable) tenant found "
+                    "under --checkpoint-root — the restarted-pod half of "
+                    "the drain contract; each resumes toward --turns")
+    ap.add_argument("--turns", type=int, default=10_000,
+                    help="turn target for re-adopted tenants (a resumed "
+                    "run continues from its checkpoint turn toward this)")
+    ap.add_argument("--max-sessions", type=int, default=4,
+                    help="resident session budget (concurrent runs)")
+    ap.add_argument("--max-queued", type=int, default=8,
+                    help="bounded admission wait queue; submissions past "
+                    "it are shed with AdmissionRejected")
+    ap.add_argument("--max-cells", type=int, default=2**24,
+                    help="per-session board budget in cells")
+    ap.add_argument("--max-total-cells", type=int, default=2**26,
+                    help="pod-wide cell budget (0 = unbounded)")
+    ap.add_argument("--deadline", type=float, default=0.0,
+                    help="dispatch watchdog deadline stamped on every "
+                    "session (0 = off): a wedged tenant aborts itself "
+                    "instead of pinning a pod worker")
+    ap.add_argument("--soup", type=float, default=0.3,
+                    help="soup density for scripted tenant boards")
+    ap.add_argument("--engine", default="auto",
+                    choices=["auto", "roll", "pallas", "packed", "pallas-packed"])
+    ap.add_argument("--superstep", type=int, default=0,
+                    help="generations per dispatch (0 = auto)")
+    ap.add_argument("--checkpoint-every-turns", type=int, default=0,
+                    help="periodic durable checkpoint cadence per session")
+    ap.add_argument("--restart-limit", type=int, default=0,
+                    help="per-session rollback-recovery supervisor budget "
+                    "(ISSUE 5); each tenant's ladder is its own")
+    ap.add_argument("--sdc-check-every-turns", type=int, default=0,
+                    help="per-session SDC sentinel cadence")
+    ap.add_argument("--drain-timeout", type=float, default=60.0,
+                    help="seconds a SIGTERM drain waits for resident "
+                    "sessions to emergency-checkpoint")
+    return ap
+
+
+def _parse_tenant_spec(spec: str) -> tuple[str, int, int, int]:
+    name, sep, geo = spec.partition(":")
+    parts = geo.split("x")
+    if not sep or not name or len(parts) != 3 or not all(p.isdigit() for p in parts):
+        raise ValueError(
+            f"--tenant wants NAME:WxHxTURNS (e.g. alice:512x512x10000), "
+            f"got {spec!r}"
+        )
+    w, h, turns = (int(p) for p in parts)
+    return name, w, h, turns
+
+
+def serve_main(argv) -> int:
+    import json
+    import zlib
+    from pathlib import Path
+
+    from distributed_gol_tpu.engine.params import Params
+    from distributed_gol_tpu.serve import (
+        AdmissionRejected,
+        ServeConfig,
+        ServePlane,
+    )
+
+    ap = build_serve_parser()
+    args = ap.parse_args(argv)
+    try:
+        specs = [_parse_tenant_spec(s) for s in args.tenant]
+    except ValueError as e:
+        ap.error(str(e))
+    if not specs and not args.readopt:
+        ap.error("nothing to serve: pass --tenant and/or --readopt")
+    if args.readopt and not args.checkpoint_root:
+        ap.error("--readopt needs --checkpoint-root")
+
+    config = ServeConfig(
+        max_sessions=args.max_sessions,
+        max_queued=args.max_queued,
+        max_cells_per_session=args.max_cells,
+        max_total_cells=args.max_total_cells,
+        default_deadline_seconds=args.deadline,
+        drain_timeout_seconds=args.drain_timeout,
+    )
+
+    def tenant_params(name: str, w: int, h: int, turns: int) -> Params:
+        return Params(
+            turns=turns,
+            image_width=w,
+            image_height=h,
+            engine=args.engine,
+            superstep=args.superstep,
+            soup_density=args.soup,
+            soup_seed=zlib.crc32(name.encode()) & 0x7FFFFFFF,
+            out_dir=Path(args.checkpoint_root or "out") / name,
+            checkpoint_every_turns=args.checkpoint_every_turns,
+            restart_limit=args.restart_limit,
+            sdc_check_every_turns=args.sdc_check_every_turns,
+            turn_events="batch",
+        )
+
+    plane = ServePlane(config, checkpoint_root=args.checkpoint_root)
+    restore = plane.install()  # SIGTERM -> graceful drain
+    try:
+        if args.readopt:
+            for name, info in plane.resumable_tenants().items():
+                shape = info.get("shape")
+                # Old sidecars may lack the shape field (Session guards
+                # the same way on adoption) — without it we cannot
+                # rebuild the Params, so skip that one tenant rather
+                # than crash the whole restarted pod.
+                if not isinstance(shape, (list, tuple)) or len(shape) != 2:
+                    print(f"cannot re-adopt {name}: checkpoint sidecar "
+                          f"has no board shape", file=sys.stderr)
+                    continue
+                h, w = shape
+                specs.append((name, w, h, max(args.turns, info["turn"])))
+                print(f"re-adopting {name}: turn {info['turn']}, {w}x{h}",
+                      file=sys.stderr)
+        handles = []
+        for name, w, h, turns in specs:
+            try:
+                handles.append(
+                    plane.submit(name, tenant_params(name, w, h, turns))
+                )
+            except AdmissionRejected as e:
+                print(f"tenant {name} shed: {e}", file=sys.stderr)
+        for handle in handles:
+            handle.wait()
+        summary = plane.drain()  # no-op when every session already ended
+        print(json.dumps({"health": plane.health(), "sessions": summary}))
+    finally:
+        restore()
+        plane.close()
+    bad = [h for h in handles if h.status == "failed"]
+    return 1 if bad else 0
+
+
 def main(argv=None) -> int:
     honour_env_platforms()
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        return serve_main(argv[1:])
     ap = build_parser()
     args = ap.parse_args(argv)
     try:

@@ -284,6 +284,7 @@ class Controller:
             emit=self._emit,
             emit_timing=params.emit_timing,
             qsize=qsize,
+            tenant=params.tenant,
         )
         self._m_pipeline_overlap = self.metrics.counter(
             "controller.pipeline_overlap"

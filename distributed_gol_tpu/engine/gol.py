@@ -32,6 +32,7 @@ def run(
     session: Optional[Session] = None,
     backend: Optional[Backend] = None,
     stop=None,
+    backend_factory=None,
 ) -> None:
     """Drive one whole simulation, blocking until the event stream ends.
 
@@ -41,12 +42,27 @@ def run(
     exits paused-and-resumable.  With ``params.restart_limit > 0`` the
     whole run is additionally supervised: terminal dispatch failures
     roll back to the newest checkpoint and resume instead of aborting
-    (see ``engine/supervisor.py``; docs/API.md "Resilience")."""
+    (see ``engine/supervisor.py``; docs/API.md "Resilience").
+
+    ``backend_factory(params, attempt)`` is the build seam the serving
+    plane and chaos harnesses use (ISSUE 6): supervised runs hand it to
+    the supervisor's rebuild ladder; unsupervised runs call it once with
+    ``attempt=0``.  An explicit ``backend`` wins for attempt 0."""
     if params.restart_limit > 0:
         from distributed_gol_tpu.engine.supervisor import supervise
 
-        supervise(params, events, key_presses, session, backend, stop=stop)
+        supervise(
+            params,
+            events,
+            key_presses,
+            session,
+            backend,
+            backend_factory=backend_factory,
+            stop=stop,
+        )
     else:
+        if backend is None and backend_factory is not None:
+            backend = backend_factory(params, 0)
         Controller(params, events, key_presses, session, backend, stop=stop).run()
 
 
@@ -57,11 +73,12 @@ def start(
     session: Optional[Session] = None,
     backend: Optional[Backend] = None,
     stop=None,
+    backend_factory=None,
 ) -> threading.Thread:
     """``go gol.Run(...)``: run in a daemon thread, return it."""
     t = threading.Thread(
         target=run,
-        args=(params, events, key_presses, session, backend, stop),
+        args=(params, events, key_presses, session, backend, stop, backend_factory),
         name="gol-run",
         daemon=True,
     )

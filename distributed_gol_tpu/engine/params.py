@@ -241,6 +241,19 @@ class Params:
     # dies; a clean run writes nothing.  0 disables.
     flight_recorder_depth: int = 256
 
+    # --- multi-tenant serving (ISSUE 6; docs/API.md "Serving") ---
+    # Tenant identity for runs multiplexed through the serving plane
+    # (``serve.ServePlane``): threads a ``tenant=`` label through the
+    # per-dispatch metrics (``obs.metrics.DispatchRecorder``) — and, via
+    # the run's metrics delta, through checkpoint-sidecar snapshots and
+    # the terminal ``MetricsReport`` — so one process-wide registry
+    # snapshot separates tenants.  Also the session's scoped checkpoint
+    # subdirectory name under the plane's checkpoint root, so it must be
+    # filesystem-safe (letters, digits, ``._-``; <= 64 chars).  None
+    # (default) = untenanted: metric names are exactly the pre-serving
+    # ones.
+    tenant: str | None = None
+
     # Input-source override: a random soup of this density instead of the
     # ``images/WxH.pgm`` file (framework extension — the reference ships
     # pre-made soups as PGMs, which stops being practical at 16384²+ where
@@ -337,6 +350,17 @@ class Params:
             raise ValueError(
                 "flight_recorder_depth must be >= 0 (0 disables the recorder)"
             )
+        if self.tenant is not None:
+            import re
+
+            # No all-dot names: "." / ".." are path traversal, not tenants.
+            if set(self.tenant) <= {"."} or not re.fullmatch(
+                r"[A-Za-z0-9._-]{1,64}", self.tenant
+            ):
+                raise ValueError(
+                    "tenant must be a filesystem-safe name (letters, "
+                    f"digits, '._-', <= 64 chars), got {self.tenant!r}"
+                )
         # Paths may arrive as strings from CLI/config files.
         object.__setattr__(self, "images_dir", Path(self.images_dir))
         object.__setattr__(self, "out_dir", Path(self.out_dir))

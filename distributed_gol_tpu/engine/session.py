@@ -255,6 +255,17 @@ class Session:
             return self._paused
 
     @property
+    def parked_turn(self) -> int | None:
+        """Turn of the in-memory parked checkpoint (None when not
+        paused) — how the serving plane's drain receipt reads a
+        session's progress when the caller owns the event stream and
+        the plane never saw its TurnComplete events (ISSUE 6)."""
+        with self._lock:
+            if not self._paused or self._checkpoint is None:
+                return None
+            return self._checkpoint.turn
+
+    @property
     def is_shutdown(self) -> bool:
         with self._lock:
             return self._shutdown

@@ -49,6 +49,24 @@ from distributed_gol_tpu.obs import metrics as metrics_lib
 from distributed_gol_tpu.obs import spans
 
 
+def route_signals(
+    handler: Callable, signals: tuple
+) -> Callable[[], None]:
+    """Route ``signals`` to ``handler``; returns a callable restoring the
+    previous handlers (process-global state — callers must put them
+    back).  The shared plumbing under :meth:`GracefulStop.install` and
+    ``serve.ServePlane.install``."""
+    prev = [(s, signal_mod.getsignal(s)) for s in signals]
+    for s in signals:
+        signal_mod.signal(s, handler)
+
+    def restore():
+        for s, h in prev:
+            signal_mod.signal(s, h)
+
+    return restore
+
+
 class GracefulStop:
     """The preemption latch: a process-wide ``requested`` flag the
     controller polls at turn boundaries (``Controller._stop_now``).
@@ -75,15 +93,7 @@ class GracefulStop:
     ) -> Callable[[], None]:
         """Route ``signals`` to :meth:`request`; returns a callable that
         restores the previous handlers."""
-        prev = [(s, signal_mod.getsignal(s)) for s in signals]
-        for s in signals:
-            signal_mod.signal(s, self.request)
-
-        def restore():
-            for s, h in prev:
-                signal_mod.signal(s, h)
-
-        return restore
+        return route_signals(self.request, signals)
 
 
 class Supervisor:

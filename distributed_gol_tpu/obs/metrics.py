@@ -165,6 +165,24 @@ class MetricsSnapshot:
         )
 
 
+def labelled(name: str, tenant: str | None = None) -> str:
+    """Instrument name carrying a ``tenant=`` label (ISSUE 6): the flat
+    registry stays flat — a labelled instrument is just a distinct name,
+    ``name{tenant=x}`` — so one process-wide snapshot separates tenants
+    multiplexed through the serving plane, and deltas/aggregation/lint
+    need no label machinery.  ``tenant=None`` returns ``name`` unchanged:
+    untenanted runs keep the exact pre-serving metric names."""
+    return name if tenant is None else f"{name}{{tenant={tenant}}}"
+
+
+def tenant_of(name: str) -> str | None:
+    """Inverse of :func:`labelled`: the tenant a snapshot key belongs to
+    (None = untenanted) — what per-tenant rollups key on."""
+    if name.endswith("}") and "{tenant=" in name:
+        return name[name.rindex("{tenant=") + 8 : -1]
+    return None
+
+
 class MetricsRegistry:
     """Named instruments; creation is locked (cold path), bumps are not
     (hot path).  ``snapshot()`` is the only aggregation point."""
@@ -203,6 +221,25 @@ class MetricsRegistry:
         """A string-valued label (engine in use, exchange tier, ...)."""
         with self._lock:
             self._info[name] = str(value)
+
+    def clear_tenant(self, tenant: str) -> None:
+        """Drop every instrument carrying this ``tenant=`` label — the
+        serving plane's eviction hook (ISSUE 6): a pod serving churning
+        tenant names must not grow the registry without bound.  Unlike
+        :meth:`clear_labels`, COUNTERS go too: an evicted tenant's
+        series is over (its run is terminal, nothing bumps the orphaned
+        instruments again), and snapshot deltas tolerate missing keys."""
+        with self._lock:
+            suffix = f"{{tenant={tenant}}}"
+            for store in (
+                self._counters,
+                self._gauges,
+                self._histograms,
+                self._gauge_fns,
+                self._info,
+            ):
+                for k in [k for k in store if k.endswith(suffix)]:
+                    del store[k]
 
     def clear_labels(self, prefix: str) -> None:
         """Drop every gauge, callback gauge, and info label under
@@ -294,6 +331,9 @@ class NullRegistry:
     def clear_labels(self, prefix: str) -> None:
         pass
 
+    def clear_tenant(self, tenant: str) -> None:
+        pass
+
     def snapshot(self, include_lazy: bool = True) -> MetricsSnapshot:
         return MetricsSnapshot(
             {
@@ -330,16 +370,29 @@ class DispatchRecorder:
         emit: Callable[[object], None],
         emit_timing: bool = False,
         qsize: Callable[[], int] | None = None,
+        tenant: str | None = None,
     ):
         self._flight = flight
         self._emit = emit
         self._emit_timing = emit_timing
         self._qsize = qsize
-        self._c_dispatches = registry.counter("controller.dispatches")
-        self._c_turns = registry.counter("controller.turns")
-        self._h_seconds = registry.histogram("controller.dispatch_seconds")
-        self._g_superstep = registry.gauge("controller.superstep")
-        self._g_qdepth = registry.gauge("controller.event_queue_depth")
+        # ``tenant`` labels every instrument (ISSUE 6 satellite): N
+        # sessions multiplexed onto one process-wide registry stay
+        # separable in a single snapshot — and the labels ride the run's
+        # delta into checkpoint sidecars and the terminal MetricsReport.
+        self._c_dispatches = registry.counter(
+            labelled("controller.dispatches", tenant)
+        )
+        self._c_turns = registry.counter(labelled("controller.turns", tenant))
+        self._h_seconds = registry.histogram(
+            labelled("controller.dispatch_seconds", tenant)
+        )
+        self._g_superstep = registry.gauge(
+            labelled("controller.superstep", tenant)
+        )
+        self._g_qdepth = registry.gauge(
+            labelled("controller.event_queue_depth", tenant)
+        )
         self.last_turn = 0  # the abort path's best known turn
 
     def record(self, turn: int, k: int, seconds: float) -> None:

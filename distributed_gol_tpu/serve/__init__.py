@@ -1,0 +1,19 @@
+"""Multi-tenant serving plane (ISSUE 6): admission control, per-session
+fault isolation, graceful pod drain, health surface.  See
+``serve/plane.py`` for the architecture and docs/API.md "Serving" for
+the contracts."""
+
+from distributed_gol_tpu.serve.admission import (
+    AdmissionController,
+    AdmissionRejected,
+    ServeConfig,
+)
+from distributed_gol_tpu.serve.plane import ServePlane, SessionHandle
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionRejected",
+    "ServeConfig",
+    "ServePlane",
+    "SessionHandle",
+]

@@ -1,0 +1,215 @@
+"""Admission control + backpressure for the serving plane (ISSUE 6).
+
+The reference system's broker accepts every controller that dials it and
+holds exactly one checkpoint slot (``broker/broker.go:124-148``) — fine
+for one student pair, fatal for a pod serving many users: an unbounded
+accept queue is an OOM with extra steps, and a tenant that floods the
+pod starves everyone.  This module is the policy half of the serving
+plane's first robustness leg: a **capacity budget** (max resident
+sessions, max queued admissions, per-tenant and pod-wide cell budgets)
+enforced with **explicit load-shedding** — a submission the budget
+cannot hold is refused *immediately* with :class:`AdmissionRejected`
+(carrying a ``retry_after`` hint when the condition is transient), never
+parked on an unbounded queue and never left to time out.
+
+The controller is pure bookkeeping — no locks, no device work, no I/O —
+so the plane can consult it under its own lock and tests can drive it
+directly.  Every decision is deterministic in the submission order,
+which is what makes the ``flood`` chaos rows assertable down to the
+exact outcome sequence (``testing/faults.FloodTenant``).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """The serving plane's capacity budget (docs/API.md "Serving").
+
+    Defaults are sized for a small demo pod; a production deployment
+    tunes them to the device's memory and the balancer's patience."""
+
+    # Sessions computing concurrently (each runs under its own
+    # supervisor ladder on its own worker thread today; the batched-board
+    # scheduler slots in behind the same budget later).
+    max_sessions: int = 4
+    # Admissions allowed to WAIT for a slot.  A queued session holds only
+    # its Params — no board is loaded until it starts — so queue memory
+    # is O(max_queued) small objects, bounded by construction.  0 =
+    # no waiting: a full pod sheds immediately.
+    max_queued: int = 8
+    # Per-tenant board budget in cells (width × height).  A board over
+    # this never fits, so the rejection carries no retry_after.
+    max_cells_per_session: int = 2**24  # one 4096² board
+    # Pod-wide cell budget across resident + queued sessions — the
+    # device-memory guard.  0 = only the per-session bound applies.
+    max_total_cells: int = 2**26
+    # Dispatch watchdog deadline stamped on every admitted session that
+    # did not bring its own (``submit(deadline_seconds=...)`` wins):
+    # propagates into ``Params.dispatch_deadline_seconds`` so one wedged
+    # tenant surfaces as ITS OWN DispatchTimeout instead of a silent
+    # stall.  0 keeps the per-run default (watchdog off).
+    default_deadline_seconds: float = 0.0
+    # The retry-after hint stamped on transient rejections (pod full,
+    # queue full, total-cell budget) — what an HTTP front-end would send
+    # as a 429 Retry-After.
+    retry_after_seconds: float = 1.0
+    # How long a drain waits for resident sessions to emergency-
+    # checkpoint and exit before giving up (``ServePlane.drain``).
+    drain_timeout_seconds: float = 60.0
+    # TERMINAL session handles retained for introspection (health /
+    # drain receipts / ``plane.handle``).  Beyond this the oldest are
+    # evicted — handle, digest, AND the tenant's labelled metrics
+    # instruments — so a pod serving churning tenant names stays
+    # bounded-memory (the same contract the queue bound enforces).
+    # Resident and queued sessions are never evicted.
+    max_retained_handles: int = 256
+
+    def __post_init__(self):
+        if self.max_sessions < 1:
+            raise ValueError("max_sessions must be >= 1")
+        if self.max_queued < 0:
+            raise ValueError("max_queued must be >= 0")
+        if self.max_cells_per_session < 1:
+            raise ValueError("max_cells_per_session must be >= 1")
+        if self.max_total_cells < 0:
+            raise ValueError("max_total_cells must be >= 0 (0 = unbounded)")
+        if self.default_deadline_seconds < 0:
+            raise ValueError("default_deadline_seconds must be >= 0")
+        if self.retry_after_seconds < 0:
+            raise ValueError("retry_after_seconds must be >= 0")
+        if self.drain_timeout_seconds <= 0:
+            raise ValueError("drain_timeout_seconds must be positive")
+        if self.max_retained_handles < 0:
+            raise ValueError(
+                "max_retained_handles must be >= 0 (0 = drop terminal "
+                "handles immediately)"
+            )
+
+
+class AdmissionRejected(RuntimeError):
+    """A submission the capacity budget cannot hold was shed.
+
+    ``retry_after`` is the back-off hint in seconds; None means the
+    rejection is permanent for this request (board over the per-tenant
+    budget, pod draining) and retrying the same submission is futile."""
+
+    def __init__(self, reason: str, retry_after: float | None = None):
+        super().__init__(reason)
+        self.reason = reason
+        self.retry_after = retry_after
+
+    def __str__(self) -> str:
+        hint = (
+            f" (retry after {self.retry_after:g}s)"
+            if self.retry_after is not None
+            else ""
+        )
+        return f"{self.reason}{hint}"
+
+
+# Admission outcomes (``AdmissionController.admit``).
+ADMIT_RUN = "run"  # a session slot is free: start now
+ADMIT_QUEUE = "queue"  # pod full, queue has room: wait for a slot
+
+
+class AdmissionController:
+    """The budget bookkeeping: who is resident, who is waiting, how many
+    cells they pin.  Pure state — the plane serialises access under its
+    own lock; every mutation is O(1).
+
+    Tenant identity is the admission key: one live run per tenant (its
+    scoped checkpoint dir is single-writer by contract), so a duplicate
+    submission is shed with a retry-after rather than queued behind
+    itself."""
+
+    def __init__(self, config: ServeConfig):
+        self.config = config
+        self.resident: dict[str, int] = {}  # tenant -> cells
+        self.waiting: deque[str] = deque()  # admission order
+        self._waiting_cells: dict[str, int] = {}
+        self.draining = False
+
+    # -- the decision ----------------------------------------------------------
+    def admit(self, tenant: str, cells: int) -> str:
+        """Decide one submission: :data:`ADMIT_RUN`, :data:`ADMIT_QUEUE`
+        (both recorded in the books), or raise :class:`AdmissionRejected`
+        (books untouched).  Deterministic in submission order."""
+        cfg = self.config
+        if self.draining:
+            raise AdmissionRejected("pod is draining; admissions closed")
+        if cells > cfg.max_cells_per_session:
+            raise AdmissionRejected(
+                f"board of {cells} cells exceeds the per-session budget "
+                f"({cfg.max_cells_per_session})"
+            )
+        if tenant in self.resident or tenant in self._waiting_cells:
+            raise AdmissionRejected(
+                f"tenant {tenant!r} already has a live session",
+                retry_after=cfg.retry_after_seconds,
+            )
+        if cfg.max_total_cells and self.total_cells + cells > cfg.max_total_cells:
+            raise AdmissionRejected(
+                f"pod cell budget exhausted ({self.total_cells} + {cells} "
+                f"> {cfg.max_total_cells})",
+                retry_after=cfg.retry_after_seconds,
+            )
+        if len(self.resident) < cfg.max_sessions:
+            self.resident[tenant] = cells
+            return ADMIT_RUN
+        if len(self.waiting) < cfg.max_queued:
+            self.waiting.append(tenant)
+            self._waiting_cells[tenant] = cells
+            return ADMIT_QUEUE
+        raise AdmissionRejected(
+            f"pod full ({cfg.max_sessions} resident, "
+            f"{len(self.waiting)} queued)",
+            retry_after=cfg.retry_after_seconds,
+        )
+
+    # -- bookkeeping transitions ----------------------------------------------
+    def release(self, tenant: str) -> None:
+        """A resident session reached a terminal state: free its slot."""
+        self.resident.pop(tenant, None)
+
+    def pop_waiting(self) -> tuple[str, int] | None:
+        """Promote the longest-waiting admission into a freed slot
+        (admission order, no starvation); None when nothing waits."""
+        if not self.waiting or len(self.resident) >= self.config.max_sessions:
+            return None
+        tenant = self.waiting.popleft()
+        cells = self._waiting_cells.pop(tenant)
+        self.resident[tenant] = cells
+        return tenant, cells
+
+    def shed_waiting(self) -> list[str]:
+        """Drop every queued admission (the drain path); returns them in
+        admission order so each handle can be terminated explicitly."""
+        shed = list(self.waiting)
+        self.waiting.clear()
+        self._waiting_cells.clear()
+        return shed
+
+    # -- read side -------------------------------------------------------------
+    @property
+    def total_cells(self) -> int:
+        return sum(self.resident.values()) + sum(self._waiting_cells.values())
+
+    @property
+    def resident_cells(self) -> int:
+        return sum(self.resident.values())
+
+    @property
+    def queued(self) -> int:
+        return len(self.waiting)
+
+    def has_room(self) -> bool:
+        """Whether a (budget-sized) submission could be admitted right
+        now — the readiness half of the health surface."""
+        return not self.draining and (
+            len(self.resident) < self.config.max_sessions
+            or len(self.waiting) < self.config.max_queued
+        )

@@ -1,0 +1,691 @@
+"""The fault-isolated multi-tenant serving plane (ISSUE 6 tentpole).
+
+The reference system's broker is a long-lived process many controllers
+come and go against (``Broker.Publish/Pause/CheckStates/Quit``,
+controller detach/resume — PAPER.md §1); its rebuild so far served ONE
+run at a time.  :class:`ServePlane` lifts the PR-2/PR-5 resilience
+ladder one level: one pod process multiplexes N independent sessions —
+each with its own board, :class:`~distributed_gol_tpu.engine.params.
+Params`, scoped checkpoint directory, and event stream — with
+robustness as the headline contract:
+
+- **Admission control + backpressure** (``serve/admission.py``): a
+  capacity budget with bounded queues and explicit load-shedding
+  (:class:`AdmissionRejected` with a retry-after hint — never an
+  unbounded queue, never an OOM), plus per-session deadlines that
+  propagate into the existing dispatch watchdog
+  (``Params.dispatch_deadline_seconds``).
+- **Per-session fault isolation**: every session runs under its own
+  controller/supervisor ladder on its own worker; one tenant's terminal
+  ``DispatchError``/``CorruptionDetected``/restart-exhaustion parks
+  *that* session (checkpoint + flight record in its scoped directory)
+  while every other tenant keeps dispatching — no cross-tenant abort,
+  no pod exit (asserted by the chaos matrix, ``tests/test_serve.py``).
+- **Graceful pod drain**: SIGTERM (``install``) stops admissions, sheds
+  the waiting queue, routes the PR-5 ``GracefulStop`` latch into every
+  resident session — each emergency-checkpoints through the existing
+  ``Controller._checkpoint_now`` path (fsync-durable) and exits
+  paused-and-resumable — and the pod exits cleanly; a restarted pod
+  re-adopts every tenant via the ``Session.check_states`` scan.
+- **Health surface** (:meth:`ServePlane.health`): readiness/liveness
+  derived from the obs registry (watchdog fires, supervisor restarts,
+  queue depths, per-tenant ``tenant=`` metric labels) so an external
+  balancer can eject a sick pod.
+
+Concurrency shape: an asyncio loop (one daemon thread) owns session
+lifecycle — admission hand-off, slot scheduling, completion — while the
+blocking controller runs execute on a bounded executor
+(``max_sessions`` workers).  The public API is thread-safe and
+synchronous (``submit``/``drain``/``health``); an async network
+front-end (ROADMAP item 1's HTTP/WebSocket face) plugs into the same
+loop.  The **scheduler seam** is :meth:`ServePlane._launch`: today it
+maps one admitted session onto one worker thread; the ROADMAP's
+batched-board vmap lever replaces its body with a shared batched
+dispatcher (grouping same-shape boards into one device launch) without
+touching the admission, isolation, drain, or health contracts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import replace
+from pathlib import Path
+from typing import Callable, Optional
+
+from distributed_gol_tpu.engine import gol
+from distributed_gol_tpu.engine.events import (
+    CheckpointSaved,
+    DispatchError,
+    EventQueue,
+    FinalTurnComplete,
+    MetricsReport,
+    TurnComplete,
+    TurnsCompleted,
+)
+from distributed_gol_tpu.engine.params import Params
+from distributed_gol_tpu.engine.session import Session
+from distributed_gol_tpu.engine.supervisor import GracefulStop
+from distributed_gol_tpu.obs import metrics as metrics_lib
+from distributed_gol_tpu.serve.admission import (
+    ADMIT_RUN,
+    AdmissionController,
+    AdmissionRejected,
+    ServeConfig,
+)
+
+#: Handle lifecycle: ``queued`` → ``running`` → one terminal state.
+TERMINAL_STATES = ("completed", "parked", "drained", "failed", "shed")
+
+
+class SessionHandle:
+    """One tenant's run through the plane: identity, live status, the
+    event stream, and the terminal digest.
+
+    ``events`` is the session's own stream (the per-tenant analog of the
+    reference's one events channel).  When the submitter brought a queue
+    it owns draining it (the plane only TEES the producer side through
+    the digest — see :class:`_DigestTee`); otherwise the plane drains
+    the stream itself.  Either way the **digest** fields (``final``,
+    ``report``, ``errors``, ``checkpoint_turns``, ``last_turn``) are
+    populated — they are what the drain receipt and terminal
+    classification read — and bounded, so a session's events can never
+    grow the pod's memory.  The stream is guaranteed to end with the
+    ``None`` sentinel (possibly one extra trailing sentinel on
+    plane-terminated paths — consumers stop at the first, so it is
+    invisible to the standard drain loop)."""
+
+    # Caps on retained digest entries — a postmortem tail, not an
+    # unbounded log (the digest's whole point is O(1) memory/session):
+    # first 32 DispatchErrors, last 32 checkpoint turns.
+    _MAX_ERRORS = 32
+    _MAX_CHECKPOINTS = 32
+
+    def __init__(
+        self,
+        tenant: str,
+        params: Params,
+        session: Session,
+        events: queue.Queue,
+        owns_events: bool,
+    ):
+        self.tenant = tenant
+        self.params = params
+        self.session = session
+        self.events = events
+        self.stop = GracefulStop()
+        self.status = "queued"
+        #: The admission verdict at submit time ("run" = a slot was
+        #: free, "queue" = parked in the bounded wait queue) — stable,
+        #: unlike ``status``, which advances as the session runs.
+        self.admitted_as = "run"
+        self.error: str | None = None
+        #: Whether a fresh run on this tenant's session would resume
+        #: (a paused checkpoint is parked) — truthful in every terminal
+        #: state, including ``failed``.
+        self.resumable = False
+        self.t_submit = time.perf_counter()
+        self.t_start: float | None = None
+        self.t_end: float | None = None
+        # -- digest (populated only when the plane owns the stream) --
+        self.final: FinalTurnComplete | None = None
+        self.report: MetricsReport | None = None
+        self.errors: list[DispatchError] = []
+        self.checkpoint_turns: deque[int] = deque(maxlen=self._MAX_CHECKPOINTS)
+        self.last_turn = 0
+        self._owns_events = owns_events
+        self._done = threading.Event()
+        self._backend = None
+        self._backend_factory = None
+
+    @property
+    def done(self) -> bool:
+        return self.status in TERMINAL_STATES
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the session reaches a terminal state."""
+        return self._done.wait(timeout)
+
+    @property
+    def duration(self) -> float | None:
+        """Running wall-clock (start → terminal), excluding queue wait."""
+        if self.t_start is None or self.t_end is None:
+            return None
+        return self.t_end - self.t_start
+
+    def _finish(self, status: str, error: str | None = None) -> None:
+        self.status = status
+        if error is not None:
+            self.error = error
+        if self.t_end is None and self.t_start is not None:
+            self.t_end = time.perf_counter()
+        self.resumable = self.session.paused
+        # A caller-owned stream never fed the digest, so ``last_turn``
+        # would read 0 however far the run got — the parked checkpoint's
+        # turn is the progress oracle the drain receipt needs.
+        parked = self.session.parked_turn
+        if parked is not None:
+            self.last_turn = max(self.last_turn, parked)
+        self._done.set()
+
+    def _digest(self, event) -> None:
+        if isinstance(event, (TurnComplete, TurnsCompleted)):
+            self.last_turn = event.completed_turns
+        elif isinstance(event, FinalTurnComplete):
+            self.final = event
+            self.last_turn = event.completed_turns
+        elif isinstance(event, MetricsReport):
+            self.report = event
+        elif isinstance(event, DispatchError):
+            if len(self.errors) < self._MAX_ERRORS:
+                self.errors.append(event)
+        elif isinstance(event, CheckpointSaved):
+            self.checkpoint_turns.append(event.completed_turns)
+
+    def __repr__(self) -> str:
+        return (
+            f"SessionHandle(tenant={self.tenant!r}, status={self.status!r}, "
+            f"turn={self.last_turn}, resumable={self.resumable})"
+        )
+
+
+class _DigestTee(EventQueue):
+    """Producer-side wrapper around a CALLER-owned event queue: digests
+    every event into the handle, then forwards it to the caller's queue
+    untouched — the drain receipt and terminal classification see the
+    run's progress without the plane consuming a stream it does not own.
+
+    Subclasses :class:`EventQueue` so the controller keeps batching
+    TurnComplete ranges (``put_turns``) when the caller's queue can
+    expand them; a caller bringing a plain ``queue.Queue`` gets the
+    per-event fallback, exactly as if it were handed to ``gol.run``
+    directly.  Only the producer side is ever used (the caller reads
+    their own queue object)."""
+
+    def __init__(self, handle: SessionHandle, inner: queue.Queue):
+        super().__init__()
+        self._handle = handle
+        self._inner = inner
+
+    def put(self, item, block: bool = True, timeout: float | None = None):
+        if item is not None:
+            self._handle._digest(item)
+        self._inner.put(item, block, timeout)
+
+    def put_turns(self, first: int, last: int) -> None:
+        if last >= first:
+            self._handle.last_turn = last
+        if isinstance(self._inner, EventQueue):
+            self._inner.put_turns(first, last)
+        else:
+            for t in range(first, last + 1):
+                self._inner.put(TurnComplete(t))
+
+    def qsize(self) -> int:
+        return self._inner.qsize()
+
+    def empty(self) -> bool:
+        return self._inner.empty()
+
+
+class ServePlane:
+    """The pod: N tenants, one backend process, robustness contracts as
+    in the module doc.  Use as a context manager (``close`` drains)."""
+
+    def __init__(
+        self,
+        config: ServeConfig | None = None,
+        checkpoint_root: str | Path | None = None,
+        metrics: bool = True,
+    ):
+        self.config = config if config is not None else ServeConfig()
+        self._root = Path(checkpoint_root) if checkpoint_root else None
+        self._lock = threading.Lock()
+        self._state = threading.Condition(self._lock)
+        self._admission = AdmissionController(self.config)
+        self._handles: dict[str, SessionHandle] = {}  # latest per tenant
+        # Terminal handles in completion order — the eviction ring that
+        # keeps a churning-tenant pod's memory bounded (``_on_done``).
+        self._retired: deque[tuple[str, SessionHandle]] = deque()
+        self._closed = False
+        # -- observability (the health surface's substrate) --
+        self.metrics = metrics_lib.registry_for(metrics)
+        self._metrics_start = self.metrics.snapshot(include_lazy=False)
+        self._c_admitted = self.metrics.counter("serve.admitted")
+        self._c_rejected = self.metrics.counter("serve.rejected")
+        self._c_drains = self.metrics.counter("serve.drains")
+        self._c_outcome = {
+            s: self.metrics.counter(f"serve.sessions_{s}")
+            for s in TERMINAL_STATES
+        }
+        self._g_resident = self.metrics.gauge("serve.resident_sessions")
+        self._g_queued = self.metrics.gauge("serve.queued_sessions")
+        self._g_cells = self.metrics.gauge("serve.resident_cells")
+        self._g_resident.set(0)
+        self._g_queued.set(0)
+        self._g_cells.set(0)
+        # -- the asyncio control plane --
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._loop.run_forever, name="gol-serve-plane", daemon=True
+        )
+        self._loop_thread.start()
+        self._executor = ThreadPoolExecutor(
+            max_workers=self.config.max_sessions,
+            thread_name_prefix="gol-serve-run",
+        )
+
+    # -- context manager -------------------------------------------------------
+    def __enter__(self) -> "ServePlane":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- admission (leg 1) -----------------------------------------------------
+    def submit(
+        self,
+        tenant: str,
+        params: Params,
+        events: queue.Queue | None = None,
+        deadline_seconds: float | None = None,
+        backend=None,
+        backend_factory: Optional[Callable] = None,
+    ) -> SessionHandle:
+        """Admit one session or shed it (:class:`AdmissionRejected`).
+
+        Never blocks on capacity: the decision is immediate — run now,
+        wait in the bounded queue, or reject with a retry-after hint.
+        ``deadline_seconds`` (or the config default) propagates into the
+        session's ``Params.dispatch_deadline_seconds`` watchdog, so a
+        wedged dispatch surfaces as that tenant's own ``DispatchTimeout``
+        instead of silently pinning a pod worker.  ``backend`` /
+        ``backend_factory`` are the chaos seams (``testing/faults``)."""
+        overrides: dict = {"tenant": tenant}
+        if deadline_seconds is not None:
+            # An explicit per-request deadline always wins.
+            overrides["dispatch_deadline_seconds"] = deadline_seconds
+        elif (
+            self.config.default_deadline_seconds
+            and not params.dispatch_deadline_seconds
+        ):
+            # The config default applies only to sessions that did not
+            # bring their own (admission.py's documented contract).
+            overrides["dispatch_deadline_seconds"] = (
+                self.config.default_deadline_seconds
+            )
+        if params.tenant is not None and params.tenant != tenant:
+            raise ValueError(
+                f"params.tenant {params.tenant!r} contradicts the "
+                f"submission tenant {tenant!r}"
+            )
+        params = replace(params, **overrides)
+        cells = params.image_width * params.image_height
+        with self._lock:
+            if self._closed:
+                self._c_rejected.inc()
+                raise AdmissionRejected("pod is closed")
+            try:
+                verdict = self._admission.admit(tenant, cells)
+            except AdmissionRejected:
+                self._c_rejected.inc()
+                raise
+            session = Session(self._root / tenant) if self._root else Session()
+            handle = SessionHandle(
+                tenant,
+                params,
+                session,
+                events if events is not None else EventQueue(),
+                owns_events=events is None,
+            )
+            if events is not None:
+                # Tee the producer side through the digest so the drain
+                # receipt and classification see progress the plane
+                # never consumes (the caller keeps reading their queue).
+                handle.events = _DigestTee(handle, events)
+            handle._backend = backend
+            handle._backend_factory = backend_factory
+            handle.admitted_as = verdict
+            self._handles[tenant] = handle
+            self._c_admitted.inc()
+            self._sync_gauges()
+        if verdict == ADMIT_RUN:
+            self._launch(handle)
+        return handle
+
+    # -- scheduling ------------------------------------------------------------
+    def _launch(self, handle: SessionHandle) -> None:
+        """THE SCHEDULER SEAM: turn one admitted session into device
+        work.  Today: one asyncio task awaiting one bounded-executor
+        worker running the session's own controller/supervisor — which
+        is what makes fault isolation structural.  The ROADMAP's
+        batched-board vmap lever replaces this body (group same-shape
+        resident boards into one vmapped launch) behind the same
+        admission/drain/health contracts."""
+        asyncio.run_coroutine_threadsafe(self._run_async(handle), self._loop)
+
+    async def _run_async(self, handle: SessionHandle) -> None:
+        try:
+            await self._loop.run_in_executor(
+                self._executor, self._run_session, handle
+            )
+        finally:
+            self._on_done(handle)
+
+    def _run_session(self, handle: SessionHandle) -> None:
+        """One session end-to-end on a pod worker — every exception is
+        absorbed here (classified into the handle's terminal state):
+        a tenant's failure must never propagate into the plane."""
+        handle.status = "running"
+        handle.t_start = time.perf_counter()
+        drainer = None
+        if handle._owns_events:
+            drainer = threading.Thread(
+                target=self._drain_digest,
+                args=(handle,),
+                name=f"gol-serve-digest-{handle.tenant}",
+                daemon=True,
+            )
+            drainer.start()
+        exc: BaseException | None = None
+        try:
+            gol.run(
+                handle.params,
+                handle.events,
+                session=handle.session,
+                backend=handle._backend,
+                backend_factory=handle._backend_factory,
+                stop=handle.stop,
+            )
+        except BaseException as e:  # noqa: BLE001 — isolation boundary
+            exc = e
+        finally:
+            # Terminal-stream guarantee: the engine emits its own
+            # sentinel on every path except a failed first build; one
+            # extra trailing sentinel is invisible to consumers (they
+            # stop at the first).
+            handle.events.put(None)
+            if drainer is not None:
+                drainer.join(timeout=60)
+        self._classify(handle, exc)
+
+    def _drain_digest(self, handle: SessionHandle) -> None:
+        """The plane-owned consumer: reduce an unwatched session's event
+        stream to the bounded digest as it is produced (memory stays
+        O(1) per session however long the run)."""
+        while True:
+            event = handle.events.get()
+            if event is None:
+                return
+            handle._digest(event)
+
+    def _classify(self, handle: SessionHandle, exc: BaseException | None):
+        """Map one finished run onto the handle's terminal state.  The
+        session's own ``paused`` flag is the resumability oracle (a
+        terminal park, an emergency checkpoint, and a 'q' detach all
+        leave it set; a completed run consumed/discarded everything)."""
+        completed_all = (
+            handle.final is not None
+            and handle.final.completed_turns >= handle.params.turns
+        )
+        if exc is None:
+            if handle.stop.requested and not completed_all:
+                handle._finish("drained")
+            elif handle.session.paused:
+                handle._finish("parked")
+            else:
+                handle._finish("completed")
+        else:
+            handle._finish(
+                "parked" if handle.session.paused else "failed",
+                error=f"{type(exc).__name__}: {exc}",
+            )
+
+    def _on_done(self, handle: SessionHandle) -> None:
+        """Free the slot, promote the longest-waiting admission (unless
+        draining, which shed the queue), publish gauges."""
+        with self._state:
+            self._admission.release(handle.tenant)
+            self._c_outcome[handle.status].inc()
+            # Bound the terminal-handle books: evict oldest-completed
+            # beyond the budget — handle, digest, and (outside the lock)
+            # the tenant's labelled metrics instruments.  A tenant that
+            # was resubmitted keeps its CURRENT handle; only the stale
+            # terminal one leaves the ring.
+            self._retired.append((handle.tenant, handle))
+            evicted: list[str] = []
+            while len(self._retired) > self.config.max_retained_handles:
+                t, old = self._retired.popleft()
+                if self._handles.get(t) is old:
+                    del self._handles[t]
+                    evicted.append(t)
+            promoted = None
+            if not self._admission.draining:
+                nxt = self._admission.pop_waiting()
+                if nxt is not None:
+                    promoted = self._handles.get(nxt[0])
+            self._sync_gauges()
+            self._state.notify_all()
+        for t in evicted:
+            self.metrics.clear_tenant(t)
+        if promoted is not None:
+            self._launch(promoted)
+
+    def _sync_gauges(self) -> None:
+        self._g_resident.set(len(self._admission.resident))
+        self._g_queued.set(self._admission.queued)
+        self._g_cells.set(self._admission.resident_cells)
+
+    # -- drain (leg 3) ---------------------------------------------------------
+    def begin_drain(self, signum=None, frame=None) -> None:
+        """The non-blocking half of a graceful drain: close admissions,
+        shed the waiting queue (their streams are terminated so no
+        consumer hangs), and raise every resident session's
+        ``GracefulStop`` latch — each controller emergency-checkpoints
+        at its next turn boundary (the fsync-durable ``_checkpoint_now``
+        path) and exits paused-and-resumable.
+
+        Takes the plane's (non-reentrant) lock, so it must NOT run
+        directly inside a signal handler — the signal could land while
+        the main thread holds that lock (mid-``submit``) and deadlock
+        the drain.  :meth:`install` therefore routes signals through a
+        trampoline that runs it on a fresh thread."""
+        with self._state:
+            if self._admission.draining:
+                return
+            self._admission.draining = True
+            self._c_drains.inc()
+            shed = [self._handles[t] for t in self._admission.shed_waiting()]
+            running = [
+                self._handles[t] for t in list(self._admission.resident)
+            ]
+            self._sync_gauges()
+            self._state.notify_all()
+        for handle in shed:
+            handle._finish("shed", error="pod drained before a slot freed")
+            self._c_outcome["shed"].inc()
+            handle.events.put(None)  # terminal event for any waiting consumer
+        for handle in running:
+            handle.stop.request(signum)
+
+    def drain(self, timeout: float | None = None) -> dict:
+        """Blocking graceful drain: :meth:`begin_drain`, then wait (up to
+        ``timeout``, default the config's ``drain_timeout_seconds``) for
+        every resident session to reach a terminal state.  Returns a
+        summary ``{tenant: {status, turn, resumable}}`` — the drain
+        contract's receipt: with a checkpoint root, every ``drained``
+        tenant is re-adoptable by a fresh pod."""
+        self.begin_drain()
+        deadline = time.monotonic() + (
+            timeout if timeout is not None else self.config.drain_timeout_seconds
+        )
+        with self._state:
+            while self._admission.resident:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._state.wait(timeout=remaining):
+                    break
+            handles = dict(self._handles)
+        return {
+            t: {
+                "status": h.status,
+                "turn": h.last_turn,
+                "resumable": h.resumable,
+            }
+            for t, h in handles.items()
+        }
+
+    def install(self, signals=None) -> Callable[[], None]:
+        """Route SIGTERM (default) to :meth:`begin_drain`; returns a
+        restore callable, like ``GracefulStop.install``.  The handler
+        itself only spawns the drain thread (never touches the plane's
+        lock on the interrupted thread — see :meth:`begin_drain`); the
+        process's main loop observes the drain via :meth:`wait_idle` /
+        handle waits and exits when the pod is empty."""
+        import signal as signal_mod
+
+        from distributed_gol_tpu.engine.supervisor import route_signals
+
+        if signals is None:
+            signals = (signal_mod.SIGTERM,)
+
+        def handler(signum, frame):
+            threading.Thread(
+                target=self.begin_drain,
+                args=(signum,),
+                name="gol-serve-drain",
+                daemon=True,
+            ).start()
+
+        return route_signals(handler, signals)
+
+    def wait_idle(self, timeout: float | None = None) -> bool:
+        """Block until no session is resident or queued."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._state:
+            while self._admission.resident or self._admission.waiting:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                if not self._state.wait(timeout=remaining):
+                    return False
+            return True
+
+    def close(self, timeout: float | None = None) -> None:
+        """Drain, then tear the control plane down (idempotent)."""
+        with self._lock:
+            if self._closed:
+                return
+        self.drain(timeout)
+        with self._lock:
+            self._closed = True
+        self._executor.shutdown(wait=False)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._loop_thread.join(timeout=10)
+
+    # -- health (leg 4) --------------------------------------------------------
+    def health(self) -> dict:
+        """Readiness/liveness for an external balancer, derived from the
+        plane's books plus the obs registry delta since plane start
+        (watchdog fires, supervisor restarts, per-tenant dispatch
+        counters via their ``tenant=`` labels).  ``ready`` = this pod
+        can admit work now; ``live`` = the control plane itself is
+        healthy (a not-live pod should be ejected/restarted; a
+        not-ready-but-live pod is full or draining — route around it)."""
+        with self._lock:
+            draining = self._admission.draining
+            resident = len(self._admission.resident)
+            queued = self._admission.queued
+            resident_cells = self._admission.resident_cells
+            ready = (
+                not self._closed
+                and not draining
+                and self._admission.has_room()
+            )
+            statuses = {t: h.status for t, h in self._handles.items()}
+            closed = self._closed
+        snap = (
+            self.metrics.snapshot(include_lazy=False)
+            .delta(self._metrics_start)
+            .to_dict()
+        )
+        counters = snap.get("counters", {})
+        tenants = {
+            t: {
+                "status": status,
+                "dispatches": counters.get(
+                    metrics_lib.labelled("controller.dispatches", t), 0
+                ),
+                "turns": counters.get(
+                    metrics_lib.labelled("controller.turns", t), 0
+                ),
+            }
+            for t, status in statuses.items()
+        }
+        return {
+            "ready": ready,
+            "live": not closed and self._loop_thread.is_alive(),
+            "draining": draining,
+            "resident_sessions": resident,
+            "queued_sessions": queued,
+            "resident_cells": resident_cells,
+            "capacity": {
+                "max_sessions": self.config.max_sessions,
+                "max_queued": self.config.max_queued,
+                "max_total_cells": self.config.max_total_cells,
+            },
+            "watchdog_fires": counters.get("faults.watchdog_fires", 0),
+            "supervisor_restarts": counters.get("supervisor.restarts", 0),
+            "sessions_parked": counters.get("serve.sessions_parked", 0),
+            "sessions_failed": counters.get("serve.sessions_failed", 0),
+            "rejected": counters.get("serve.rejected", 0),
+            "tenants": tenants,
+        }
+
+    # -- re-adoption (the restarted-pod half of the drain contract) ------------
+    def resumable_tenants(self) -> dict[str, dict]:
+        """Scan the checkpoint root for tenants a fresh pod can re-adopt:
+        ``{tenant: {turn, shape, rule}}`` for every tenant directory
+        holding a paused (unconsumed) checkpoint sidecar.  Submitting a
+        matching ``Params`` for such a tenant resumes it via the normal
+        ``Session.check_states`` negotiation."""
+        out: dict[str, dict] = {}
+        if self._root is None or not self._root.is_dir():
+            return out
+        for tenant_dir in sorted(p for p in self._root.iterdir() if p.is_dir()):
+            best: dict | None = None
+            for sidecar in tenant_dir.glob("checkpoint*.json"):
+                try:
+                    meta = json.loads(sidecar.read_text())
+                except (OSError, ValueError):
+                    continue
+                if not isinstance(meta, dict) or not meta.get("paused"):
+                    continue
+                turn = meta.get("turn")
+                if not isinstance(turn, int):
+                    continue
+                if best is None or turn > best["turn"]:
+                    best = {
+                        "turn": turn,
+                        "shape": meta.get("shape"),
+                        "rule": meta.get("rule"),
+                    }
+            if best is not None:
+                out[tenant_dir.name] = best
+        return out
+
+    # -- introspection ---------------------------------------------------------
+    def handle(self, tenant: str) -> SessionHandle | None:
+        with self._lock:
+            return self._handles.get(tenant)
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._admission.draining

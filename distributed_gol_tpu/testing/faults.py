@@ -35,6 +35,16 @@ Fault kinds:
   sentinel's popcount cross-check alone (an even mix of births/deaths
   could cancel in the count; the stripe recompute has no such parity
   blind spot).
+- ``flood`` — a misbehaving TENANT, not a misbehaving device: at step
+  ``at`` of a scripted submission schedule, ``cells`` back-to-back
+  session submissions are fired at the serving plane's admission seam
+  with no pacing (the max-rate client the admission budget exists to
+  shed).  Flood faults target ``serve.ServePlane.submit`` and are driven
+  by :class:`FloodTenant`; handing a flood-bearing plan to
+  :class:`FaultInjectionBackend` (the dispatch seam) is a test-harness
+  bug and is rejected at construction.  Deterministic like every other
+  kind: the outcome sequence (admitted / queued / shed) is a pure
+  function of the plan and the plane's capacity budget.
 
 Determinism: a plan is a pure value.  Scripted plans are literal fault
 lists; :meth:`FaultPlan.random` derives the schedule from a seed via
@@ -60,7 +70,7 @@ from typing import Iterable, Sequence
 
 import numpy as np
 
-FAULT_KINDS = ("issue", "resolve", "latency", "hang", "corrupt")
+FAULT_KINDS = ("issue", "resolve", "latency", "hang", "corrupt", "flood")
 
 # Injected hangs self-release after this long if nothing (watchdog, test
 # teardown) got there first: a leaked daemon thread must not outlive the
@@ -75,7 +85,7 @@ class Fault:
     at: int
     kind: str
     seconds: float = 0.0  # latency duration / hang self-release timeout
-    cells: int = 1  # corrupt: number of seeded bit-flips
+    cells: int = 1  # corrupt: seeded bit-flips; flood: burst submissions
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -227,6 +237,11 @@ class FaultInjectionBackend:
     (a plan can script faults past the end of a short run)."""
 
     def __init__(self, inner, plan: FaultPlan):
+        if any(f.kind == "flood" for f in plan.faults):
+            raise ValueError(
+                "flood faults target the serving plane's admission seam "
+                "(testing.faults.FloodTenant), not the dispatch seam"
+            )
         self._inner = inner
         self.plan = plan
         self.dispatches = 0
@@ -286,3 +301,65 @@ class FaultInjectionBackend:
         # Through the seam above so retries are counted (and faultable).
         new_board, count = self.run_turns_async(board, turns)
         return new_board, int(count)
+
+
+class FloodTenant:
+    """The ``flood`` fault kind's driver: a scripted tenant submitting
+    at max rate against a serving plane's admission seam (ISSUE 6).
+
+    Walks the plan's ``flood`` faults in schedule order; each fires
+    ``cells`` back-to-back submissions (tenants ``<prefix>0``,
+    ``<prefix>1``, ... — distinct names, so the budget ladder is
+    exercised: resident slots fill, then the bounded queue, then
+    shedding) with NO pacing and NO randomness, so the exact outcome
+    sequence is assertable.  Submissions that the plane admits run for
+    real — ``make_params(tenant)`` supplies each one's :class:`Params` —
+    which is what makes a flood a genuine noisy-neighbour workload
+    beside the healthy tenants of an isolation test rather than a mocked
+    counter bump.
+
+    ``outcomes`` after :meth:`run`: one ``(tenant, verdict)`` per
+    submission, verdict ∈ ``{"admitted", "queued", "rejected"}``
+    (admitted = a slot was free at submit time; queued = parked in the
+    bounded wait queue)."""
+
+    def __init__(self, plane, make_params, plan: FaultPlan, prefix: str = "flood-"):
+        self.plane = plane
+        self.make_params = make_params
+        self.plan = plan
+        self.prefix = prefix
+        self.outcomes: list[tuple[str, str]] = []
+        self.handles: list = []
+        self.rejections: list = []
+
+    def run(self) -> dict:
+        """Fire the whole scripted flood; returns the tally
+        ``{submitted, admitted, queued, rejected}``."""
+        from distributed_gol_tpu.serve.admission import AdmissionRejected
+
+        k = 0
+        for fault in self.plan.faults:
+            if fault.kind != "flood":
+                continue
+            for _ in range(fault.cells):
+                tenant = f"{self.prefix}{k}"
+                k += 1
+                try:
+                    handle = self.plane.submit(tenant, self.make_params(tenant))
+                except AdmissionRejected as e:
+                    self.rejections.append(e)
+                    self.outcomes.append((tenant, "rejected"))
+                else:
+                    self.handles.append(handle)
+                    self.outcomes.append(
+                        (
+                            tenant,
+                            "queued"
+                            if handle.admitted_as == "queue"
+                            else "admitted",
+                        )
+                    )
+        tally = {"submitted": k, "admitted": 0, "queued": 0, "rejected": 0}
+        for _, verdict in self.outcomes:
+            tally[verdict] += 1
+        return tally

@@ -1,0 +1,939 @@
+"""The multi-tenant serving plane suite (ISSUE 6).
+
+Four contracts, asserted hermetically on CPU:
+
+- **Admission + backpressure**: the capacity budget's decision ladder
+  (run -> bounded queue -> shed with retry-after) is deterministic in
+  submission order, queue depth and memory stay bounded under a scripted
+  flood (the `flood` fault kind), and a rejection is always explicit —
+  never an unbounded wait.
+- **Per-session fault isolation** (the chaos rows): one tenant under
+  injected terminal faults — burst, corrupt, hang, flood — parks or
+  sheds ALONE while >= 2 healthy tenants beside it complete
+  bit-identically to their fault-free solo oracles.  No cross-tenant
+  abort, no pod exit.
+- **Graceful pod drain**: a real SIGTERM against a pod with N resident
+  sessions emergency-checkpoints every one (fsync-durable), the process
+  survives, and a fresh pod re-adopts each tenant to the oracle state.
+- **Health surface + per-tenant obs labels**: one registry snapshot
+  separates tenants via their ``tenant=`` labels (DispatchRecorder,
+  checkpoint sidecars, MetricsReport), and ``health()`` exposes the
+  readiness/liveness an external balancer needs.
+
+Chaos rows are marked ``chaos`` like the rest of the matrix.
+"""
+
+import json
+import os
+import queue
+import signal
+import threading
+import time
+
+import pytest
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.engine.backend import Backend
+from distributed_gol_tpu.engine.controller import DispatchTimeout
+from distributed_gol_tpu.engine.events import DispatchError
+from distributed_gol_tpu.engine.params import Params
+from distributed_gol_tpu.engine.session import Session
+from distributed_gol_tpu.obs import metrics as obs_metrics
+from distributed_gol_tpu.serve import (
+    AdmissionController,
+    AdmissionRejected,
+    ServeConfig,
+    ServePlane,
+)
+from distributed_gol_tpu.testing.faults import (
+    Fault,
+    FaultInjectionBackend,
+    FaultPlan,
+    FloodTenant,
+)
+
+# One pod workload shape for the whole suite: small boards, explicit
+# superstep, no cycle check — the dispatch schedule (= fault indices) is
+# exact and identical between a plane-multiplexed run and its solo oracle.
+W = H = 16
+SUPERSTEP = 4
+TURNS = 24
+
+
+def tenant_params(out_dir, seed, turns=TURNS, **kw):
+    cfg = dict(
+        engine="roll",
+        mesh_shape=(1, 1),
+        image_width=W,
+        image_height=H,
+        superstep=SUPERSTEP,
+        turns=turns,
+        soup_density=0.25,
+        soup_seed=seed,
+        out_dir=out_dir,
+        cycle_check=0,
+        ticker_period=60.0,
+    )
+    cfg.update(kw)
+    return Params(**cfg)
+
+
+@pytest.fixture(scope="module")
+def solo_oracle(tmp_path_factory):
+    """Fault-free solo run per soup seed, computed once: the final board
+    bytes every healthy multiplexed tenant must match bit-identically."""
+    cache = {}
+
+    def get(seed):
+        if seed not in cache:
+            out = tmp_path_factory.mktemp(f"solo-{seed}")
+            p = tenant_params(out, seed)
+            events: queue.Queue = queue.Queue()
+            gol.run(p, events)
+            while events.get(timeout=60) is not None:
+                pass
+            cache[seed] = (out / f"{p.final_output_name}.pgm").read_bytes()
+        return cache[seed]
+
+    return get
+
+
+def assert_healthy_matches_oracle(handle, solo_oracle, seed):
+    assert handle.status == "completed", (
+        f"healthy tenant {handle.tenant} did not complete: "
+        f"{handle.status} ({handle.error})"
+    )
+    assert handle.final is not None
+    assert handle.final.completed_turns == handle.params.turns
+    got = (
+        handle.params.out_dir / f"{handle.params.final_output_name}.pgm"
+    ).read_bytes()
+    assert got == solo_oracle(seed), (
+        f"healthy tenant {handle.tenant} diverged from its solo oracle"
+    )
+
+
+# -- admission control units (pure bookkeeping, no device work) ----------------
+
+
+class TestServeConfig:
+    def test_defaults_are_valid(self):
+        ServeConfig()
+
+    @pytest.mark.parametrize(
+        "field, bad",
+        [
+            ("max_sessions", 0),
+            ("max_queued", -1),
+            ("max_cells_per_session", 0),
+            ("max_total_cells", -1),
+            ("default_deadline_seconds", -0.5),
+            ("retry_after_seconds", -1.0),
+            ("drain_timeout_seconds", 0.0),
+        ],
+    )
+    def test_rejects_bad_budget(self, field, bad):
+        with pytest.raises(ValueError):
+            ServeConfig(**{field: bad})
+
+
+class TestAdmissionController:
+    CFG = ServeConfig(
+        max_sessions=2,
+        max_queued=2,
+        max_cells_per_session=100,
+        max_total_cells=500,
+        retry_after_seconds=2.5,
+    )
+
+    def test_decision_ladder_is_deterministic(self):
+        """run, run, queue, queue, shed — a pure function of the
+        submission order, down to the retry-after hint."""
+        ac = AdmissionController(self.CFG)
+        assert ac.admit("a", 10) == "run"
+        assert ac.admit("b", 10) == "run"
+        assert ac.admit("c", 10) == "queue"
+        assert ac.admit("d", 10) == "queue"
+        with pytest.raises(AdmissionRejected) as ei:
+            ac.admit("e", 10)
+        assert ei.value.retry_after == 2.5
+        assert ac.queued == 2 and len(ac.resident) == 2
+
+    def test_oversized_board_is_a_permanent_rejection(self):
+        ac = AdmissionController(self.CFG)
+        with pytest.raises(AdmissionRejected) as ei:
+            ac.admit("big", 101)
+        assert ei.value.retry_after is None  # retrying the same ask is futile
+        assert not ac.resident and not ac.waiting
+
+    def test_pod_cell_budget_frees_on_release(self):
+        """A pod-budget rejection is transient: releasing a resident
+        session frees its cells and the same submission then admits."""
+        cfg = ServeConfig(
+            max_sessions=4, max_queued=4, max_cells_per_session=100,
+            max_total_cells=150,
+        )
+        ac = AdmissionController(cfg)
+        assert ac.admit("a", 100) == "run"
+        with pytest.raises(AdmissionRejected):
+            ac.admit("b", 100)
+        ac.release("a")
+        assert ac.admit("b", 100) == "run"
+
+    def test_pod_cell_budget_rejects_with_retry_after(self):
+        cfg = ServeConfig(
+            max_sessions=4, max_queued=4, max_cells_per_session=100,
+            max_total_cells=150, retry_after_seconds=1.0,
+        )
+        ac = AdmissionController(cfg)
+        assert ac.admit("a", 100) == "run"
+        with pytest.raises(AdmissionRejected) as ei:
+            ac.admit("b", 100)
+        assert ei.value.retry_after == 1.0
+        # Queued cells count against the budget too (a queued board WILL
+        # become resident: admitting past the budget just defers the OOM).
+        assert ac.total_cells == 100
+
+    def test_duplicate_tenant_is_shed(self):
+        ac = AdmissionController(self.CFG)
+        ac.admit("a", 10)
+        with pytest.raises(AdmissionRejected, match="live session"):
+            ac.admit("a", 10)
+
+    def test_promotion_is_fifo(self):
+        ac = AdmissionController(self.CFG)
+        for t in ("a", "b", "c", "d"):
+            ac.admit(t, 10)
+        ac.release("a")
+        assert ac.pop_waiting() == ("c", 10)  # longest-waiting first
+        assert ac.pop_waiting() is None  # pod full again
+        ac.release("b")
+        assert ac.pop_waiting() == ("d", 10)
+
+    def test_drain_closes_admissions_and_sheds_the_queue(self):
+        ac = AdmissionController(self.CFG)
+        for t in ("a", "b", "c"):
+            ac.admit(t, 10)
+        ac.draining = True
+        with pytest.raises(AdmissionRejected) as ei:
+            ac.admit("d", 10)
+        assert ei.value.retry_after is None  # this pod is going away
+        assert ac.shed_waiting() == ["c"]
+        assert not ac.has_room()
+
+
+# -- the plane: happy path, scheduling, backpressure ---------------------------
+
+
+class TestPlaneBasics:
+    def test_sessions_complete_and_digest(self, tmp_path, solo_oracle):
+        with ServePlane(ServeConfig(max_sessions=2)) as plane:
+            h1 = plane.submit("alice", tenant_params(tmp_path / "alice", 1))
+            h2 = plane.submit("bob", tenant_params(tmp_path / "bob", 2))
+            assert plane.wait_idle(timeout=120)
+        for h, seed in ((h1, 1), (h2, 2)):
+            assert_healthy_matches_oracle(h, solo_oracle, seed)
+            assert h.last_turn == TURNS
+            assert h.report is not None  # MetricsReport digested
+            assert not h.resumable  # completed runs leave nothing parked
+            assert h.duration is not None and h.duration > 0
+
+    def test_queued_session_is_promoted_fifo(self, tmp_path, solo_oracle):
+        """One slot, three tenants: all complete (in admission order),
+        each bit-identical to its solo oracle."""
+        with ServePlane(ServeConfig(max_sessions=1, max_queued=2)) as plane:
+            handles = [
+                plane.submit(f"t{i}", tenant_params(tmp_path / f"t{i}", i))
+                for i in range(3)
+            ]
+            assert handles[0].admitted_as == "run"
+            assert handles[1].admitted_as == "queue"
+            assert handles[2].admitted_as == "queue"
+            assert plane.wait_idle(timeout=180)
+        for i, h in enumerate(handles):
+            assert_healthy_matches_oracle(h, solo_oracle, i)
+        # Queue wait ordering: t1 started no later than t2.
+        assert handles[1].t_start <= handles[2].t_start
+
+    def test_submit_never_blocks_and_sheds_explicitly(self, tmp_path):
+        with ServePlane(ServeConfig(max_sessions=1, max_queued=1)) as plane:
+            plane.submit("a", tenant_params(tmp_path / "a", 1, turns=10**6))
+            plane.submit("b", tenant_params(tmp_path / "b", 2))
+            t0 = time.monotonic()
+            with pytest.raises(AdmissionRejected) as ei:
+                plane.submit("c", tenant_params(tmp_path / "c", 3))
+            assert time.monotonic() - t0 < 5  # immediate, not a timeout
+            assert ei.value.retry_after is not None
+            plane.begin_drain()
+        assert plane.handle("a").status in ("drained", "completed")
+        assert plane.handle("b").status in ("shed", "drained", "completed")
+
+    def test_caller_owned_event_stream_is_teed_not_consumed(self, tmp_path):
+        """The caller keeps every event of their own queue, AND the
+        plane's digest still populates (producer-side tee) — so the
+        drain receipt / classification work in bring-your-own-queue
+        mode too."""
+        events: queue.Queue = queue.Queue()
+        with ServePlane(ServeConfig(max_sessions=1)) as plane:
+            h = plane.submit(
+                "a", tenant_params(tmp_path / "a", 1), events=events
+            )
+            seen = []
+            while (e := events.get(timeout=60)) is not None:
+                seen.append(e)
+            assert h.wait(timeout=60)
+        assert h.status == "completed"
+        finals = [e for e in seen if isinstance(e, gol.FinalTurnComplete)]
+        assert finals and finals[0].completed_turns == TURNS
+        # The digest saw the same stream the caller did.
+        assert h.final is not None and h.final.completed_turns == TURNS
+        assert h.last_turn == TURNS
+        turns = [e for e in seen if isinstance(e, gol.TurnComplete)]
+        assert len(turns) == TURNS  # caller missed nothing to the tee
+
+    def test_deadline_propagates_into_the_watchdog(self, tmp_path):
+        p = tenant_params(tmp_path / "a", 1)
+        assert p.dispatch_deadline_seconds == 0
+        with ServePlane(
+            ServeConfig(max_sessions=1, default_deadline_seconds=30.0)
+        ) as plane:
+            h = plane.submit("a", p)
+            h2_deadline = plane.submit(
+                "b", tenant_params(tmp_path / "b", 2), deadline_seconds=45.0
+            )
+            assert plane.wait_idle(timeout=120)
+        assert h.params.dispatch_deadline_seconds == 30.0  # config default
+        assert h2_deadline.params.dispatch_deadline_seconds == 45.0  # wins
+        assert h.status == h2_deadline.status == "completed"
+
+    def test_params_own_deadline_not_clobbered_by_config_default(
+        self, tmp_path
+    ):
+        """The config default applies only to sessions WITHOUT their own
+        deadline — a tenant that configured a generous watchdog must not
+        have it silently tightened by the pod's default."""
+        p = tenant_params(tmp_path / "a", 1, dispatch_deadline_seconds=300.0)
+        with ServePlane(
+            ServeConfig(max_sessions=1, default_deadline_seconds=30.0)
+        ) as plane:
+            h = plane.submit("a", p)
+            assert plane.wait_idle(timeout=120)
+        assert h.params.dispatch_deadline_seconds == 300.0
+        assert h.status == "completed"
+
+    def test_completed_before_drain_not_reported_drained(self, tmp_path):
+        """A session whose FinalTurnComplete covered all its turns is
+        'completed' even when the drain latch was raised concurrently —
+        the receipt must not claim an interrupted, non-resumable tenant
+        where there is a finished one."""
+        from distributed_gol_tpu.serve.plane import SessionHandle
+
+        p = tenant_params(tmp_path / "a", 1)
+        with ServePlane(ServeConfig(max_sessions=1)) as plane:
+            h = SessionHandle("a", p, Session(), queue.Queue(), True)
+            h.t_start = time.perf_counter()
+            h.final = gol.FinalTurnComplete(completed_turns=p.turns)
+            h.last_turn = p.turns
+            h.stop.request()  # drain latched just as the run finished
+            plane._classify(h, None)
+        assert h.status == "completed"
+        assert h.last_turn == TURNS
+
+    def test_drain_receipt_turn_with_caller_owned_stream(self, tmp_path):
+        """submit(events=...) means the plane never sees TurnComplete —
+        the drain receipt's turn must come from the parked checkpoint,
+        not read 0."""
+        ev = queue.Queue()
+        plane = ServePlane(
+            ServeConfig(max_sessions=1), checkpoint_root=tmp_path / "ckpt"
+        )
+        try:
+            h = plane.submit(
+                "a",
+                tenant_params(tmp_path / "a", 1, turns=10**6),
+                events=ev,
+            )
+            # Wait for real progress via the caller-owned stream.
+            deadline = time.monotonic() + 60
+            progressed = 0
+            while time.monotonic() < deadline and progressed < SUPERSTEP:
+                e = ev.get(timeout=30)
+                if hasattr(e, "completed_turns"):
+                    progressed = e.completed_turns
+            receipt = plane.drain(timeout=60)
+            while ev.get(timeout=30) is not None:  # caller drains to sentinel
+                pass
+        finally:
+            plane.close()
+        assert h.status == "drained" and h.resumable
+        assert receipt["a"]["turn"] >= SUPERSTEP
+        assert receipt["a"]["turn"] == h.session.parked_turn
+
+    def test_terminal_handles_evicted_beyond_budget(self, tmp_path):
+        """A pod serving churning tenant names stays bounded: beyond
+        ``max_retained_handles`` the oldest terminal handle is evicted —
+        introspection books AND the tenant's labelled registry
+        instruments — while resident/queued handles are never touched."""
+        with ServePlane(
+            ServeConfig(max_sessions=1, max_retained_handles=2)
+        ) as plane:
+            names = [f"churn{i}" for i in range(5)]
+            for i, name in enumerate(names):
+                h = plane.submit(name, tenant_params(tmp_path / name, i + 1))
+                assert h.wait(timeout=120)
+            assert plane.wait_idle(timeout=60)
+            retained = set(plane.health()["tenants"])
+        assert retained == set(names[-2:])
+        for gone in names[:-2]:
+            assert plane.handle(gone) is None
+        # The evicted tenants' labelled instruments left the registry.
+        snap = obs_metrics.REGISTRY.snapshot(include_lazy=False).to_dict()
+        live = {
+            obs_metrics.tenant_of(k)
+            for section in ("counters", "gauges", "histograms")
+            for k in snap.get(section, {})
+        }
+        for gone in names[:-2]:
+            assert gone not in live
+        for kept in names[-2:]:
+            assert kept in live
+
+    def test_checkpoint_digest_is_bounded(self, tmp_path):
+        """checkpoint_turns keeps the last 32 — an eternally-running
+        tenant's digest must stay O(1) like the errors cap."""
+        h = None
+        with ServePlane(
+            ServeConfig(max_sessions=1), checkpoint_root=tmp_path / "ckpt"
+        ) as plane:
+            h = plane.submit(
+                "a",
+                tenant_params(
+                    tmp_path / "a",
+                    1,
+                    turns=40 * SUPERSTEP,
+                    checkpoint_every_turns=SUPERSTEP,
+                ),
+            )
+            assert plane.wait_idle(timeout=180)
+        assert h.status == "completed"
+        # 39 periodic saves (the final boundary completes + discards
+        # instead of saving), digest capped to the LAST 32.
+        assert len(h.checkpoint_turns) == 32
+        assert list(h.checkpoint_turns)[-1] == 39 * SUPERSTEP
+        assert list(h.checkpoint_turns)[0] == 8 * SUPERSTEP
+
+    def test_tenant_name_mismatch_is_rejected(self, tmp_path):
+        with ServePlane(ServeConfig()) as plane:
+            with pytest.raises(ValueError, match="contradicts"):
+                plane.submit(
+                    "alice", tenant_params(tmp_path, 1, tenant="bob")
+                )
+
+    def test_health_surface(self, tmp_path):
+        with ServePlane(ServeConfig(max_sessions=2, max_queued=1)) as plane:
+            before = plane.health()
+            assert before["ready"] and before["live"]
+            assert before["resident_sessions"] == 0
+            h = plane.submit("alice", tenant_params(tmp_path / "alice", 1))
+            assert h.wait(timeout=120)
+            assert plane.wait_idle(timeout=60)
+            hl = plane.health()
+            assert hl["tenants"]["alice"]["status"] == "completed"
+            assert hl["tenants"]["alice"]["turns"] == TURNS
+            assert hl["tenants"]["alice"]["dispatches"] == TURNS // SUPERSTEP
+            assert hl["watchdog_fires"] == 0
+            assert hl["capacity"]["max_sessions"] == 2
+        after = plane.health()
+        assert not after["ready"] and after["draining"]
+
+
+# -- per-tenant obs labels (satellite) -----------------------------------------
+
+
+class TestTenantLabels:
+    def test_labelled_roundtrip(self):
+        assert obs_metrics.labelled("controller.turns", None) == "controller.turns"
+        name = obs_metrics.labelled("controller.turns", "alice")
+        assert name == "controller.turns{tenant=alice}"
+        assert obs_metrics.tenant_of(name) == "alice"
+        assert obs_metrics.tenant_of("controller.turns") is None
+
+    @pytest.mark.parametrize("bad", ["", "a/b", "..", ".", "a" * 65, "a b"])
+    def test_params_rejects_unsafe_tenant_names(self, bad, tmp_path):
+        with pytest.raises(ValueError, match="tenant"):
+            tenant_params(tmp_path, 1, tenant=bad)
+
+    def test_one_snapshot_separates_tenants(self, tmp_path, solo_oracle):
+        """Two tenants through one process-wide registry: each session's
+        MetricsReport carries ITS OWN labelled dispatch counters, and an
+        untenanted run keeps the exact pre-serving metric names."""
+        with ServePlane(ServeConfig(max_sessions=2)) as plane:
+            ha = plane.submit("alice", tenant_params(tmp_path / "a", 1))
+            hb = plane.submit("bob", tenant_params(tmp_path / "b", 2))
+            assert plane.wait_idle(timeout=120)
+        for h, t in ((ha, "alice"), (hb, "bob")):
+            counters = h.report.snapshot["counters"]
+            key = f"controller.turns{{tenant={t}}}"
+            assert counters[key] == TURNS
+            assert (
+                counters[f"controller.dispatches{{tenant={t}}}"]
+                == TURNS // SUPERSTEP
+            )
+        # alice's report (a whole-registry delta over her run's window)
+        # must not claim bob's turns as plain "controller.turns".
+        assert ha.report.snapshot["counters"].get("controller.turns", 0) == 0
+
+        # Untenanted control: exact pre-serving names, no labels.
+        events: queue.Queue = queue.Queue()
+        gol.run(tenant_params(tmp_path / "solo", 3), events)
+        report = None
+        while (e := events.get(timeout=60)) is not None:
+            if isinstance(e, gol.MetricsReport):
+                report = e
+        assert report.snapshot["counters"]["controller.turns"] == TURNS
+        assert not any(
+            "{tenant=" in k for k in report.snapshot["counters"]
+        )
+
+    def test_checkpoint_sidecar_carries_tenant_labels(self, tmp_path):
+        """The drain contract's postmortem trail: a parked tenant's
+        sidecar snapshot separates that tenant's work by label."""
+        plane = ServePlane(
+            ServeConfig(max_sessions=1), checkpoint_root=tmp_path / "ckpt"
+        )
+        plane.submit(
+            "alice",
+            tenant_params(tmp_path / "out", 1, turns=10**6),
+        )
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if plane.handle("alice").last_turn >= SUPERSTEP:
+                break
+            time.sleep(0.05)
+        plane.drain(timeout=60)
+        plane.close()
+        sidecars = list((tmp_path / "ckpt" / "alice").glob("checkpoint*.json"))
+        assert sidecars, "drain parked no checkpoint"
+        metas = [json.loads(p.read_text()) for p in sidecars]
+        snaps = [m["metrics"] for m in metas if m.get("metrics")]
+        assert snaps, "no sidecar embedded a metrics snapshot"
+        assert any(
+            obs_metrics.tenant_of(k) == "alice"
+            for s in snaps
+            for k in s.get("counters", {})
+        )
+
+
+# -- the chaos isolation matrix (tentpole leg 2) -------------------------------
+#
+# One faulty tenant beside TWO healthy ones, per fault kind.  The
+# assertion is always the same shape: the healthy tenants complete
+# bit-identical to their fault-free solo oracles, the sick tenant is
+# parked-resumable / cleanly failed / shed — and the pod survives to
+# serve the next submission.
+
+pytestmark_chaos = pytest.mark.chaos
+
+HEALTHY_SEEDS = (101, 202)
+
+
+def submit_healthy(plane, tmp_path):
+    return [
+        plane.submit(f"good{i}", tenant_params(tmp_path / f"good{i}", seed))
+        for i, seed in enumerate(HEALTHY_SEEDS)
+    ]
+
+
+def assert_pod_survives(plane, tmp_path, solo_oracle):
+    """The no-cross-tenant-abort coda: the pod still admits and completes
+    fresh work after the faulty tenant's demise."""
+    h = plane.submit("after", tenant_params(tmp_path / "after", 303))
+    assert h.wait(timeout=120)
+    assert_healthy_matches_oracle(h, solo_oracle, 303)
+
+
+@pytest.mark.chaos
+class TestTenantIsolation:
+    def test_burst_parks_one_tenant_alone(self, tmp_path, solo_oracle):
+        """A 2-failure burst (terminal under the default retry budget)
+        kills ONE tenant — parked resumable, error digested — while both
+        healthy neighbours land on their oracles."""
+        sick_params = tenant_params(tmp_path / "sick", 999)
+        sick_backend = FaultInjectionBackend(
+            Backend(sick_params),
+            FaultPlan([Fault(2, "issue"), Fault(3, "issue")]),
+        )
+        with ServePlane(
+            ServeConfig(max_sessions=3), checkpoint_root=tmp_path / "ckpt"
+        ) as plane:
+            healthy = submit_healthy(plane, tmp_path)
+            sick = plane.submit("sick", sick_params, backend=sick_backend)
+            assert plane.wait_idle(timeout=180)
+            for h, seed in zip(healthy, HEALTHY_SEEDS):
+                assert_healthy_matches_oracle(h, solo_oracle, seed)
+            assert sick.status == "parked"
+            assert sick.resumable
+            assert "RuntimeError" in sick.error
+            errors = sick.errors
+            assert [e.will_retry for e in errors] == [True, False]
+            assert plane.health()["live"]
+            assert_pod_survives(plane, tmp_path, solo_oracle)
+        # Parked-resumable means exactly that: a fresh run on the sick
+        # tenant's scoped session completes to ITS solo oracle.
+        events: queue.Queue = queue.Queue()
+        gol.run(
+            tenant_params(tmp_path / "resumed", 999),
+            events,
+            session=Session(tmp_path / "ckpt" / "sick"),
+        )
+        while events.get(timeout=60) is not None:
+            pass
+        got = tmp_path / "resumed" / f"{W}x{H}x{TURNS}.pgm"
+        assert got.read_bytes() == solo_oracle(999)
+
+    def test_corrupt_tenant_self_heals_in_place(self, tmp_path, solo_oracle):
+        """The supervised variant: a corrupt-fault tenant with its own
+        restart ladder (SDC sentinel + rollback) RECOVERS to its oracle
+        without any other tenant noticing — per-session supervision is
+        per-session."""
+        sick_params = tenant_params(
+            tmp_path / "sick",
+            999,
+            checkpoint_every_turns=SUPERSTEP,
+            sdc_check_every_turns=SUPERSTEP,
+            restart_limit=2,
+        )
+        plan = FaultPlan([Fault(2, "corrupt", cells=3)])
+
+        def factory(params, attempt):
+            backend = Backend(params)
+            return FaultInjectionBackend(backend, plan) if attempt == 0 else backend
+
+        with ServePlane(ServeConfig(max_sessions=3)) as plane:
+            healthy = submit_healthy(plane, tmp_path)
+            sick = plane.submit("sick", sick_params, backend_factory=factory)
+            assert plane.wait_idle(timeout=180)
+            for h, seed in zip(healthy, HEALTHY_SEEDS):
+                assert_healthy_matches_oracle(h, solo_oracle, seed)
+            # The sick tenant RECOVERED: completed, bit-identical, with
+            # the restart documented in its own labelled telemetry.
+            assert_healthy_matches_oracle(sick, solo_oracle, 999)
+            counters = sick.report.snapshot["counters"]
+            assert counters["supervisor.restarts"] == 1
+            assert counters["sdc.mismatches"] == 1
+            assert plane.health()["supervisor_restarts"] == 1
+
+    def test_hang_is_bounded_and_isolated(self, tmp_path, solo_oracle):
+        """A wedged dispatch pins ONE worker for exactly the deadline:
+        the sick tenant aborts via its own watchdog, healthy tenants and
+        the pod's health surface are untouched."""
+        sick_params = tenant_params(tmp_path / "sick", 999)
+        sick_backend = FaultInjectionBackend(
+            Backend(sick_params),
+            FaultPlan([Fault(1, "hang", seconds=90.0)]),
+        )
+        t0 = time.monotonic()
+        try:
+            with ServePlane(
+                ServeConfig(max_sessions=3, default_deadline_seconds=1.0),
+                checkpoint_root=tmp_path / "ckpt",
+            ) as plane:
+                healthy = submit_healthy(plane, tmp_path)
+                sick = plane.submit("sick", sick_params, backend=sick_backend)
+                assert plane.wait_idle(timeout=120)
+                elapsed = time.monotonic() - t0
+                assert elapsed < 45, f"watchdog abort took {elapsed:.1f}s"
+                for h, seed in zip(healthy, HEALTHY_SEEDS):
+                    assert_healthy_matches_oracle(h, solo_oracle, seed)
+                assert sick.status == "parked" and sick.resumable
+                assert "DispatchTimeout" in sick.error
+                hl = plane.health()
+                assert hl["watchdog_fires"] >= 1
+                assert hl["live"]
+                assert_pod_survives(plane, tmp_path, solo_oracle)
+        finally:
+            sick_backend.release_hangs()
+
+    def test_flood_is_shed_beside_healthy_tenants(self, tmp_path, solo_oracle):
+        """The noisy-neighbour row: a max-rate flood fills the free slot
+        and the bounded queue, the rest is shed deterministically, queue
+        depth and memory stay bounded (obs gauges), and the healthy
+        tenants never notice."""
+        with ServePlane(
+            ServeConfig(max_sessions=3, max_queued=2)
+        ) as plane:
+            healthy = submit_healthy(plane, tmp_path)  # 2 of 3 slots
+            flood = FloodTenant(
+                plane,
+                lambda t: tenant_params(tmp_path / t, 7),
+                FaultPlan([Fault(0, "flood", cells=6)]),
+            )
+            tally = flood.run()
+            # Deterministic ladder: 1 free slot, 2 queue places, 3 shed.
+            assert tally == {
+                "submitted": 6, "admitted": 1, "queued": 2, "rejected": 3,
+            }
+            assert [v for _, v in flood.outcomes] == [
+                "admitted", "queued", "queued",
+                "rejected", "rejected", "rejected",
+            ]
+            # Bounded backpressure, visible to a balancer.
+            snap = plane.metrics.snapshot().to_dict()
+            assert snap["gauges"]["serve.queued_sessions"] <= 2
+            assert snap["gauges"]["serve.resident_sessions"] <= 3
+            hl = plane.health()
+            assert hl["rejected"] == 3
+            assert all(e.retry_after is not None for e in flood.rejections)
+            assert plane.wait_idle(timeout=300)
+            for h, seed in zip(healthy, HEALTHY_SEEDS):
+                assert_healthy_matches_oracle(h, solo_oracle, seed)
+            # Admitted flood sessions ran to completion too — a flood is
+            # real load, not a mocked counter bump.
+            for h in flood.handles:
+                assert h.wait(timeout=120) and h.status == "completed"
+
+    def test_flood_plan_is_rejected_at_the_dispatch_seam(self, tmp_path):
+        """Handing a flood-bearing plan to the dispatch-seam harness is a
+        test-harness bug, caught at construction."""
+        params = tenant_params(tmp_path, 1)
+        with pytest.raises(ValueError, match="admission seam"):
+            FaultInjectionBackend(
+                Backend(params), FaultPlan([Fault(0, "flood")])
+            )
+
+
+# -- graceful pod drain (tentpole leg 3) ---------------------------------------
+
+
+@pytest.mark.chaos
+class TestPodDrain:
+    def test_sigterm_drains_all_residents_resumable(
+        self, tmp_path, solo_oracle
+    ):
+        """The acceptance row: a REAL SIGTERM against a pod with N
+        resident sessions emergency-checkpoints all N (fsync-durable via
+        the PR-5 ``_checkpoint_now`` path), the pod exits cleanly, and a
+        fresh pod re-adopts each tenant to the oracle state."""
+        seeds = {"a": 11, "b": 22, "c": 33}
+        root = tmp_path / "ckpt"
+        plane = ServePlane(ServeConfig(max_sessions=3), checkpoint_root=root)
+        restore = plane.install(signals=(signal.SIGTERM,))
+        try:
+            handles = {
+                t: plane.submit(
+                    t, tenant_params(tmp_path / t, seed, turns=10**6)
+                )
+                for t, seed in seeds.items()
+            }
+            # Let every tenant make real progress first.
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not all(
+                h.last_turn >= SUPERSTEP for h in handles.values()
+            ):
+                time.sleep(0.05)
+            os.kill(os.getpid(), signal.SIGTERM)
+            # The handler is non-blocking; the pod empties as each
+            # session parks.  time.sleep keeps the main thread
+            # signal-responsive.
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not all(
+                h.done for h in handles.values()
+            ):
+                time.sleep(0.05)
+        finally:
+            restore()
+        for t, h in handles.items():
+            assert h.status == "drained", (t, h.status, h.error)
+            assert h.resumable
+            assert 0 < h.last_turn < 10**6
+        summary = plane.drain()  # already drained: returns the receipt
+        assert {t: s["resumable"] for t, s in summary.items()} == {
+            t: True for t in seeds
+        }
+        plane.close()
+
+        # -- the restarted pod --
+        plane2 = ServePlane(ServeConfig(max_sessions=3), checkpoint_root=root)
+        adoptable = plane2.resumable_tenants()
+        assert set(adoptable) == set(seeds)
+        for t, info in adoptable.items():
+            assert info["turn"] == handles[t].last_turn
+            assert info["shape"] == [H, W]
+        # Re-adopt toward a turn target PAST the park point so the
+        # resumed run has work left (a fixed TURNS target could land
+        # under a park turn and be flaky).
+        resumed = {}
+        for t, seed in seeds.items():
+            target = adoptable[t]["turn"] + 2 * SUPERSTEP
+            resumed[t] = plane2.submit(
+                t,
+                tenant_params(tmp_path / f"resumed-{t}", seed, turns=target),
+            )
+        assert plane2.wait_idle(timeout=180)
+        for t, h in resumed.items():
+            assert h.status == "completed", (t, h.status, h.error)
+            assert h.last_turn == adoptable[t]["turn"] + 2 * SUPERSTEP
+        plane2.close()
+
+        # Oracle equality: an uninterrupted solo run to the same turn
+        # target must produce the identical final board.
+        for t, seed in seeds.items():
+            target = adoptable[t]["turn"] + 2 * SUPERSTEP
+            solo_out = tmp_path / f"oracle-{t}"
+            p = tenant_params(solo_out, seed, turns=target)
+            events: queue.Queue = queue.Queue()
+            gol.run(p, events)
+            while events.get(timeout=60) is not None:
+                pass
+            want = (solo_out / f"{p.final_output_name}.pgm").read_bytes()
+            got = (
+                tmp_path / f"resumed-{t}" / f"{W}x{H}x{target}.pgm"
+            ).read_bytes()
+            assert got == want, f"re-adopted tenant {t} diverged from oracle"
+
+    def test_drain_sheds_the_waiting_queue(self, tmp_path):
+        """Queued admissions never ran: a drain must terminate their
+        streams explicitly (status 'shed'), not leave consumers hanging."""
+        with ServePlane(ServeConfig(max_sessions=1, max_queued=2)) as plane:
+            running = plane.submit(
+                "run", tenant_params(tmp_path / "run", 1, turns=10**6)
+            )
+            queued = [
+                plane.submit(f"q{i}", tenant_params(tmp_path / f"q{i}", i))
+                for i in range(2)
+            ]
+            plane.begin_drain()
+            for h in queued:
+                assert h.wait(timeout=30)
+                assert h.status == "shed"
+                assert not h.resumable
+                # The stream is terminated for any waiting consumer.
+                assert h.events.get(timeout=10) is None
+            assert running.wait(timeout=60)
+            assert running.status == "drained"
+
+    def test_drain_is_idempotent_and_admissions_stay_closed(self, tmp_path):
+        with ServePlane(ServeConfig()) as plane:
+            plane.begin_drain()
+            plane.begin_drain()  # no double shed / double count
+            with pytest.raises(AdmissionRejected, match="draining"):
+                plane.submit("late", tenant_params(tmp_path, 1))
+            hl = plane.health()
+            assert hl["draining"] and not hl["ready"] and hl["live"]
+
+
+# -- flight-report rendering (satellite) ---------------------------------------
+
+
+class TestFlightReportRendering:
+    def test_pr5_kinds_render_dedicated_rows(self, tmp_path):
+        """Pinning test on a SUPERVISOR-PRODUCED flight record: drive a
+        restart-exhaustion abort (restarts + exhaustion in the ring),
+        then assert the report renders the resilience kinds as prose
+        rows, not generic key=value fallthrough."""
+        from distributed_gol_tpu.engine.supervisor import supervise
+        from tools import flight_report
+
+        params = tenant_params(
+            tmp_path / "out", 1,
+            checkpoint_every_turns=SUPERSTEP, restart_limit=2,
+        )
+        (tmp_path / "out").mkdir()
+        plan = FaultPlan([Fault(0, "issue"), Fault(1, "issue")])
+
+        def always_faulty(p, attempt):
+            return FaultInjectionBackend(Backend(p), plan)
+
+        events: queue.Queue = queue.Queue()
+        with pytest.raises(RuntimeError):
+            supervise(params, events, backend_factory=always_faulty)
+        while events.get(timeout=60) is not None:
+            pass
+
+        from distributed_gol_tpu.obs import flight as flight_lib
+
+        path = flight_lib.latest_flight_record(tmp_path / "out")
+        assert path is not None
+        doc = flight_lib.load_flight_record(path)
+        text = flight_report.render(doc, tail=100)
+        # The dedicated rows (no raw attempt=1 key=value fallthrough).
+        assert "supervisor restart #1 after RuntimeError" in text
+        assert "supervisor restart #2 after RuntimeError" in text
+        assert "supervisor EXHAUSTED after 2 restart(s)" in text
+        # No raw key=value fallthrough for the dedicated kinds
+        # (terminal_failure rows legitimately stay generic).
+        assert "from_turn=" not in text
+        assert "resume_turn=" not in text
+        assert "restarts=" not in text
+
+    def test_all_resilience_kinds_have_renderers(self):
+        """Synthetic ring covering every PR-5 kind: each renders its
+        dedicated prose (generic fallthrough would print 'turn=7'), and
+        unknown kinds still fall through so nothing is ever dropped."""
+        from tools.flight_report import render
+
+        records = [
+            {"kind": "restart", "t": 1.0, "attempt": 1, "cause": "DispatchTimeout",
+             "from_turn": 12, "resume_turn": 8, "tier": "same"},
+            {"kind": "supervisor_exhausted", "t": 2.0, "restarts": 2,
+             "cause": "RuntimeError"},
+            {"kind": "sdc_check", "t": 3.0, "turn": 7, "ok": True,
+             "fingerprint": 123, "stripe": True},
+            {"kind": "sdc_mismatch", "t": 4.0, "turn": 7, "stripe_ok": False,
+             "popcount": 10, "count": 11},
+            {"kind": "preempt", "t": 5.0, "turn": 9},
+            {"kind": "ckpt_skipped_unverified", "t": 6.0, "turn": 9},
+            {"kind": "preempt_save_skipped", "t": 7.0, "turn": 9},
+            {"kind": "some_future_kind", "t": 8.0, "detail": 42},
+            {"kind": "abort", "t": 9.0, "cause": "RuntimeError"},
+        ]
+        doc = {
+            "schema": "gol-flight-v1", "cause": "RuntimeError", "turn": 9,
+            "error": "boom", "written_at": 9.0, "records": records,
+            "metrics": {},
+        }
+        text = render(doc, tail=100)
+        assert "rolled back turn 12 -> 8" in text
+        assert "supervisor EXHAUSTED after 2 restart(s)" in text
+        assert "SDC check at turn 7: ok (stripe+fingerprint, fp=123)" in text
+        assert "SDC MISMATCH at turn 7: popcount 10 vs forced count 11" in text
+        assert "graceful stop latched at turn 9" in text
+        assert "checkpoint WITHHELD at turn 9" in text
+        assert "emergency save WITHHELD at turn 9" in text
+        assert "detail=42" in text  # unknown kind: generic row, not dropped
+
+
+# -- the serve CLI subcommand --------------------------------------------------
+
+
+class TestServeCli:
+    def test_serve_subcommand_end_to_end(self, tmp_path, capsys):
+        from distributed_gol_tpu.__main__ import serve_main
+
+        rc = serve_main(
+            [
+                "--tenant", "alice:16x16x24",
+                "--tenant", "bob:16x16x12",
+                "--checkpoint-root", str(tmp_path / "ckpt"),
+                "--superstep", "4",
+                "--engine", "roll",
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        doc = json.loads(out.strip().splitlines()[-1])
+        assert doc["sessions"]["alice"]["status"] == "completed"
+        assert doc["sessions"]["alice"]["turn"] == 24
+        assert doc["sessions"]["bob"]["turn"] == 12
+        assert doc["health"]["live"]
+        assert doc["health"]["tenants"]["alice"]["turns"] == 24
+
+    def test_tenant_spec_parse_errors(self):
+        from distributed_gol_tpu.__main__ import _parse_tenant_spec
+
+        assert _parse_tenant_spec("a:16x32x100") == ("a", 16, 32, 100)
+        # An empty name is a usage error AT PARSE TIME (ap.error), not a
+        # raw Params traceback from inside submit.
+        for bad in ("a", "a:16x32", "a:16x32xfoo", ":16x16x1"):
+            with pytest.raises(ValueError, match="NAME:WxHxTURNS"):
+                _parse_tenant_spec(bad)

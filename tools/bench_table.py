@@ -50,6 +50,10 @@ def main():
                     "render the fault-tolerance arms: clean vs armed "
                     "controller-path rates plus the supervisor arm's "
                     "MTTR and restart columns ('{}' = empty plan)")
+    ap.add_argument("--serve", type=int, default=0, metavar="N",
+                    help="also run bench.bench_serve (ISSUE 6) and render "
+                    "the serving-plane rows: aggregate and per-tenant "
+                    "gens/s at tenant counts {1,4,16} capped at N")
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -98,6 +102,11 @@ def main():
         from bench import bench_faults
 
         print_faults_table(bench_faults(sizes[0], args.faults))
+
+    if args.serve:
+        from bench import bench_serve
+
+        print_serve_table(bench_serve(args.serve))
 
     if not args.paths:
         return
@@ -167,6 +176,30 @@ def print_faults_table(rec: dict) -> None:
         f"| supervisor | n/a | {sup['spread']:.1%} | {sup['reps']} | "
         f"{sup['median']:.4f} | {sup['restarts']} | {sup['rollback_turns']} |"
     )
+
+
+def print_serve_table(rec: dict) -> None:
+    """Render a ``bench.bench_serve`` record (ISSUE 6) as markdown: one
+    row per tenant count — aggregate pod throughput, the per-tenant rate
+    distribution (fairness), and the scaling efficiency vs N=1."""
+    rows = rec["tenant_counts"]
+    base = None
+    print()
+    print(
+        "| Tenants | aggregate gens/s | per-tenant median | spread | "
+        "reps | scaling vs 1 |"
+    )
+    print("|---|---|---|---|---|---|")
+    for key in sorted(rows, key=lambda k: int(k[1:])):
+        r = rows[key]
+        if base is None:
+            base = r["aggregate_gps"]
+        scale = f"{r['aggregate_gps'] / base:.2f}x" if base else "n/a"
+        print(
+            f"| {r['tenants']} | {r['aggregate_gps']:,.0f} | "
+            f"{r['per_tenant_median_gps']:,.0f} | {r['spread']:.1%} | "
+            f"{r['reps']} | {scale} |"
+        )
 
 
 def metrics_cells(snap: dict | None) -> tuple[str, str, str]:

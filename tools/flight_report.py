@@ -26,11 +26,89 @@ def _fmt_t(t: float, t0: float) -> str:
     return f"+{t - t0:8.3f}s"
 
 
+# -- per-kind renderers (ISSUE 6 satellite) ------------------------------------
+# The PR-5 resilience kinds used to fall through to the generic key=value
+# row; a postmortem reader should not need the flight schema in their
+# head to see "the supervisor rolled back" or "a park was withheld".
+# Unknown kinds (and kinds without a dedicated renderer) still get the
+# generic row, so the report never drops information.
+
+def _d_restart(r):
+    return (
+        f"supervisor restart #{r.get('attempt', '?')} after "
+        f"{r.get('cause', '?')}: rolled back turn {r.get('from_turn', '?')}"
+        f" -> {r.get('resume_turn', '?')} ({r.get('tier', '?')} tier)"
+    )
+
+
+def _d_supervisor_exhausted(r):
+    return (
+        f"supervisor EXHAUSTED after {r.get('restarts', '?')} restart(s) "
+        f"({r.get('cause', '?')}): degrading to sentinel abort"
+    )
+
+
+def _d_sdc_check(r):
+    legs = "stripe+fingerprint" if r.get("stripe") else "fingerprint only"
+    verdict = "ok" if r.get("ok") else "STRIPE MISMATCH"
+    return (
+        f"SDC check at turn {r.get('turn', '?')}: {verdict} ({legs}, "
+        f"fp={r.get('fingerprint', '?')})"
+    )
+
+
+def _d_sdc_mismatch(r):
+    return (
+        f"SDC MISMATCH at turn {r.get('turn', '?')}: popcount "
+        f"{r.get('popcount', '?')} vs forced count {r.get('count', '?')}, "
+        f"stripe_ok={r.get('stripe_ok', '?')} — corruption detected, "
+        "board NOT parked"
+    )
+
+
+def _d_preempt(r):
+    return (
+        f"graceful stop latched at turn {r.get('turn', '?')}: emergency "
+        "checkpoint + paused-and-resumable exit"
+    )
+
+
+def _d_ckpt_skipped_unverified(r):
+    return (
+        f"checkpoint WITHHELD at turn {r.get('turn', '?')}: parking "
+        "boundary failed verification (SDC probe skipped) — older "
+        "checkpoints stay authoritative"
+    )
+
+
+def _d_preempt_save_skipped(r):
+    return (
+        f"emergency save WITHHELD at turn {r.get('turn', '?')}: board "
+        "unverified at preemption — exiting resumable from the last good "
+        "checkpoint"
+    )
+
+
+_DESCRIBE = {
+    "restart": _d_restart,
+    "supervisor_exhausted": _d_supervisor_exhausted,
+    "sdc_check": _d_sdc_check,
+    "sdc_mismatch": _d_sdc_mismatch,
+    "preempt": _d_preempt,
+    "ckpt_skipped_unverified": _d_ckpt_skipped_unverified,
+    "preempt_save_skipped": _d_preempt_save_skipped,
+}
+
+
 def _fmt_record(r: dict, t0: float) -> str:
     kind = r["kind"]
-    rest = " ".join(
-        f"{k}={v}" for k, v in r.items() if k not in ("kind", "t")
-    )
+    describe = _DESCRIBE.get(kind)
+    if describe is not None:
+        rest = describe(r)
+    else:
+        rest = " ".join(
+            f"{k}={v}" for k, v in r.items() if k not in ("kind", "t")
+        )
     return f"  {_fmt_t(r['t'], t0)}  {kind:<16} {rest}"
 
 
