@@ -642,6 +642,9 @@ def bench_faults(size: int, plan_spec: str, budget_seconds: float = 8.0) -> dict
     # the rollback-recovery supervisor survives, published as a
     # lint-checked MTTR stats block alongside the overhead rows.
     record["supervisor"] = bench_supervisor(size, superstep)
+    # The device-loss arm (ISSUE 7): a persistent device_down that only
+    # the topology-elastic rung survives, published with the shrink.
+    record["device_loss"] = bench_device_loss(superstep)
     log(f"  fault-overhead record: {json.dumps(record)}")
     return record
 
@@ -729,6 +732,120 @@ def bench_supervisor(size: int, superstep: int, bursts: int = 3) -> dict:
         "turns": turns,
     }
     log(f"  supervisor MTTR record: {json.dumps(record)}")
+    return record
+
+
+def bench_device_loss(superstep: int) -> dict:
+    """The device-loss MTTR arm of ``--faults`` (ISSUE 7): a sharded run
+    loses one device PERSISTENTLY (the ``device_down`` fault kind — every
+    attempt touching it fails, unlike a transient burst), so the
+    same-tier and forced-ppermute rungs both fail and only the
+    topology-elastic rung recovers: probe, condemn, rebuild on the
+    largest healthy mesh, reshard the checkpoint, complete.  The record
+    publishes the per-recovery times as a quiet-protocol stats block
+    (headline ``value`` = median; ``elastic_recovery_s`` isolates the
+    elastic rung — its MTTR includes the probe, the blacklist write, and
+    the resharded restore) plus the topology columns bench_table renders:
+    ``mesh_from``/``mesh_to``/``excluded_devices``.  Needs >= 2 devices
+    (on a CPU rig run under ``XLA_FLAGS=--xla_force_host_platform_
+    device_count=8``); a single-device rig records a skip."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from distributed_gol_tpu.engine.backend import Backend
+    from distributed_gol_tpu.engine.events import EventQueue
+    from distributed_gol_tpu.engine.params import Params
+    from distributed_gol_tpu.engine.session import Session
+    from distributed_gol_tpu.engine.supervisor import supervise
+    from distributed_gol_tpu.parallel import mesh as mesh_lib
+    from distributed_gol_tpu.testing.faults import (
+        Fault,
+        FaultInjectionBackend,
+        FaultPlan,
+    )
+    from distributed_gol_tpu.utils import measure
+
+    n = len(jax.devices())
+    if n < 2:
+        log("  device-loss arm skipped: single-device rig")
+        return {"skipped": "needs >= 2 devices to lose one"}
+    # A board the packed engine shards over every device: rows per device
+    # stay word-free (row sharding), width one packed word per column.
+    size = 64 if n <= 64 else n
+    mesh_from = mesh_lib.largest_mesh_shape(n, size, size)
+    victim = int(
+        mesh_lib.make_mesh(mesh_from).devices.flat[-1].id
+    )  # the last device of the running mesh dies
+    turns = 6 * superstep
+    params = Params(
+        turns=turns,
+        image_width=size,
+        image_height=size,
+        engine="packed",
+        mesh_shape=mesh_from,
+        soup_density=0.3,
+        soup_seed=0,
+        out_dir=tempfile.mkdtemp(prefix="gol_bench_devloss_"),
+        superstep=superstep,
+        cycle_check=0,
+        retry_limit=1,
+        checkpoint_every_turns=superstep,
+        restart_limit=3,
+        ticker_period=60.0,
+    )
+    plan = FaultPlan([Fault(2, "device_down", device=victim)])
+    harness = FaultInjectionBackend(Backend(params), plan)
+
+    def factory(p, attempt):
+        return harness if attempt == 0 else harness.rebind(Backend(p))
+
+    events = EventQueue()
+
+    def consume():
+        while events.get(timeout=600) is not None:
+            pass
+
+    consumer = threading.Thread(target=consume, daemon=True)
+    consumer.start()
+    t0 = time.perf_counter()
+    try:
+        sup = supervise(
+            params,
+            events,
+            session=Session(),
+            backend_factory=factory,
+            device_probe=harness.device_probe,
+        )
+        wall = time.perf_counter() - t0
+        consumer.join(timeout=60)
+        times = [max(t, 1e-6) for t in sup.recovery_times()]
+        stats = measure.summarize(times)
+        elastic = [r for r in sup.history if r["tier"] == "elastic"]
+        record = {
+            "metric": f"gol_device_loss_mttr_{size}x{size}",
+            "unit": "seconds",
+            "value": round(stats["median"], 6),
+            **stats,
+            # The elastic recovery is the LAST one (attempts 1-2 retried
+            # the full topology); isolate it for the headline story.
+            "elastic_recovery_s": round(times[-1], 6) if times else None,
+            "restarts": len(sup.history),
+            "mesh_from": list(mesh_from),
+            "mesh_to": elastic[-1]["mesh_shape"] if elastic else None,
+            "excluded_devices": (
+                elastic[-1]["excluded_devices"] if elastic else []
+            ),
+            "recovered_wall_s": round(wall, 3),
+            "superstep": superstep,
+            "turns": turns,
+        }
+    finally:
+        # The blacklist is process-wide by design; a bench process must
+        # not leak the scripted loss into its later arms.
+        mesh_lib.clear_blacklist()
+    log(f"  device-loss MTTR record: {json.dumps(record)}")
     return record
 
 

@@ -159,6 +159,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "is terminal (CorruptionDetected) and rolls "
                          "back under --restart-limit; keep N <= "
                          "--checkpoint-every-turns; 0 disables")
+    ap.add_argument("--peer-heartbeat", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="multi-host peer liveness: every rank UDP-pings "
+                         "its peers on this interval so a rank that dies "
+                         "HARD (SIGKILL, machine loss) is detected within "
+                         "~3 intervals and survivors abort resumable "
+                         "(PeerLost) instead of waiting out the dispatch "
+                         "deadline or the coordination service's "
+                         "multi-minute hard-kill; arm uniformly on every "
+                         "rank; 0 = off; ignored on single-host runs")
     # Observability (docs/API.md "Observability").
     ap.add_argument("--metrics", action="store_true", default=True,
                     help="always-on run metrics: counters/gauges/histograms "
@@ -224,6 +234,7 @@ def params_from_args(args) -> Params:
         restart_limit=args.restart_limit,
         restart_window_seconds=args.restart_window,
         sdc_check_every_turns=args.sdc_check_every_turns,
+        peer_heartbeat_seconds=args.peer_heartbeat,
         metrics=args.metrics,
         flight_recorder_depth=args.flight_recorder_depth,
     )

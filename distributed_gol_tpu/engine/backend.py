@@ -88,7 +88,30 @@ class Backend:
             )
         if (ny, nx) == (1, 1):
             self.mesh = None
+            # Single-device placement honours the elastic-topology
+            # contract too (ISSUE 7): an explicit device pins the board
+            # there (committed arrays keep every jit on that device), and
+            # a blacklisted default device is sidestepped for the first
+            # healthy one — so a supervisor rebuild after condemning the
+            # default chip genuinely moves off it.  With no blacklist and
+            # no explicit device the path is byte-for-byte the old one.
             self._sharding = None
+            if devices:
+                from jax.sharding import SingleDeviceSharding
+
+                self._sharding = SingleDeviceSharding(devices[0])
+            elif mesh_lib.blacklisted():
+                healthy = mesh_lib.healthy_devices()
+                if not healthy:
+                    raise ValueError(
+                        "every device is blacklisted "
+                        f"({sorted(mesh_lib.blacklisted())}); no healthy "
+                        "device to build on"
+                    )
+                if healthy[0] is not jax.devices()[0]:
+                    from jax.sharding import SingleDeviceSharding
+
+                    self._sharding = SingleDeviceSharding(healthy[0])
             self.engine_used = self._resolve_single(params, shape)
             self._warn_if_downgraded(params, shape, (ny, nx))
             if self.engine_used == "pallas-packed":
@@ -219,6 +242,15 @@ class Backend:
             else:
                 _superstep = halo.sharded_superstep(self.mesh)
                 self._superstep = lambda b, k: _superstep(b, self.table, k)
+        #: The devices this backend actually computes on — what the
+        #: elastic supervisor records in restart history and what the
+        #: ``device_down`` fault harness intersects its dead set against.
+        if self.mesh is not None:
+            self.devices = list(self.mesh.devices.flat)
+        elif self._sharding is not None:
+            self.devices = list(self._sharding.device_set)
+        else:
+            self.devices = [jax.devices()[0]]
         self._init_metrics(params)
 
     def _init_metrics(self, params: Params):

@@ -111,15 +111,33 @@ class _Watchdog:
     timeout — metrics bumps only, so the disabled (deadline 0) path stays
     a plain call with zero overhead."""
 
+    #: How often an armed ``interrupt`` callback is polled mid-wait.
+    INTERRUPT_POLL_SECONDS = 0.25
+
     def __init__(self, deadline: float, on_arm=None, on_fire=None):
         self.deadline = deadline
         self._on_arm = on_arm
         self._on_fire = on_fire
+        #: Optional zero-arg callable polled during the wait (ISSUE 7);
+        #: returning an exception abandons the wait and raises it
+        #: immediately — the multihost tier wires the peer-heartbeat
+        #: check here, so a survivor blocked in a collective its dead
+        #: peer never joins aborts within the HEARTBEAT bound (naming
+        #: the dead rank) instead of sitting out the full dispatch
+        #: deadline, which must stay conservative enough to cover a
+        #: first-dispatch compile.  None (default) keeps the plain
+        #: single wait.
+        self.interrupt = None
 
     def call(self, fn):
-        if not self.deadline:
+        # Deadline 0 with no interrupt is OFF: a plain call, zero cost.
+        # An armed interrupt keeps polling even with no deadline — the
+        # heartbeat must be able to break a wait the deadline would
+        # never bound (``dispatch_deadline_seconds=0`` is the default);
+        # such waits never fire a DispatchTimeout, only the interrupt.
+        if not self.deadline and self.interrupt is None:
             return fn()
-        if self._on_arm is not None:
+        if self.deadline and self._on_arm is not None:
             self._on_arm()
         box: list = []
         done = threading.Event()
@@ -134,13 +152,29 @@ class _Watchdog:
 
         t = threading.Thread(target=_runner, name="gol-watchdog", daemon=True)
         t.start()
-        if not done.wait(self.deadline):
-            if self._on_fire is not None:
-                self._on_fire()
-            raise DispatchTimeout(
-                f"dispatch did not resolve within {self.deadline}s "
-                "(device or collective wedged)"
-            )
+        deadline_at = (
+            time.monotonic() + self.deadline if self.deadline else None
+        )
+        while True:
+            if self.interrupt is not None:
+                step = self.INTERRUPT_POLL_SECONDS
+            else:
+                step = self.deadline
+            if deadline_at is not None:
+                step = min(step, max(deadline_at - time.monotonic(), 0.001))
+            if done.wait(step):
+                break
+            if self.interrupt is not None:
+                err = self.interrupt()
+                if err is not None:
+                    raise err  # the wedged wait is abandoned, like a fire
+            if deadline_at is not None and time.monotonic() >= deadline_at:
+                if self._on_fire is not None:
+                    self._on_fire()
+                raise DispatchTimeout(
+                    f"dispatch did not resolve within {self.deadline}s "
+                    "(device or collective wedged)"
+                )
         ok, value = box[0]
         if ok:
             return value

@@ -224,6 +224,18 @@ class Params:
     # cadence <= checkpoint_every_turns so a corruption is caught before
     # it can be checkpointed.  0 (default) disables.
     sdc_check_every_turns: int = 0
+    # Multi-host peer heartbeat (ISSUE 7): every rank UDP-pings its peers
+    # on this interval (seconds) from a daemon thread, OUTSIDE the
+    # collective stream — so a rank that dies hard (SIGKILL, kernel
+    # panic) is detected within ~3 intervals by every survivor, which
+    # then aborts with the stream sentinel and the newest periodic
+    # checkpoint as the resumable state, instead of relying solely on
+    # the dispatch watchdog (which only fires once a survivor blocks in
+    # a collective) or the coordination service's multi-minute
+    # hard-kill.  Arm uniformly on every rank, like ``stop`` — the setup
+    # address exchange is a collective.  0 (default) disables; ignored
+    # on single-host runs.
+    peer_heartbeat_seconds: float = 0.0
 
     # --- observability (ISSUE 4; see docs/API.md "Observability") ---
     # Always-on metrics registry: process-wide named counters/gauges/
@@ -325,6 +337,10 @@ class Params:
         if self.sdc_check_every_turns < 0:
             raise ValueError(
                 "sdc_check_every_turns must be >= 0 (0 disables the sentinel)"
+            )
+        if self.peer_heartbeat_seconds < 0:
+            raise ValueError(
+                "peer_heartbeat_seconds must be >= 0 (0 disables the heartbeat)"
             )
         if (
             self.sdc_check_every_turns

@@ -12,11 +12,15 @@ MegaScale-style rollback-recovery runtimes):
   terminal dispatch failure (``DispatchError`` exhaustion,
   ``DispatchTimeout``, ``CorruptionDetected``) with a resumable checkpoint
   available no longer aborts: the backend is torn down and rebuilt on an
-  **escalation ladder** (first restart: the same tier; later restarts:
-  the forced-ppermute exchange tier — a wedged remote-DMA collective must
-  not be rebuilt verbatim forever), the newest intact checkpoint is
-  restored through the existing ``Session.check_states`` scan, and the
-  run resumes.  Restarts are bounded by ``Params.restart_limit`` plus the
+  **escalation ladder** (restart 1: the same tier; restart 2: the
+  forced-ppermute exchange tier — a wedged remote-DMA collective must
+  not be rebuilt verbatim forever; restart >= 3: the **topology-elastic
+  rung**, ISSUE 7 — probe every device, condemn the dead ones into the
+  process-wide blacklist (``parallel.mesh``), and rebuild on the largest
+  healthy mesh, resharding the restored full-board checkpoint onto it),
+  the newest intact checkpoint is restored through the existing
+  ``Session.check_states`` scan, and the run resumes.  Restarts are
+  bounded by ``Params.restart_limit`` plus the
   ``Params.restart_window_seconds`` rate budget; exhaustion degrades to
   PR 2's sentinel abort, with the full restart history in the flight
   record (the supervisor shares ONE flight ring across attempts).
@@ -47,6 +51,14 @@ from distributed_gol_tpu.engine.session import Session, default_session
 from distributed_gol_tpu.obs import flight as flight_lib
 from distributed_gol_tpu.obs import metrics as metrics_lib
 from distributed_gol_tpu.obs import spans
+from distributed_gol_tpu.parallel import mesh as mesh_lib
+
+
+class AllDevicesCondemned(RuntimeError):
+    """The elastic rung's device probe found no healthy device to rebuild
+    on (or no mesh over the survivors divides the board).  Terminal by
+    construction: the run degrades to PR 2's sentinel abort with the
+    full probe results and blacklist in the flight ring."""
 
 
 def route_signals(
@@ -112,11 +124,22 @@ class Supervisor:
     the first build): the default implements the escalation ladder —
     attempt 1 rebuilds the same tier (a transient deserves one fresh
     chance), attempt >= 2 forces the ppermute exchange fallback via
-    ``Backend(params, in_kernel=False)``.  Chaos tests inject fault
-    harnesses here."""
+    ``Backend(params, in_kernel=False)``, attempt >= 3 is the elastic
+    rung: devices are probed (``device_probe``, default
+    ``parallel.mesh.probe_devices``), dead ones are condemned into the
+    process-wide blacklist, and the rebuild lands on the largest healthy
+    mesh — ``Backend(params', devices=healthy)`` on the default ladder;
+    a ``backend_factory`` receives the SHRUNKEN ``params'`` (its
+    ``mesh_shape`` reduced) and its own ``Backend(params')`` excludes the
+    blacklisted devices through ``make_mesh``'s healthy-device default.
+    Chaos tests inject fault harnesses here (and a plan-consistent
+    ``device_probe`` — ``FaultInjectionBackend.device_probe``)."""
 
     # Restart attempt at which the rebuild escalates to forced-ppermute.
     _ESCALATE_AT = 2
+    # Restart attempt at which the rebuild turns topology-elastic: probe
+    # devices, blacklist the dead, shrink the mesh to the healthy set.
+    _ELASTIC_AT = 3
 
     def __init__(
         self,
@@ -127,6 +150,7 @@ class Supervisor:
         backend: Optional[Backend] = None,
         backend_factory: Optional[Callable[[Params, int], Backend]] = None,
         stop: Optional[GracefulStop] = None,
+        device_probe: Optional[Callable] = None,
     ):
         self.params = params
         self.events = events
@@ -135,12 +159,30 @@ class Supervisor:
         self._first_backend = backend
         self._backend_factory = backend_factory
         self.stop = stop
+        # The health-classification seam of the elastic rung:
+        # ``device_probe(devices) -> (healthy, condemned)``.  Default is
+        # the real put/fetch probe, watchdog-bounded by the dispatch
+        # deadline when one is set (a wedged chip must fail its probe in
+        # bounded time, not hang the recovery).
+        if device_probe is None:
+            deadline = (
+                params.dispatch_deadline_seconds
+                or mesh_lib.PROBE_DEADLINE_SECONDS
+            )
+            device_probe = lambda devs: mesh_lib.probe_devices(  # noqa: E731
+                devs, deadline
+            )
+        self._device_probe = device_probe
+        # (shrunken params, healthy device list) once the elastic rung
+        # has planned a rebuild — consumed by _build_backend.
+        self._elastic: Optional[tuple[Params, list]] = None
         self.flight = flight_lib.FlightRecorder(params.flight_recorder_depth)
         self.metrics = metrics_lib.registry_for(params.metrics)
         self._m_restarts = self.metrics.counter("supervisor.restarts")
         self._m_rollback = self.metrics.counter("supervisor.rollback_turns")
         #: One dict per restart: attempt, cause, from_turn, resume_turn,
-        #: tier, t (unix seconds) — the run's restart history.
+        #: tier, mesh_shape, excluded_devices, t (unix seconds) — the
+        #: run's restart history.
         self.history: list[dict] = []
         self._restart_times: list[float] = []  # monotonic, for the rate budget
 
@@ -148,6 +190,21 @@ class Supervisor:
     def _build_backend(self, attempt: int) -> Backend:
         if attempt == 0 and self._first_backend is not None:
             return self._first_backend
+        if attempt >= self._ELASTIC_AT and self._elastic is not None:
+            # The elastic rung (planned by _plan_elastic, which ran the
+            # probe and condemned dead devices before this rebuild).
+            eparams, healthy = self._elastic
+            if self._backend_factory is not None:
+                # The factory builds its own Backend from the shrunken
+                # params; make_mesh's healthy-device default keeps the
+                # blacklisted devices out without the factory knowing.
+                return self._backend_factory(eparams, attempt)
+            if eparams.mesh_shape == self.params.mesh_shape:
+                # Nothing condemned (the failure was not device-tied):
+                # stay on the forced-ppermute rung's tier rather than
+                # rebuilding the possibly-wedged collective verbatim.
+                return Backend(eparams, devices=healthy, in_kernel=False)
+            return Backend(eparams, devices=healthy)
         if self._backend_factory is not None:
             return self._backend_factory(self.params, attempt)
         if attempt >= self._ESCALATE_AT:
@@ -160,12 +217,83 @@ class Supervisor:
         return Backend(self.params)
 
     def _ladder_tier(self, attempt: int) -> str:
+        if attempt >= self._ELASTIC_AT:
+            return "elastic"
         if self._backend_factory is not None:
             return "factory"
         return "forced-ppermute" if attempt >= self._ESCALATE_AT else "same"
 
+    # -- the elastic rung ------------------------------------------------------
+    def _plan_elastic(self, attempt: int) -> tuple[tuple[int, int], list[int]]:
+        """Probe the (non-blacklisted) devices, condemn the dead ones,
+        and pick the rebuild topology: the original mesh when enough
+        devices stay healthy, else the largest healthy factorisation
+        that divides the board (word-aligned shapes preferred so the
+        shrink keeps the packed engine family —
+        ``mesh_lib.largest_mesh_shape``).  Returns
+        ``(mesh_shape, excluded_ids)`` for the restart-history row and
+        stashes the rebuild config for ``_build_backend``; raises
+        :class:`AllDevicesCondemned` when nothing survives.
+
+        Every probe outcome is a flight record (``device_blacklist``),
+        success or not — a postmortem of a mid-ladder exhaustion must
+        show the full probe results, not just the abort."""
+        from dataclasses import replace
+
+        p = self.params
+        candidates = mesh_lib.healthy_devices()
+        with spans.span("gol.supervisor.probe", attempt=attempt):
+            healthy, condemned = self._device_probe(candidates)
+        newly = mesh_lib.condemn(condemned) if condemned else []
+        excluded = sorted(mesh_lib.blacklisted())
+        self.flight.record(
+            "device_blacklist",
+            attempt=attempt,
+            probed=len(candidates),
+            condemned=sorted(d.id for d in condemned),
+            blacklist=excluded,
+        )
+        del newly  # counted by mesh_lib.condemn (mesh.devices_lost)
+        if not healthy:
+            raise AllDevicesCondemned(
+                f"device probe condemned all {len(candidates)} remaining "
+                f"devices (blacklist: {excluded})"
+            )
+        old = p.mesh_shape
+        if len(healthy) >= old[0] * old[1]:
+            new = old  # enough survivors: keep the run's own topology
+        else:
+            new = mesh_lib.largest_mesh_shape(
+                len(healthy), p.image_height, p.image_width
+            )
+        if new != old:
+            self.flight.record(
+                "mesh_shrink",
+                attempt=attempt,
+                from_shape=list(old),
+                to_shape=list(new),
+                healthy=len(healthy),
+            )
+        self._elastic = (replace(p, mesh_shape=new), healthy)
+        return new, excluded
+
     # -- the restart budget ----------------------------------------------------
     def _budget_allows(self, now: float) -> bool:
+        """Whether one more restart fits the budget.  Two explicit modes:
+
+        - ``restart_window_seconds == 0`` (default): ``restart_limit``
+          bounds the ALL-TIME restart count of this run
+          (``len(self.history)``).
+        - ``restart_window_seconds > 0``: the limit bounds restarts
+          whose detection time falls inside the trailing window — older
+          restarts age out, so a steady trickle keeps being survived.
+
+        The elastic rungs interact with both modes identically: one
+        restart consumes exactly ONE budget unit however expensive its
+        rebuild was (probe + blacklist + reshard all ride the same
+        restart), and a budget denial mid-ladder degrades to PR 2's
+        sentinel abort — with the full probe results already in the
+        flight ring from the elastic attempts that did run."""
         p = self.params
         if p.restart_window_seconds > 0:
             recent = [
@@ -225,11 +353,14 @@ class Supervisor:
         """Degrade to PR 2's sentinel abort: dump the shared flight ring
         (restart history included — its tail is the abort record) and end
         the stream exactly once."""
-        self.flight.record(
-            "supervisor_exhausted",
-            restarts=len(self.history),
-            cause=type(error).__name__,
-        )
+        fields = dict(restarts=len(self.history), cause=type(error).__name__)
+        blacklist = sorted(mesh_lib.blacklisted())
+        if blacklist:
+            # A degraded abort after elastic attempts documents the
+            # condemned topology right in its tail record (the probe
+            # results themselves are earlier ``device_blacklist`` rows).
+            fields["device_blacklist"] = blacklist
+        self.flight.record("supervisor_exhausted", **fields)
         controller._dump_flight(error)
         self.events.put(None)
 
@@ -303,6 +434,33 @@ class Supervisor:
                     self._abort(controller, e)
                     raise
                 attempt += 1
+                mesh_shape = self.params.mesh_shape
+                excluded: list[int] = sorted(mesh_lib.blacklisted())
+                if attempt >= self._ELASTIC_AT:
+                    # The topology-elastic rung: classify devices and plan
+                    # the shrunken rebuild BEFORE the restart is recorded,
+                    # so the history row carries the topology it resumed
+                    # on.  An unsalvageable topology (every device
+                    # condemned) degrades to the sentinel abort with the
+                    # probe results already in the ring.
+                    try:
+                        mesh_shape, excluded = self._plan_elastic(attempt)
+                    except Exception as probe_err:
+                        # AllDevicesCondemned, or the injectable
+                        # device_probe seam itself failing: either way
+                        # the stream contract holds — every failure path
+                        # out of this handler aborts with the flight
+                        # dump and the sentinel, never an escaped
+                        # exception that leaves consumers blocked on a
+                        # stream that can no longer end.
+                        self.flight.record(
+                            "elastic_exhausted",
+                            attempt=attempt,
+                            cause=type(probe_err).__name__,
+                            error=str(probe_err)[:200],
+                        )
+                        self._abort(controller, e)
+                        raise e from probe_err
                 crash_turn = controller._dispatch_rec.last_turn
                 record = dict(
                     attempt=attempt,
@@ -311,6 +469,8 @@ class Supervisor:
                     from_turn=crash_turn,
                     resume_turn=ckpt.turn,
                     tier=self._ladder_tier(attempt),
+                    mesh_shape=list(mesh_shape),
+                    excluded_devices=excluded,
                 )
                 self.history.append({**record, "t": t_detect})
                 self._restart_times.append(now)
@@ -354,16 +514,27 @@ def supervise(
     backend: Optional[Backend] = None,
     backend_factory: Optional[Callable[[Params, int], Backend]] = None,
     stop: Optional[GracefulStop] = None,
+    device_probe: Optional[Callable] = None,
 ) -> Supervisor:
     """Run one supervised simulation (see :class:`Supervisor`); returns
     the supervisor so callers can read ``history`` /
     ``recovery_times()``.  ``gol.run`` routes here whenever
-    ``params.restart_limit > 0``."""
+    ``params.restart_limit > 0``.  ``device_probe(devices) ->
+    (healthy, condemned)`` overrides the elastic rung's health
+    classifier (chaos tests pass the fault harness's plan-consistent
+    probe)."""
     sup = Supervisor(
-        params, events, key_presses, session, backend, backend_factory, stop
+        params,
+        events,
+        key_presses,
+        session,
+        backend,
+        backend_factory,
+        stop,
+        device_probe=device_probe,
     )
     sup.run()
     return sup
 
 
-__all__ = ["GracefulStop", "Supervisor", "supervise"]
+__all__ = ["AllDevicesCondemned", "GracefulStop", "Supervisor", "supervise"]

@@ -1,4 +1,4 @@
-"""Device-mesh construction.
+"""Device-mesh construction + device health (the elastic-topology base).
 
 The reference's topology is configuration-by-hardcoding: 4 worker IPs in
 ``broker/broker.go:192``.  Here the topology is a ``jax.sharding.Mesh`` with
@@ -6,36 +6,176 @@ axes ``("y", "x")`` — rows and columns of the board's 2-D domain
 decomposition.  ``("y",)`` sharding alone reproduces the reference's
 contiguous row strips (``broker/broker.go:37-56``); the 2-D form halves halo
 bytes per device at scale.
+
+ISSUE 7 adds the health half: a cheap per-device probe
+(:func:`probe_devices` — one tiny jit put/compute/fetch round-trip per
+device, bounded by the PR-2 dispatch watchdog so a wedged chip cannot hang
+the classifier) and a **process-wide device blacklist**.  A device the
+supervisor's elastic rung condemns (:func:`condemn`) stays out of every
+later default-built mesh: :func:`make_mesh` with ``devices=None`` draws
+from :func:`healthy_devices`, so a rebuilt backend — whether built by the
+default ladder, a chaos ``backend_factory``, or a serving-plane tenant —
+never lands back on a dead chip.  Blacklist lifetime is the process: a
+condemned device is condemned for every subsequent run (clear with
+:func:`clear_blacklist`, e.g. between bench reps); the observability
+contract is the ``mesh.devices_lost`` counter, the
+``mesh.device_blacklist`` info label, and the supervisor's
+``device_blacklist`` flight record.
 """
 
 from __future__ import annotations
 
 import math
+import threading
 
 import jax
 from jax.sharding import Mesh
 
 AXES = ("y", "x")
 
+# Process-wide blacklist of condemned device ids (``device.id``), guarded
+# for the rare concurrent condemn (serving-plane tenants share it).
+_BLACKLIST: set[int] = set()
+_BLACKLIST_LOCK = threading.Lock()
 
-def make_mesh(shape: tuple[int, int], devices=None) -> Mesh:
-    """A (ny, nx) mesh with axes ("y", "x") over the first ny*nx devices."""
-    ny, nx = shape
+#: Default per-device probe deadline — generous for a healthy device (the
+#: round-trip is microseconds of compute) yet far below the coordination
+#: service's multi-minute hard-kill the probe exists to pre-empt.
+PROBE_DEADLINE_SECONDS = 5.0
+
+
+def blacklisted() -> frozenset[int]:
+    """The condemned device ids (a snapshot copy)."""
+    with _BLACKLIST_LOCK:
+        return frozenset(_BLACKLIST)
+
+
+def condemn(devices) -> list[int]:
+    """Add ``devices`` (device objects or raw ids) to the process-wide
+    blacklist; returns the ids that are NEWLY condemned.  Bumps the
+    ``mesh.devices_lost`` counter by that count and republishes the
+    ``mesh.device_blacklist`` info label (comma-joined ids) on the
+    process-wide registry, so supervisor restarts, serving-plane health,
+    and flight/metrics artifacts all read one source of truth."""
+    ids = [d if isinstance(d, int) else d.id for d in devices]
+    with _BLACKLIST_LOCK:
+        new = [i for i in ids if i not in _BLACKLIST]
+        _BLACKLIST.update(new)
+        label = ",".join(str(i) for i in sorted(_BLACKLIST))
+    if new:
+        from distributed_gol_tpu.obs import metrics as metrics_lib
+
+        metrics_lib.REGISTRY.counter("mesh.devices_lost").inc(len(new))
+        metrics_lib.REGISTRY.info("mesh.device_blacklist", label)
+    return new
+
+
+def clear_blacklist() -> None:
+    """Forget every condemned device (tests; bench reps; an operator who
+    physically replaced the chip).  The metrics label is reset too."""
+    with _BLACKLIST_LOCK:
+        had = bool(_BLACKLIST)
+        _BLACKLIST.clear()
+    if had:
+        from distributed_gol_tpu.obs import metrics as metrics_lib
+
+        metrics_lib.REGISTRY.info("mesh.device_blacklist", "")
+
+
+def healthy_devices(devices=None) -> list:
+    """``devices`` (default ``jax.devices()``) minus the blacklist — what
+    every default-built mesh draws from."""
     if devices is None:
         devices = jax.devices()
+    bad = blacklisted()
+    return [d for d in devices if d.id not in bad]
+
+
+def lost_device_count() -> int:
+    """How many of this process's devices are condemned (the serving
+    plane's ``degraded`` health field)."""
+    bad = blacklisted()
+    return sum(1 for d in jax.devices() if d.id in bad)
+
+
+def capacity_fraction() -> float:
+    """Healthy share of this process's devices, in [0, 1] — the factor a
+    degraded serving pod scales its cell budget by (1.0 = full health)."""
+    total = len(jax.devices())
+    return (total - lost_device_count()) / total if total else 0.0
+
+
+def probe_device(device, deadline_seconds: float = PROBE_DEADLINE_SECONDS) -> bool:
+    """One cheap health check of ``device``: put a tiny array, run one
+    jitted op on it, fetch, verify the round-trip.  Bounded by the PR-2
+    dispatch watchdog (a wedged device must fail the probe in bounded
+    time, not hang the classifier); any exception or timeout classifies
+    the device unhealthy."""
+    import numpy as np
+
+    # Lazy import: the watchdog lives with the controller, and mesh.py
+    # must stay importable below the engine layer.
+    from distributed_gol_tpu.engine.controller import _Watchdog
+
+    def attempt() -> bool:
+        want = np.arange(8, dtype=np.uint8)
+        x = jax.device_put(want, device)
+        got = np.asarray(jax.device_get(x + np.uint8(1)))
+        return bool((got == want + 1).all())
+
+    try:
+        return bool(_Watchdog(deadline_seconds).call(attempt))
+    except Exception:  # noqa: BLE001 — timeout, runtime error: unhealthy
+        return False
+
+
+def probe_devices(
+    devices=None, deadline_seconds: float = PROBE_DEADLINE_SECONDS
+) -> tuple[list, list]:
+    """Classify ``devices`` (default: the non-blacklisted devices) into
+    ``(healthy, condemned)`` lists via :func:`probe_device`.  The
+    supervisor's elastic rung runs this after a terminal failure; chaos
+    tests inject a plan-consistent probe through the same seam
+    (``Supervisor(device_probe=...)``)."""
+    if devices is None:
+        devices = healthy_devices()
+    healthy, condemned = [], []
+    for d in devices:
+        (healthy if probe_device(d, deadline_seconds) else condemned).append(d)
+    return healthy, condemned
+
+
+def make_mesh(shape: tuple[int, int], devices=None) -> Mesh:
+    """A (ny, nx) mesh with axes ("y", "x") over the first ny*nx devices.
+
+    ``devices=None`` draws from :func:`healthy_devices` — blacklisted
+    devices never enter a default-built mesh, which is what lets a
+    supervisor rebuild (or a factory-built chaos backend, or a new
+    serving-plane tenant) land on healthy silicon without every caller
+    threading a device list."""
+    ny, nx = shape
+    if devices is None:
+        devices = healthy_devices()
     n = ny * nx
     if len(devices) < n:
-        raise ValueError(f"mesh {shape} needs {n} devices, have {len(devices)}")
+        lost = lost_device_count()
+        hint = f" ({lost} blacklisted)" if lost else ""
+        raise ValueError(
+            f"mesh {shape} needs {n} devices, have {len(devices)}{hint}"
+        )
     import numpy as np
 
     return Mesh(np.asarray(devices[:n]).reshape(ny, nx), AXES)
 
 
-def mesh_shape_for(
-    n_devices: int, height: int, width: int
-) -> tuple[int, int]:
-    """Pick a (ny, nx) factorisation of n_devices that divides the board and
-    is as square as possible (minimises halo perimeter per device)."""
+def _squarest_factorisation(
+    n_devices: int, height: int, width: int, predicate=None
+) -> tuple[int, int] | None:
+    """The (ny, nx) factorisation of ``n_devices`` that divides the board
+    and is as square as possible (minimises halo perimeter per device),
+    restricted to shapes ``predicate`` accepts; None if no shape
+    qualifies.  ONE selection loop for both the auto-shape and the
+    elastic-reshard paths — a policy change here reaches both."""
     best = None
     for ny in range(1, n_devices + 1):
         if n_devices % ny:
@@ -43,12 +183,49 @@ def mesh_shape_for(
         nx = n_devices // ny
         if height % ny or width % nx:
             continue
+        if predicate is not None and not predicate(ny, nx):
+            continue
         score = abs(math.log(ny) - math.log(nx))
         if best is None or score < best[0]:
             best = (score, (ny, nx))
-    if best is None:
+    return best[1] if best else None
+
+
+def mesh_shape_for(
+    n_devices: int, height: int, width: int
+) -> tuple[int, int]:
+    """Pick a (ny, nx) factorisation of n_devices that divides the board and
+    is as square as possible (minimises halo perimeter per device)."""
+    shape = _squarest_factorisation(n_devices, height, width)
+    if shape is None:
         raise ValueError(
             f"no factorisation of {n_devices} devices divides a "
             f"{height}x{width} board"
         )
-    return best[1]
+    return shape
+
+
+def largest_mesh_shape(
+    n_devices: int, height: int, width: int, word_aligned: bool = True
+) -> tuple[int, int]:
+    """The largest mesh (most devices ≤ ``n_devices``) that still divides
+    a ``height``×``width`` board — the elastic supervisor's reshard
+    target after device loss.  ``word_aligned`` first prefers shapes the
+    packed engine family can run ((width // nx) % 32 == 0, the
+    ``packed_halo.supports`` word-granularity gate), so a shrink keeps
+    the fast tier whenever any healthy factorisation allows it; with no
+    such shape it falls back to any dividing factorisation (the roll
+    engine supports every shape — bit-identical, slower).  Always
+    succeeds for ``n_devices >= 1``: (1, 1) divides everything."""
+    if n_devices < 1:
+        raise ValueError("largest_mesh_shape needs >= 1 device")
+    word_gate = lambda ny, nx: (width // nx) % 32 == 0  # noqa: E731
+    passes = (word_gate, None) if word_aligned else (None,)
+    for predicate in passes:
+        for n in range(n_devices, 0, -1):
+            shape = _squarest_factorisation(n, height, width, predicate)
+            if shape is not None:
+                return shape
+    raise ValueError(  # unreachable: n == 1 always divides
+        f"no mesh of <= {n_devices} devices divides {height}x{width}"
+    )

@@ -29,13 +29,191 @@ mode does the same.  The same oracle discipline as every other tier.
 from __future__ import annotations
 
 import json
+import threading
+import time
 
 import numpy as np
 
 import jax
 
+from distributed_gol_tpu.engine.controller import DispatchTimeout
 from distributed_gol_tpu.obs import spans
 from distributed_gol_tpu.parallel import mesh as mesh_lib
+
+
+class PeerLost(DispatchTimeout):
+    """A peer rank went silent past the heartbeat bound (ISSUE 7).  The
+    survivors abort with the stream sentinel; the newest periodic
+    checkpoint is the resumable state (the multi-host park policy never
+    fetches collectively after a failure — a dead peer cannot join).
+
+    Raised from two seams, both bounded by the HEARTBEAT timeout rather
+    than the (necessarily compile-conservative) dispatch deadline: the
+    turn-boundary poll (``_stop_now``), and the dispatch watchdog's
+    mid-wait ``interrupt`` hook — a survivor already blocked in a
+    collective its dead peer never joins must not sit out the full
+    deadline.  At the controller seam it is re-classed as a
+    :class:`~distributed_gol_tpu.engine.controller.DispatchTimeout`
+    subtype (terminal, never retried): a collective whose peer is dead
+    can never complete, so retrying is the one guaranteed-futile
+    response."""
+
+
+#: A peer is declared dead after this many missed heartbeat intervals —
+#: one lost UDP datagram must not condemn a rank, three in a row is a
+#: dead process on any sane network.
+HEARTBEAT_MISS_FACTOR = 3.0
+
+
+class PeerHeartbeat:
+    """Lightweight peer liveness beside the collective stream (ISSUE 7).
+
+    Every rank UDP-pings every other rank on ``interval`` seconds from a
+    daemon thread and tracks when it last heard each peer; a rank silent
+    for ``HEARTBEAT_MISS_FACTOR x interval`` is reported by
+    :meth:`dead_peers`.  Deliberately OUTSIDE the collective transport:
+    the existing keys/superstep broadcasts only detect a dead rank once a
+    survivor blocks in a collective the corpse never joins (bounded by
+    the dispatch watchdog), and the coordination service's own heartbeat
+    hard-kills after minutes with no sentinel.  This detector works at
+    turn boundaries even while no collective is in flight, names the
+    dead rank, and costs one tiny datagram per peer per interval.
+
+    ``start()`` exchanges addresses over ONE allgather (call on every
+    rank together — arm uniformly, like ``stop``); tests inject
+    ``peer_addrs`` directly and need no distributed runtime.  The
+    advertised address is this host's name-resolved IP (loopback rigs:
+    127.0.0.1); single-process runs have no peers and never report one
+    dead."""
+
+    def __init__(
+        self,
+        interval: float,
+        process_id: int | None = None,
+        num_processes: int | None = None,
+    ):
+        if interval <= 0:
+            raise ValueError("heartbeat interval must be positive")
+        self.interval = interval
+        self.timeout = HEARTBEAT_MISS_FACTOR * interval
+        self._pid = process_id if process_id is not None else jax.process_index()
+        self._n = num_processes if num_processes is not None else jax.process_count()
+        self._sock = None
+        self._addr: tuple[str, int] | None = None
+        self._peers: dict[int, tuple[str, int]] = {}
+        self._last: dict[int, float] = {}
+        self._stop_evt = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _bind(self) -> tuple[str, int]:
+        import socket
+
+        if self._sock is not None:  # idempotent: tests bind early for the port
+            return self._addr
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(("0.0.0.0", 0))
+        # Short poll so one loop both sends on cadence and drains receipts.
+        self._sock.settimeout(self.interval / 4)
+        port = self._sock.getsockname()[1]
+        self._addr = (self._advertised_host(), port)
+        return self._addr
+
+    @staticmethod
+    def _advertised_host() -> str:
+        """The IP peers should ping.  A UDP connect() toward a routable
+        address resolves the OUTBOUND interface without sending a packet
+        — ``gethostbyname(gethostname())`` is wrong on Debian-style
+        hosts, where /etc/hosts maps the hostname to 127.0.1.1 and every
+        rank would advertise an unreachable loopback, spuriously
+        declaring all peers dead on a real multi-machine rig.  Loopback
+        fallbacks keep single-machine rigs working."""
+        import socket
+
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.connect(("8.8.8.8", 80))  # routing lookup only, no I/O
+                return s.getsockname()[0]
+            finally:
+                s.close()
+        except OSError:
+            pass
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+
+    def _exchange(self, host: str, port: int) -> dict[int, tuple[str, int]]:
+        """One collective ``host:port`` allgather (64-byte padded rows,
+        the ``gather_metrics_snapshots`` transport pattern)."""
+        from jax.experimental import multihost_utils
+
+        payload = f"{host}:{port}".encode()
+        if len(payload) > 64:
+            raise ValueError(f"heartbeat address too long: {payload!r}")
+        buf = np.zeros(64, dtype=np.uint8)
+        buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+        rows = np.atleast_2d(np.asarray(multihost_utils.process_allgather(buf)))
+        out = {}
+        for r in range(rows.shape[0]):
+            text = bytes(rows[r]).rstrip(b"\x00").decode()
+            h, _, p = text.rpartition(":")
+            out[r] = (h, int(p))
+        return out
+
+    def start(self, peer_addrs: dict[int, tuple[str, int]] | None = None):
+        """Bind, exchange addresses (collectively, unless injected), and
+        start the ping/listen daemon.  Returns self."""
+        host, port = self._bind()
+        if peer_addrs is None:
+            peer_addrs = self._exchange(host, port)
+        self._peers = {r: a for r, a in peer_addrs.items() if r != self._pid}
+        now = time.monotonic()
+        self._last = {r: now for r in self._peers}  # grace: start = heard
+        self._thread = threading.Thread(
+            target=self._loop, name="gol-peer-heartbeat", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        import socket
+
+        msg = str(self._pid).encode()
+        next_send = 0.0
+        while not self._stop_evt.is_set():
+            now = time.monotonic()
+            if now >= next_send:
+                for addr in self._peers.values():
+                    try:
+                        self._sock.sendto(msg, addr)
+                    except OSError:
+                        pass  # unreachable peer: its silence is the signal
+                next_send = now + self.interval
+            try:
+                data, _ = self._sock.recvfrom(64)
+                rank = int(data)
+                if rank in self._last:
+                    self._last[rank] = time.monotonic()
+            except (socket.timeout, ValueError, OSError):
+                continue
+
+    def dead_peers(self) -> list[int]:
+        """Ranks silent past the bound (empty = everyone alive)."""
+        now = time.monotonic()
+        return sorted(
+            r for r, t in self._last.items() if now - t > self.timeout
+        )
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval)
 
 
 def initialize(
@@ -71,7 +249,10 @@ def global_row_mesh() -> jax.sharding.Mesh:
     owns a contiguous row band — host boundaries cross DCN exactly once
     per halo exchange, interior boundaries stay on-host.
     """
-    return mesh_lib.make_mesh((len(jax.devices()), 1))
+    # Explicit device list: a cross-rank mesh must NOT be filtered by
+    # the per-process blacklist (make_mesh's devices=None default) —
+    # ranks would desynchronize, and the global shape is fixed anyway.
+    return mesh_lib.make_mesh((len(jax.devices()), 1), jax.devices())
 
 
 def put_global(board: np.ndarray, sharding) -> jax.Array:
@@ -241,6 +422,17 @@ def run_distributed(params, events=None, key_presses=None, session=None, stop=No
     mid-allgather and wedging the survivors.  Arming must be uniform —
     the poll is a collective, so stop-armed and stop-less processes would
     diverge the schedule.
+
+    ``params.peer_heartbeat_seconds > 0`` (ISSUE 7) additionally arms the
+    :class:`PeerHeartbeat` membership monitor on every rank (uniformly —
+    the setup address exchange is a collective): a rank that dies HARD
+    (SIGKILL, machine loss) is detected locally by every survivor within
+    ``HEARTBEAT_MISS_FACTOR`` intervals, and the next turn-boundary poll
+    raises :class:`PeerLost` — sentinel-terminated abort, flight record
+    ``peer_lost``, `multihost.peers_lost` counter, resumable from the
+    newest periodic checkpoint — complementing the dispatch watchdog
+    (which bounds waits INSIDE a collective) and pre-empting the
+    coordination service's multi-minute no-sentinel hard-kill.
     """
     try:
         return _validate_and_run(params, events, key_presses, session, stop)
@@ -286,39 +478,53 @@ def _run_distributed(params, events, key_presses, session, stop=None):
     from distributed_gol_tpu.engine.session import Session, default_session
 
     main = jax.process_index() == 0
-    backend = make_backend(params)
-    session = (session if session is not None else default_session()) if main else Session()
+    # Peer heartbeat (ISSUE 7): armed uniformly via Params, so the setup
+    # address allgather lines up on every rank like any other collective.
+    heartbeat = None
+    if params.peer_heartbeat_seconds > 0 and jax.process_count() > 1:
+        heartbeat = PeerHeartbeat(params.peer_heartbeat_seconds).start()
+    try:
+        backend = make_backend(params)
+        session = (session if session is not None else default_session()) if main else Session()
 
-    # Resume negotiation: process 0 consumes the checkpoint (if any) and
-    # broadcasts the outcome, so every process starts from the same world
-    # and turn.  (With turns == 0 the reference skips negotiation.)
-    negotiated = None
-    if params.turns > 0:
-        ckpt = (
-            session.check_states(
-                params.image_width, params.image_height, params.rule.notation
+        # Resume negotiation: process 0 consumes the checkpoint (if any)
+        # and broadcasts the outcome, so every process starts from the
+        # same world and turn.  (With turns == 0 the reference skips
+        # negotiation.)
+        negotiated = None
+        if params.turns > 0:
+            ckpt = (
+                session.check_states(
+                    params.image_width, params.image_height, params.rule.notation
+                )
+                if main
+                else None
             )
-            if main
-            else None
-        )
-        found = int(
-            multihost_utils.broadcast_one_to_all(
-                np.int32(0 if ckpt is None else 1)
-            )
-        )
-        if found:
-            shape = (params.image_height, params.image_width)
-            world = np.asarray(
+            found = int(
                 multihost_utils.broadcast_one_to_all(
-                    ckpt.world if main else np.zeros(shape, np.uint8)
+                    np.int32(0 if ckpt is None else 1)
                 )
             )
-            turn = int(
-                multihost_utils.broadcast_one_to_all(
-                    np.int32(ckpt.turn if main else 0)
+            if found:
+                shape = (params.image_height, params.image_width)
+                world = np.asarray(
+                    multihost_utils.broadcast_one_to_all(
+                        ckpt.world if main else np.zeros(shape, np.uint8)
+                    )
                 )
-            )
-            negotiated = (world, turn)
+                turn = int(
+                    multihost_utils.broadcast_one_to_all(
+                        np.int32(ckpt.turn if main else 0)
+                    )
+                )
+                negotiated = (world, turn)
+    except BaseException:
+        # A failed backend build or negotiation must not leak the
+        # heartbeat daemon + socket (a retrying caller would accumulate
+        # one per attempt, with peers still seeing this rank alive).
+        if heartbeat is not None:
+            heartbeat.stop()
+        raise
 
     class _DevNull:
         """Follower event sink: the stream only exists on process 0, and a
@@ -334,6 +540,18 @@ def _run_distributed(params, events, key_presses, session, stop=None):
     )
 
     class MultihostController(Controller):
+        def __init__(self, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._peer_loss_recorded = False
+            if heartbeat is not None:
+                # The watchdog's mid-wait hook (ISSUE 7): a survivor
+                # blocked in a collective its dead peer never joins
+                # aborts within the HEARTBEAT bound, naming the rank,
+                # instead of sitting out the full dispatch deadline
+                # (which must stay conservative enough for compiles).
+                self._watchdog.interrupt = self._peer_lost_error
+                keys._watchdog.interrupt = self._peer_lost_error
+
         def _write_pgm(self, path, board_np):
             if main:
                 super()._write_pgm(path, board_np)
@@ -389,7 +607,45 @@ def _run_distributed(params, events, key_presses, session, stop=None):
             # the force itself, like every other blocking collective wait.
             return self._watchdog.call(lambda: bool(flag))
 
+        def _peer_lost_error(self):
+            """The heartbeat verdict, as an exception or None: purely
+            LOCAL — the dead rank cannot join a collective, so the
+            detection must not be one.  Every survivor's own monitor
+            trips within the same bound, so each aborts independently
+            with the sentinel and the newest periodic checkpoint as the
+            resumable state (the supervisor/resume path adopts it);
+            detection is bounded by the heartbeat timeout instead of
+            the coordination service's multi-minute hard-kill.  Records
+            the loss (metrics + flight) exactly once."""
+            if heartbeat is None:
+                return None
+            dead = heartbeat.dead_peers()
+            if not dead:
+                return None
+            if not self._peer_loss_recorded:
+                self._peer_loss_recorded = True
+                self.metrics.counter("multihost.peers_lost").inc(len(dead))
+                self.flight.record(
+                    "peer_lost",
+                    ranks=dead,
+                    timeout_s=round(heartbeat.timeout, 3),
+                )
+            return PeerLost(
+                f"peer rank(s) {dead} silent past the heartbeat "
+                f"bound ({heartbeat.timeout:.1f}s); aborting — "
+                "resume from the newest periodic checkpoint"
+            )
+
         def _stop_now(self):
+            # Peer-liveness check first (ISSUE 7); the same check also
+            # rides the dispatch watchdog's mid-wait interrupt (wired in
+            # __init__), because a survivor is usually BLOCKED in the
+            # dead peer's collective when the loss bites — the boundary
+            # poll alone would leave detection to the full dispatch
+            # deadline.
+            err = self._peer_lost_error()
+            if err is not None:
+                raise err
             # The preemption poll is COLLECTIVE (ISSUE 5): each process
             # contributes its own latch and everyone acts on the max, so
             # one signalled rank stops the whole mesh together — the
@@ -471,4 +727,8 @@ def _run_distributed(params, events, key_presses, session, stop=None):
                     )
                 )
 
-    MultihostController(params, ev, keys, session, backend, stop=stop).run()
+    try:
+        MultihostController(params, ev, keys, session, backend, stop=stop).run()
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()

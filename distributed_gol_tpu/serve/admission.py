@@ -132,6 +132,14 @@ class AdmissionController:
         self.waiting: deque[str] = deque()  # admission order
         self._waiting_cells: dict[str, int] = {}
         self.draining = False
+        # Degraded-capacity factor in (0, 1] (ISSUE 7): the healthy share
+        # of the pod's devices.  The plane syncs it from the process-wide
+        # device blacklist (``parallel.mesh.capacity_fraction``) so a
+        # resident supervisor landing on a shrunken mesh shrinks the
+        # pod-wide cell budget with it — admission sheds/queues against
+        # what the surviving silicon can actually hold.  Pure state here
+        # (this module stays device-free); 1.0 = full health.
+        self.capacity_factor = 1.0
 
     # -- the decision ----------------------------------------------------------
     def admit(self, tenant: str, cells: int) -> str:
@@ -151,10 +159,17 @@ class AdmissionController:
                 f"tenant {tenant!r} already has a live session",
                 retry_after=cfg.retry_after_seconds,
             )
-        if cfg.max_total_cells and self.total_cells + cells > cfg.max_total_cells:
+        budget = self.effective_total_cells
+        if budget and self.total_cells + cells > budget:
+            degraded = (
+                f" (degraded: {self.capacity_factor:.0%} of "
+                f"{cfg.max_total_cells})"
+                if self.capacity_factor < 1.0
+                else ""
+            )
             raise AdmissionRejected(
                 f"pod cell budget exhausted ({self.total_cells} + {cells} "
-                f"> {cfg.max_total_cells})",
+                f"> {budget}{degraded})",
                 retry_after=cfg.retry_after_seconds,
             )
         if len(self.resident) < cfg.max_sessions:
@@ -194,6 +209,16 @@ class AdmissionController:
         return shed
 
     # -- read side -------------------------------------------------------------
+    @property
+    def effective_total_cells(self) -> int:
+        """The pod-wide cell budget after degradation: ``max_total_cells``
+        scaled by :attr:`capacity_factor` (0 stays 0 = unbounded — a pod
+        that opted out of the cell guard keeps that choice while
+        degraded; the per-session bound still applies)."""
+        if not self.config.max_total_cells:
+            return 0
+        return max(1, int(self.config.max_total_cells * self.capacity_factor))
+
     @property
     def total_cells(self) -> int:
         return sum(self.resident.values()) + sum(self._waiting_cells.values())

@@ -72,6 +72,7 @@ from distributed_gol_tpu.engine.params import Params
 from distributed_gol_tpu.engine.session import Session
 from distributed_gol_tpu.engine.supervisor import GracefulStop
 from distributed_gol_tpu.obs import metrics as metrics_lib
+from distributed_gol_tpu.parallel import mesh as mesh_lib
 from distributed_gol_tpu.serve.admission import (
     ADMIT_RUN,
     AdmissionController,
@@ -330,6 +331,12 @@ class ServePlane:
             if self._closed:
                 self._c_rejected.inc()
                 raise AdmissionRejected("pod is closed")
+            # Degraded-mode sync (ISSUE 7): a resident supervisor that
+            # condemned devices onto the process-wide blacklist shrank
+            # the silicon this pod schedules onto; every admission
+            # decision re-reads the healthy fraction so the cell budget
+            # tracks reality, not the config's full-health assumption.
+            self._admission.capacity_factor = mesh_lib.capacity_fraction()
             try:
                 verdict = self._admission.admit(tenant, cells)
             except AdmissionRejected:
@@ -598,11 +605,14 @@ class ServePlane:
         can admit work now; ``live`` = the control plane itself is
         healthy (a not-live pod should be ejected/restarted; a
         not-ready-but-live pod is full or draining — route around it)."""
+        devices_lost = mesh_lib.lost_device_count()
         with self._lock:
+            self._admission.capacity_factor = mesh_lib.capacity_fraction()
             draining = self._admission.draining
             resident = len(self._admission.resident)
             queued = self._admission.queued
             resident_cells = self._admission.resident_cells
+            effective_cells = self._admission.effective_total_cells
             ready = (
                 not self._closed
                 and not draining
@@ -631,6 +641,12 @@ class ServePlane:
         return {
             "ready": ready,
             "live": not closed and self._loop_thread.is_alive(),
+            # Degraded mode (ISSUE 7): this pod lost devices to the
+            # blacklist (an elastic supervisor condemned them) and now
+            # admits against the reduced capacity.  A balancer keeps
+            # routing to a degraded-but-ready pod — it just holds less.
+            "degraded": devices_lost > 0,
+            "devices_lost": devices_lost,
             "draining": draining,
             "resident_sessions": resident,
             "queued_sessions": queued,
@@ -639,6 +655,7 @@ class ServePlane:
                 "max_sessions": self.config.max_sessions,
                 "max_queued": self.config.max_queued,
                 "max_total_cells": self.config.max_total_cells,
+                "effective_total_cells": effective_cells,
             },
             "watchdog_fires": counters.get("faults.watchdog_fires", 0),
             "supervisor_restarts": counters.get("supervisor.restarts", 0),

@@ -35,6 +35,21 @@ Fault kinds:
   sentinel's popcount cross-check alone (an even mix of births/deaths
   could cancel in the count; the stripe recompute has no such parity
   blind spot).
+- ``device_down`` — a PERSISTENTLY dead device, not a transient fault:
+  from dispatch ``at`` onward, device id ``device`` is down for the rest
+  of the plan's life, and EVERY dispatch whose backend still computes on
+  that device fails at issue time — retries included, and (through
+  :meth:`FaultInjectionBackend.rebind`, the supervisor-chaos seam)
+  every rebuilt attempt too.  Contrast with a ``burst`` of consecutive
+  ``issue`` faults: a burst is transient — it defeats the retry budget
+  but the NEXT attempt's dispatches succeed, so a same-tier supervisor
+  rebuild recovers; ``device_down`` defeats every rung that rebuilds on
+  the same device set, and only a topology-elastic rebuild that excludes
+  the dead device (ISSUE 7) recovers.  Dispatches on a backend that does
+  NOT touch the dead device (a shrunken mesh) succeed, which is exactly
+  the recovery the elastic ladder is asserted against.
+  JSON-schedulable like ``corrupt``:
+  ``{"at": 2, "kind": "device_down", "device": 3}``.
 - ``flood`` — a misbehaving TENANT, not a misbehaving device: at step
   ``at`` of a scripted submission schedule, ``cells`` back-to-back
   session submissions are fired at the serving plane's admission seam
@@ -70,7 +85,9 @@ from typing import Iterable, Sequence
 
 import numpy as np
 
-FAULT_KINDS = ("issue", "resolve", "latency", "hang", "corrupt", "flood")
+FAULT_KINDS = (
+    "issue", "resolve", "latency", "hang", "corrupt", "flood", "device_down",
+)
 
 # Injected hangs self-release after this long if nothing (watchdog, test
 # teardown) got there first: a leaked daemon thread must not outlive the
@@ -86,6 +103,7 @@ class Fault:
     kind: str
     seconds: float = 0.0  # latency duration / hang self-release timeout
     cells: int = 1  # corrupt: seeded bit-flips; flood: burst submissions
+    device: int = 0  # device_down: the condemned device's ``device.id``
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -96,6 +114,8 @@ class Fault:
             raise ValueError(f"fault seconds must be >= 0, got {self.seconds}")
         if self.cells < 1:
             raise ValueError(f"fault cells must be >= 1, got {self.cells}")
+        if self.device < 0:
+            raise ValueError(f"fault device id must be >= 0, got {self.device}")
 
 
 class FaultPlan:
@@ -194,6 +214,7 @@ class FaultPlan:
                 str(f["kind"]),
                 seconds=float(f.get("seconds", 0.0)),
                 cells=int(f.get("cells", 1)),
+                device=int(f.get("device", 0)),
             )
             for f in obj.get("faults", ())
         )
@@ -246,12 +267,46 @@ class FaultInjectionBackend:
         self.plan = plan
         self.dispatches = 0
         self.injected: list[Fault] = []
+        #: Device ids struck by a ``device_down`` fault — persistent plan
+        #: state: once dead, dead for the harness's whole life (across
+        #: :meth:`rebind`), exactly like real dead silicon.
+        self.down_devices: set[int] = set()
         self._release = threading.Event()
 
     def __getattr__(self, name):
         # Only consulted for names not defined on the wrapper: params,
         # put/fetch, viewer dispatches, skip telemetry, _CYCLE_PERIOD...
         return getattr(self._inner, name)
+
+    def rebind(self, inner) -> "FaultInjectionBackend":
+        """Swap the wrapped backend while KEEPING the dispatch index and
+        the dead-device set — the supervisor-chaos seam: a rebuild ladder
+        hands each attempt's fresh backend to ONE persistent harness, so
+        ``device_down`` stays down across attempts (a fresh harness per
+        attempt would resurrect the device, modelling a transient fault
+        the ``issue`` kind already covers).  Returns self so a
+        ``backend_factory`` can be one expression."""
+        self._inner = inner
+        return self
+
+    def _inner_devices(self):
+        devices = getattr(self._inner, "devices", None)
+        if devices is not None:
+            return devices
+        import jax
+
+        return [jax.devices()[0]]
+
+    def device_probe(self, devices) -> tuple[list, list]:
+        """The plan-consistent health probe for the supervisor's elastic
+        rung (``Supervisor(device_probe=...)``): classifies ``devices``
+        into (healthy, condemned) by the harness's OWN dead set — the
+        hermetic stand-in for ``parallel.mesh.probe_devices``, whose real
+        put/fetch probes would find a CPU rig's devices healthy and never
+        see an injected fault."""
+        healthy = [d for d in devices if d.id not in self.down_devices]
+        condemned = [d for d in devices if d.id in self.down_devices]
+        return healthy, condemned
 
     def release_hangs(self) -> None:
         """Unblock every injected hang (test teardown: frees any watchdog
@@ -261,8 +316,29 @@ class FaultInjectionBackend:
     def run_turns_async(self, board, turns: int):
         i = self.dispatches
         self.dispatches += 1
+        # device_down strikes are persistent: latch every fault whose
+        # index has arrived, then fail ANY dispatch (this one and all
+        # later ones, retries and rebound attempts included) whose
+        # backend still computes on a dead device — at issue time, like
+        # ``issue``.  A backend that no longer touches the device (the
+        # elastic supervisor's shrunken mesh) sails through.
+        for f in self.plan.faults:
+            if (
+                f.kind == "device_down"
+                and f.at <= i
+                and f.device not in self.down_devices
+            ):
+                self.down_devices.add(f.device)
+                self.injected.append(f)
+        if self.down_devices:
+            dead = self.down_devices & {d.id for d in self._inner_devices()}
+            if dead:
+                raise RuntimeError(
+                    f"injected device_down (devices {sorted(dead)}, "
+                    f"dispatch {i})"
+                )
         fault = self.plan.fault_at(i)
-        if fault is None:
+        if fault is None or fault.kind == "device_down":
             return self._inner.run_turns_async(board, turns)
         self.injected.append(fault)
         if fault.kind == "issue":

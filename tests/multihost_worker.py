@@ -46,6 +46,8 @@ def main():
         return faults_main(coordinator, nprocs, pid, okfile, sys.argv[6])
     if mode == "preempt":
         return preempt_main(coordinator, nprocs, pid, okfile, sys.argv[6])
+    if mode == "peerloss":
+        return peerloss_main(coordinator, nprocs, pid, okfile, sys.argv[6])
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -762,6 +764,188 @@ def preempt_main(coordinator, nprocs, pid, okfile, out_dir):
         with open(okfile, "w") as f:
             f.write("ok")
         print(f"[{pid}] one-sided SIGTERM: collective drain + resume ok", flush=True)
+    except BaseException:
+        traceback.print_exc()
+        os._exit(1)
+    os._exit(0)
+
+
+def peerloss_main(coordinator, nprocs, pid, okfile, out_dir):
+    """Hard peer death mid-run (ISSUE 7 multihost leg): process 1 — a
+    FOLLOWER — SIGKILLs itself once the survivor has committed a periodic
+    checkpoint.  No drain, no teardown: the corpse never joins another
+    collective.  With the peer heartbeat armed (``Params.
+    peer_heartbeat_seconds``), the survivor must exit within a bound —
+    via :class:`multihost.PeerLost` from its own liveness monitor when
+    the turn boundary gets there first, or via the dispatch watchdog /
+    the transport surfacing the closed connection when the kill lands
+    mid-collective; all are clean sentinel aborts, never the
+    coordination service's multi-minute no-sentinel hard-kill.  Symmetric
+    injected dispatch latency paces the run so boundaries (where only the
+    heartbeat can detect) dominate the cycle.  The newest periodic
+    checkpoint then resumes on a single device and lands byte-identically
+    on a never-killed single-device run — device loss shrank the
+    topology; it did not cost committed progress."""
+    import queue
+    import signal
+    import threading
+    import time
+    import traceback
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import distributed_gol_tpu as gol
+    from distributed_gol_tpu.engine.session import Session
+    from distributed_gol_tpu.parallel import multihost
+    from distributed_gol_tpu.testing.faults import (
+        Fault,
+        FaultInjectionBackend,
+        FaultPlan,
+    )
+
+    try:
+        multihost.initialize(coordinator, nprocs, pid)
+        my_out = os.path.join(out_dir, f"p{pid}")
+        os.makedirs(my_out, exist_ok=True)
+        params = gol.Params(
+            turns=10**6,  # effectively unbounded: the kill ends phase 1
+            image_width=64,
+            image_height=64,
+            soup_density=0.3,
+            soup_seed=7,
+            out_dir=my_out,
+            superstep=10,
+            cycle_check=0,
+            checkpoint_every_turns=10,
+            peer_heartbeat_seconds=0.1,  # dead-peer bound: 0.3 s
+            dispatch_deadline_seconds=10.0,  # backstop, not the detector
+            turn_events="batch",
+            ticker_period=60.0,
+        )
+        # Symmetric pacing: every dispatch from 1 on sleeps 0.5 s on BOTH
+        # ranks (deterministic, identical schedules), so the kill almost
+        # always lands while both ranks are OUTSIDE a collective and the
+        # heartbeat — not the transport — is what notices.
+        real_make = multihost.make_backend
+        plan = FaultPlan(
+            [Fault(i, "latency", seconds=0.5) for i in range(1, 400)]
+        )
+        multihost.make_backend = lambda p: FaultInjectionBackend(
+            real_make(p), plan
+        )
+
+        ckpt_dir = os.path.join(out_dir, "ckpt")
+        started_marker = os.path.join(out_dir, "started")
+
+        if pid == 1:
+            # The hard death: SIGKILL to SELF once the survivor has a
+            # durable checkpoint — no handlers run, no socket linger.
+            def die():
+                deadline = time.time() + 120
+                while not os.path.exists(started_marker) and time.time() < deadline:
+                    time.sleep(0.05)
+                time.sleep(0.25)  # land mid-latency-sleep, between boundaries
+                os.kill(os.getpid(), signal.SIGKILL)
+
+            threading.Thread(target=die, daemon=True).start()
+
+        t0 = time.monotonic()
+        if pid == 0:
+            ses = Session(ckpt_dir)
+            events: queue.Queue = queue.Queue()
+            sentinel = threading.Event()
+            seen = []
+
+            def pump():
+                while True:
+                    e = events.get(timeout=180)
+                    if e is None:
+                        sentinel.set()
+                        return
+                    seen.append(e)
+                    if isinstance(e, gol.CheckpointSaved) and not os.path.exists(
+                        started_marker
+                    ):
+                        open(started_marker, "w").write("go")
+
+            t = threading.Thread(target=pump, daemon=True)
+            t.start()
+            err = None
+            try:
+                multihost.run_distributed(params, events, None, ses)
+            except BaseException as e:  # noqa: BLE001 — the abort under test
+                err = e
+            elapsed = time.monotonic() - t0
+            assert err is not None, "peer SIGKILL must abort the survivor"
+            assert sentinel.wait(10), "stream did not end with the sentinel"
+            # Bounded: heartbeat (0.3 s) or watchdog (10 s) plus slack —
+            # never the coordination service's multi-minute hard-kill.
+            assert elapsed < 90, f"survivor exit took {elapsed:.0f}s"
+            if isinstance(err, multihost.PeerLost):
+                assert "1" in str(err), err
+                # The liveness monitor documented the loss in telemetry.
+                reports = [e for e in seen if isinstance(e, gol.MetricsReport)]
+                if reports:
+                    counters = reports[0].snapshot["counters"]
+                    assert counters.get("multihost.peers_lost", 0) >= 1
+            else:
+                # The kill landed inside a collective: the transport or
+                # the watchdog got there first — equally bounded.
+                print(f"[0] transport beat the heartbeat: {type(err).__name__}",
+                      flush=True)
+            saved = [e for e in seen if isinstance(e, gol.CheckpointSaved)]
+            assert saved, "no periodic checkpoint before the kill"
+
+            # Phase 2: the survivor resumes SINGLE-DEVICE from the newest
+            # periodic checkpoint (the dead rank cannot come back) and
+            # must land byte-identically on a never-killed run.
+            from dataclasses import replace
+
+            resumed = replace(
+                params,
+                cycle_check=8,  # settles + fast-forwards: bounded turns
+                peer_heartbeat_seconds=0.0,
+                dispatch_deadline_seconds=0.0,
+                checkpoint_every_turns=0,
+            )
+            multihost.make_backend = real_make  # plan stays off phase 2
+            ev2: queue.Queue = queue.Queue()
+            seen2 = []
+            gol.run(resumed, ev2, session=Session(ckpt_dir))
+            while (e := ev2.get(timeout=180)) is not None:
+                seen2.append(e)
+            final2 = [e for e in seen2 if isinstance(e, gol.FinalTurnComplete)][0]
+            assert final2.completed_turns == params.turns
+
+            single_out = os.path.join(out_dir, "single")
+            os.makedirs(single_out, exist_ok=True)
+            ev3: queue.Queue = queue.Queue()
+            gol.run(replace(resumed, out_dir=single_out), ev3)
+            while ev3.get(timeout=180) is not None:
+                pass
+            got = open(f"{my_out}/64x64x{params.turns}.pgm", "rb").read()
+            want = open(f"{single_out}/64x64x{params.turns}.pgm", "rb").read()
+            assert got == want, "post-peerloss resume differs from oracle"
+
+            with open(okfile, "w") as f:
+                f.write("ok")
+            print(
+                f"[0] peer loss: bounded exit in {elapsed:.1f}s "
+                f"({type(err).__name__}) + resumed to oracle",
+                flush=True,
+            )
+        else:
+            # The victim: runs until the SIGKILL takes it.  Nothing below
+            # should be reached; if the kill never lands, time out hard so
+            # the launcher sees the failure.
+            try:
+                multihost.run_distributed(params)
+            except BaseException:  # noqa: BLE001 — teardown races are fine
+                pass
+            time.sleep(180)
+            os._exit(1)
     except BaseException:
         traceback.print_exc()
         os._exit(1)

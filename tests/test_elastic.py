@@ -1,0 +1,552 @@
+"""Topology-elastic recovery (ISSUE 7): survive device loss by shrinking.
+
+Three layers, asserted hermetically on the 8-virtual-device CPU rig:
+
+- **Device health + blacklist units**: the process-wide condemn/clear
+  lifecycle (with its ``mesh.devices_lost`` counter and
+  ``mesh.device_blacklist`` info label), the real put/fetch probe on a
+  healthy device, and ``largest_mesh_shape``'s reshard arithmetic —
+  word-aligned shapes preferred (the ``packed_halo.supports`` gate),
+  any dividing factorisation accepted, (1,1) always reachable.
+- **The elastic chaos rows**: a persistent ``device_down`` fault defeats
+  the same-tier and forced-ppermute rungs, then the elastic rung probes,
+  condemns, and rebuilds on the largest healthy mesh — the supervised
+  run completes bit-identical to the fault-free oracle on the SHRUNKEN
+  mesh, with the blacklist + ``mesh_shrink`` in the flight ring and
+  ``supervisor.restarts``/``mesh.devices_lost`` in the MetricsReport.
+  With the supervisor off the behaviour is byte-for-byte the PR-2
+  sentinel abort; with EVERY device condemned the ladder degrades to the
+  sentinel abort with the full probe results in the flight record.
+- **Peer heartbeat units**: two in-process :class:`PeerHeartbeat`
+  monitors with injected addresses prove liveness tracking and the
+  bounded dead-peer detection (the cross-process SIGKILL integration is
+  ``tests/multihost_worker.py::peerloss_main``).
+
+Chaos rows are marked ``chaos`` like the rest of the matrix.
+"""
+
+import queue
+import time
+
+import pytest
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.engine.backend import Backend
+from distributed_gol_tpu.engine.events import DispatchError
+from distributed_gol_tpu.engine.session import Session
+from distributed_gol_tpu.engine.supervisor import (
+    AllDevicesCondemned,
+    Supervisor,
+    supervise,
+)
+from distributed_gol_tpu.obs import flight as flight_lib
+from distributed_gol_tpu.parallel import mesh as mesh_lib
+from distributed_gol_tpu.testing.faults import (
+    Fault,
+    FaultInjectionBackend,
+    FaultPlan,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_blacklist():
+    """The blacklist is deliberately process-wide (condemned silicon stays
+    condemned for every later run) — tests must not leak it."""
+    mesh_lib.clear_blacklist()
+    yield
+    mesh_lib.clear_blacklist()
+
+
+# -- device health + blacklist units -------------------------------------------
+
+
+def test_condemn_blacklist_lifecycle_and_metrics():
+    import jax
+
+    from distributed_gol_tpu.obs import metrics as metrics_lib
+
+    before = metrics_lib.REGISTRY.counter("mesh.devices_lost").value
+    assert mesh_lib.blacklisted() == frozenset()
+    assert mesh_lib.condemn([3, 5]) == [3, 5]
+    assert mesh_lib.condemn([5, jax.devices()[1]]) == [jax.devices()[1].id]
+    assert mesh_lib.blacklisted() == frozenset({1, 3, 5})
+    # Counter counts NEWLY condemned only; the label is the full list.
+    assert metrics_lib.REGISTRY.counter("mesh.devices_lost").value - before == 3
+    snap = metrics_lib.REGISTRY.snapshot().to_dict()
+    assert snap["info"]["mesh.device_blacklist"] == "1,3,5"
+    # healthy_devices filters; lost_device_count counts real devices only
+    # (ids 3 and 5 may or may not exist on this rig, id 1 does).
+    healthy = mesh_lib.healthy_devices()
+    assert all(d.id not in (1, 3, 5) for d in healthy)
+    assert mesh_lib.lost_device_count() >= 1
+    frac = mesh_lib.capacity_fraction()
+    assert 0.0 < frac < 1.0
+    mesh_lib.clear_blacklist()
+    assert mesh_lib.blacklisted() == frozenset()
+    assert mesh_lib.capacity_fraction() == 1.0
+    snap = metrics_lib.REGISTRY.snapshot().to_dict()
+    assert snap["info"]["mesh.device_blacklist"] == ""
+
+
+def test_probe_classifies_real_devices_healthy():
+    """The real put/fetch probe on this rig's (healthy) CPU devices."""
+    import jax
+
+    healthy, condemned = mesh_lib.probe_devices(jax.devices()[:2])
+    assert [d.id for d in healthy] == [0, 1] and condemned == []
+
+
+def test_make_mesh_default_skips_blacklisted_devices():
+    import jax
+
+    mesh_lib.condemn([0])
+    mesh = mesh_lib.make_mesh((2, 1))
+    ids = [d.id for d in mesh.devices.flat]
+    assert 0 not in ids and ids == [1, 2]
+    # An explicit device list still wins (callers own their topology).
+    explicit = mesh_lib.make_mesh((2, 1), jax.devices()[:2])
+    assert [d.id for d in explicit.devices.flat] == [0, 1]
+    # Too few survivors: the error names the blacklist.
+    mesh_lib.condemn(range(1, len(jax.devices())))
+    with pytest.raises(ValueError, match="blacklisted"):
+        mesh_lib.make_mesh((2, 1))
+
+
+@pytest.mark.parametrize(
+    "n,h,w,want",
+    [
+        (8, 512, 512, (2, 4)),  # full health: most devices, squarest
+        (7, 64, 64, (2, 2)),    # 7 and 6,5 don't divide 64; 4 does
+        (3, 512, 512, (1, 2)),  # 3 doesn't divide 512; 2 does
+        (1, 512, 512, (1, 1)),  # the universal fallback
+        (4, 64, 64, (2, 2)),    # w//nx = 32: word-aligned 2-D form
+        (8, 64, 64, (4, 2)),    # (2,4) loses word alignment (16 cols); (4,2) keeps it
+    ],
+)
+def test_largest_mesh_shape_prefers_word_aligned(n, h, w, want):
+    assert mesh_lib.largest_mesh_shape(n, h, w) == want
+
+
+def test_largest_mesh_shape_falls_back_past_word_alignment():
+    """A board too narrow for any word-aligned multi-device split still
+    shrinks onto a dividing factorisation (the roll engine's territory)
+    rather than failing — and (1,1) is always reachable."""
+    assert mesh_lib.largest_mesh_shape(4, 8, 8) == (2, 2)  # 4 cols/device
+    assert mesh_lib.largest_mesh_shape(4, 8, 8, word_aligned=False) == (2, 2)
+    assert mesh_lib.largest_mesh_shape(5, 7, 13) == (1, 1)
+    with pytest.raises(ValueError):
+        mesh_lib.largest_mesh_shape(0, 64, 64)
+
+
+def test_backend_single_device_sidesteps_blacklisted_default():
+    """A (1,1) backend whose default device was condemned must genuinely
+    move off it — and record the device it landed on."""
+    import jax
+
+    params = gol.Params(
+        turns=4, image_width=16, image_height=16, engine="roll",
+        soup_density=0.25, soup_seed=11, ticker_period=60.0,
+    )
+    assert Backend(params).devices == [jax.devices()[0]]
+    mesh_lib.condemn([0])
+    assert Backend(params).devices == [jax.devices()[1]]
+    # Explicit placement pins regardless of the blacklist default.
+    pinned = Backend(params, devices=[jax.devices()[2]])
+    assert pinned.devices == [jax.devices()[2]]
+    mesh_lib.condemn(range(len(jax.devices())))
+    with pytest.raises(ValueError, match="blacklisted"):
+        Backend(params)
+
+
+# -- the elastic chaos rows ----------------------------------------------------
+
+# The sharded row the acceptance criterion names: 6 dispatches of 5 turns
+# on an (8,1) packed mesh; device 7 dies persistently at dispatch 2.  The
+# largest healthy mesh over the 7 survivors that keeps 64/nx word-aligned
+# is (2,2) — the shrink crosses mesh DIMENSIONALITY, not just size.
+SHARDED = dict(
+    engine="packed", mesh_shape=(8, 1), image_width=64, image_height=64,
+    superstep=5, turns=30,
+)
+
+
+def elastic_params(out_dir, **kw):
+    cfg = dict(SHARDED)
+    cfg.update(
+        soup_density=0.25, soup_seed=11, out_dir=out_dir, cycle_check=0,
+        ticker_period=60.0,
+    )
+    cfg.update(kw)
+    return gol.Params(**cfg)
+
+
+def drain(events):
+    out = []
+    while (e := events.get(timeout=60)) is not None:
+        out.append(e)
+    return out
+
+
+def persistent_harness(params, plan):
+    """ONE FaultInjectionBackend across every supervisor attempt (the
+    rebind seam): device_down stays down however the ladder rebuilds.
+    Returns (harness, backend_factory)."""
+    harness = FaultInjectionBackend(Backend(params), plan)
+
+    def factory(p, attempt):
+        return harness if attempt == 0 else harness.rebind(Backend(p))
+
+    return harness, factory
+
+
+@pytest.fixture(scope="module")
+def sharded_oracle(tmp_path_factory):
+    out = tmp_path_factory.mktemp("elastic-oracle")
+    p = elastic_params(out)
+    events: queue.Queue = queue.Queue()
+    gol.run(p, events)
+    stream = drain(events)
+    final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)][0]
+    return final, (out / f"{p.final_output_name}.pgm").read_bytes()
+
+
+@pytest.mark.chaos
+def test_device_down_recovers_on_shrunken_mesh(tmp_path, sharded_oracle):
+    """THE acceptance row: a persistent device_down on a sharded run
+    defeats the same-tier and forced rungs (every rebuild still computes
+    on the dead device), then the elastic rung condemns it and rebuilds
+    on the largest healthy mesh — (8,1) -> (2,2) — restoring the
+    checkpoint resharded, and the run completes bit-identical to the
+    fault-free full-mesh oracle.  A recovered run writes no flight FILE;
+    the blacklist + shrink live in the supervisor's ring and the restart
+    history, and the counters ride the terminal MetricsReport."""
+    s = SHARDED["superstep"]
+    params = elastic_params(
+        tmp_path, checkpoint_every_turns=s, restart_limit=3
+    )
+    plan = FaultPlan([Fault(2, "device_down", device=7)])
+    harness, factory = persistent_harness(params, plan)
+    events: queue.Queue = queue.Queue()
+    session = Session()
+    sup = supervise(
+        params,
+        events,
+        session=session,
+        backend_factory=factory,
+        device_probe=harness.device_probe,
+    )
+    stream = drain(events)
+
+    # Bit-identical to the fault-free (8,1) oracle, on a (2,2) mesh.
+    want_final, want_board = sharded_oracle
+    final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)][0]
+    assert final.completed_turns == params.turns
+    assert sorted(final.alive) == sorted(want_final.alive)
+    got = (tmp_path / f"{params.final_output_name}.pgm").read_bytes()
+    assert got == want_board, "recovered run differs from fault-free oracle"
+
+    # The ladder: two full-topology attempts failed, the third shrank.
+    assert [r["attempt"] for r in sup.history] == [1, 2, 3]
+    assert [r["tier"] for r in sup.history] == ["factory", "factory", "elastic"]
+    assert sup.history[0]["mesh_shape"] == [8, 1]
+    assert sup.history[2]["mesh_shape"] == [2, 2]
+    assert sup.history[2]["excluded_devices"] == [7]
+    assert mesh_lib.blacklisted() == frozenset({7})
+
+    # Blacklist + shrink visible in the (shared) flight ring...
+    kinds = [r["kind"] for r in sup.flight.records()]
+    assert "device_blacklist" in kinds and "mesh_shrink" in kinds
+    shrink = [r for r in sup.flight.records() if r["kind"] == "mesh_shrink"][0]
+    assert shrink["from_shape"] == [8, 1] and shrink["to_shape"] == [2, 2]
+    # ...but a RECOVERED run leaves no postmortem file.
+    assert flight_lib.latest_flight_record(tmp_path) is None
+
+    # And in the run's own telemetry.
+    report = [e for e in stream if isinstance(e, gol.MetricsReport)][0]
+    counters = report.snapshot["counters"]
+    assert counters["supervisor.restarts"] == 3
+    assert counters["mesh.devices_lost"] == 1
+    assert report.snapshot["info"]["mesh.device_blacklist"] == "7"
+    # Nothing left parked: the recovered run consumed its rollback state.
+    assert session.check_states(params.image_width, params.image_height) is None
+
+
+@pytest.mark.chaos
+def test_device_down_unsupervised_is_pr2_sentinel_abort(tmp_path, sharded_oracle):
+    """With the supervisor OFF (restart_limit=0, the default), a
+    device_down is byte-for-byte the PR-2 contract: retry announced,
+    terminal abort with the sentinel, last good board parked resumable,
+    flight record explaining the cause — no probe, no blacklist."""
+    params = elastic_params(tmp_path / "faulted")
+    (tmp_path / "faulted").mkdir()
+    backend = FaultInjectionBackend(
+        Backend(params), FaultPlan([Fault(2, "device_down", device=7)])
+    )
+    session = Session()
+    events: queue.Queue = queue.Queue()
+    with pytest.raises(RuntimeError, match="device_down"):
+        gol.run(params, events, session=session, backend=backend)
+    stream = drain(events)  # sentinel guaranteed on the abort path
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert [e.will_retry for e in errors] == [True, False]
+    assert errors[-1].checkpointed
+    path = flight_lib.latest_flight_record(tmp_path / "faulted")
+    assert path is not None
+    doc = flight_lib.load_flight_record(path)
+    assert doc["cause"] == "RuntimeError"
+    assert doc["records"][-1]["kind"] == "abort"
+    # Unsupervised: the elastic machinery never engaged.
+    kinds = {r["kind"] for r in doc["records"]}
+    assert "device_blacklist" not in kinds and "mesh_shrink" not in kinds
+    assert mesh_lib.blacklisted() == frozenset()
+    ckpt = session.check_states(params.image_width, params.image_height)
+    assert ckpt is not None and 0 < ckpt.turn < params.turns
+
+
+@pytest.mark.chaos
+def test_all_devices_condemned_degrades_to_clean_abort(tmp_path):
+    """The unsalvageable topology: devices die one per dispatch (distinct
+    fault indices — a plan schedules one fault per dispatch) until every
+    device on the rig is down.  The elastic rung recovers once onto a
+    surviving device, then the NEXT probe condemns the remainder and the
+    ladder degrades to PR 2's sentinel abort — with the full probe
+    results (the ``device_blacklist`` rows), the ``elastic_exhausted``
+    marker, and the blacklist on the ``supervisor_exhausted`` tail all
+    in the dumped flight record.  The restart budget is NOT the binding
+    constraint (limit 5, only 3 spent): the topology is."""
+    import jax
+
+    params = gol.Params(
+        engine="roll", mesh_shape=(1, 1), image_width=16, image_height=16,
+        superstep=4, turns=24, soup_density=0.25, soup_seed=11,
+        out_dir=tmp_path / "faulted", cycle_check=0, ticker_period=60.0,
+        checkpoint_every_turns=4, restart_limit=5,
+    )
+    (tmp_path / "faulted").mkdir()
+    n = len(jax.devices())
+    plan = FaultPlan(
+        [Fault(2 + d, "device_down", device=d) for d in range(n)]
+    )
+    harness, factory = persistent_harness(params, plan)
+    events: queue.Queue = queue.Queue()
+    with pytest.raises(RuntimeError, match="device_down"):
+        supervise(
+            params,
+            events,
+            backend_factory=factory,
+            device_probe=harness.device_probe,
+        )
+    drain(events)  # sentinel still guaranteed
+    path = flight_lib.latest_flight_record(tmp_path / "faulted")
+    assert path is not None
+    doc = flight_lib.load_flight_record(path)
+    records = doc["records"]
+    probe_rows = [r for r in records if r["kind"] == "device_blacklist"]
+    # Two elastic probes ran: the first condemned the devices dead so
+    # far, the last found the whole rig condemned.
+    assert len(probe_rows) >= 2
+    assert probe_rows[-1]["blacklist"] == list(range(n))
+    assert "elastic_exhausted" in {r["kind"] for r in records}
+    tail_sup = [r for r in records if r["kind"] == "supervisor_exhausted"][0]
+    assert tail_sup["device_blacklist"] == list(range(n))
+    assert tail_sup["restarts"] == 3  # the topology ended it, not the budget
+    assert mesh_lib.blacklisted() == frozenset(range(n))
+
+    # The dumped record renders with the dedicated prose rows (the
+    # pinning half of the flight-report satellite, on a REAL record).
+    from tools.flight_report import render
+
+    text = render(doc, tail=200)
+    assert "elastic probe (attempt 3)" in text
+    assert "condemned device(s) [0, 1, 2, 3, 4, 5]" in text
+    assert "elastic rung EXHAUSTED" in text
+    assert "no healthy device to rebuild on" in text
+
+
+@pytest.mark.chaos
+def test_budget_denial_mid_ladder_degrades_before_probing(tmp_path):
+    """The satellite fix pinned end-to-end: restart_limit=2 in all-time
+    mode means the elastic rung (attempt 3) is DENIED by the budget —
+    exactly one budget unit per restart, however expensive the rung —
+    and the run degrades to the sentinel abort without ever probing."""
+    params = gol.Params(
+        engine="roll", mesh_shape=(1, 1), image_width=16, image_height=16,
+        superstep=4, turns=24, soup_density=0.25, soup_seed=11,
+        out_dir=tmp_path / "faulted", cycle_check=0, ticker_period=60.0,
+        checkpoint_every_turns=4, restart_limit=2,
+    )
+    (tmp_path / "faulted").mkdir()
+    plan = FaultPlan([Fault(2, "device_down", device=0)])
+    harness, factory = persistent_harness(params, plan)
+    events: queue.Queue = queue.Queue()
+    with pytest.raises(RuntimeError, match="device_down"):
+        supervise(
+            params,
+            events,
+            backend_factory=factory,
+            device_probe=harness.device_probe,
+        )
+    drain(events)
+    doc = flight_lib.load_flight_record(
+        flight_lib.latest_flight_record(tmp_path / "faulted")
+    )
+    kinds = [r["kind"] for r in doc["records"]]
+    assert kinds.count("restart") == 2
+    assert "supervisor_exhausted" in kinds
+    # Budget denied BEFORE the elastic rung ran: no probe, no blacklist.
+    assert "device_blacklist" not in kinds
+    assert mesh_lib.blacklisted() == frozenset()
+
+
+@pytest.mark.chaos
+def test_probe_failure_mid_ladder_still_delivers_the_sentinel(tmp_path):
+    """A device_probe that ITSELF raises (the injectable seam failing, or
+    a transport error in a custom prober) must degrade to the sentinel
+    abort like every sibling failure path — flight dump with the probe
+    failure recorded, stream ended — never an escaped exception that
+    leaves stream consumers blocked forever."""
+    params = gol.Params(
+        engine="roll", mesh_shape=(1, 1), image_width=16, image_height=16,
+        superstep=4, turns=24, soup_density=0.25, soup_seed=11,
+        out_dir=tmp_path / "faulted", cycle_check=0, ticker_period=60.0,
+        checkpoint_every_turns=4, restart_limit=5,
+    )
+    (tmp_path / "faulted").mkdir()
+    plan = FaultPlan([Fault(2, "device_down", device=0)])
+    harness, factory = persistent_harness(params, plan)
+
+    def broken_probe(devs):
+        raise KeyError("probe transport died")
+
+    events: queue.Queue = queue.Queue()
+    with pytest.raises(RuntimeError, match="device_down"):
+        supervise(
+            params, events, backend_factory=factory, device_probe=broken_probe
+        )
+    drain(events)  # the sentinel arriving IS the assertion
+    doc = flight_lib.load_flight_record(
+        flight_lib.latest_flight_record(tmp_path / "faulted")
+    )
+    exhausted = [
+        r for r in doc["records"] if r["kind"] == "elastic_exhausted"
+    ][0]
+    assert exhausted["cause"] == "KeyError"
+    assert doc["records"][-1]["kind"] == "abort"
+
+
+# -- supervisor ladder units ---------------------------------------------------
+
+
+def test_ladder_tier_names_elastic_rung():
+    params = gol.Params(
+        turns=8, image_width=16, image_height=16, engine="roll",
+        soup_density=0.25, soup_seed=11, ticker_period=60.0, restart_limit=4,
+    )
+    sup = Supervisor(params, queue.Queue())
+    assert sup._ladder_tier(1) == "same"
+    assert sup._ladder_tier(2) == "forced-ppermute"
+    assert sup._ladder_tier(3) == "elastic"
+    assert sup._ladder_tier(4) == "elastic"
+
+
+def test_plan_elastic_keeps_topology_when_enough_survive():
+    """A failure that was NOT device-tied (the probe finds everyone
+    healthy) keeps the run's own mesh shape — the elastic rung only
+    shrinks when it must — but still re-probes and records."""
+    params = gol.Params(
+        turns=8, image_width=16, image_height=16, engine="roll",
+        soup_density=0.25, soup_seed=11, ticker_period=60.0, restart_limit=4,
+    )
+    sup = Supervisor(
+        params, queue.Queue(), device_probe=lambda devs: (list(devs), [])
+    )
+    shape, excluded = sup._plan_elastic(3)
+    assert shape == (1, 1) and excluded == []
+    kinds = [r["kind"] for r in sup.flight.records()]
+    assert "device_blacklist" in kinds and "mesh_shrink" not in kinds
+
+
+def test_plan_elastic_all_condemned_raises():
+    params = gol.Params(
+        turns=8, image_width=16, image_height=16, engine="roll",
+        soup_density=0.25, soup_seed=11, ticker_period=60.0, restart_limit=4,
+    )
+    sup = Supervisor(
+        params, queue.Queue(), device_probe=lambda devs: ([], list(devs))
+    )
+    with pytest.raises(AllDevicesCondemned):
+        sup._plan_elastic(3)
+
+
+# -- peer heartbeat units ------------------------------------------------------
+
+
+class TestPeerHeartbeat:
+    def test_two_monitors_keep_each_other_alive(self):
+        from distributed_gol_tpu.parallel.multihost import PeerHeartbeat
+
+        a = PeerHeartbeat(0.1, process_id=0, num_processes=2)
+        b = PeerHeartbeat(0.1, process_id=1, num_processes=2)
+        try:
+            ha, pa = a._bind()
+            hb, pb = b._bind()
+            addrs = {0: ("127.0.0.1", pa), 1: ("127.0.0.1", pb)}
+            a.start(addrs)
+            b.start(addrs)
+            # Well past the 3-interval timeout: pings keep both alive.
+            time.sleep(0.8)
+            assert a.dead_peers() == [] and b.dead_peers() == []
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_dead_peer_detected_within_the_bound(self):
+        from distributed_gol_tpu.parallel.multihost import (
+            HEARTBEAT_MISS_FACTOR,
+            PeerHeartbeat,
+        )
+
+        a = PeerHeartbeat(0.1, process_id=0, num_processes=2)
+        b = PeerHeartbeat(0.1, process_id=1, num_processes=2)
+        try:
+            ha, pa = a._bind()
+            hb, pb = b._bind()
+            addrs = {0: ("127.0.0.1", pa), 1: ("127.0.0.1", pb)}
+            a.start(addrs)
+            b.start(addrs)
+            time.sleep(0.3)
+            assert a.dead_peers() == []
+            b.stop()  # the "SIGKILL": b goes silent
+            t0 = time.monotonic()
+            deadline = t0 + 10 * HEARTBEAT_MISS_FACTOR * 0.1  # generous rig slack
+            while a.dead_peers() != [1] and time.monotonic() < deadline:
+                time.sleep(0.02)
+            detected = time.monotonic() - t0
+            assert a.dead_peers() == [1], "silent peer never declared dead"
+            # Bounded detection: the timeout plus rig slack, nowhere near
+            # a coordination-service multi-minute hard-kill.
+            assert detected < 10 * HEARTBEAT_MISS_FACTOR * 0.1
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_single_process_run_has_no_peers(self):
+        from distributed_gol_tpu.parallel.multihost import PeerHeartbeat
+
+        hb = PeerHeartbeat(0.1, process_id=0, num_processes=1)
+        try:
+            host, port = hb._bind()
+            hb.start({0: (host, port)})
+            assert hb.dead_peers() == []
+        finally:
+            hb.stop()
+
+    def test_interval_validated(self):
+        from distributed_gol_tpu.parallel.multihost import PeerHeartbeat
+
+        with pytest.raises(ValueError):
+            PeerHeartbeat(0.0, process_id=0, num_processes=2)
+
+    def test_params_validation(self):
+        with pytest.raises(ValueError, match="peer_heartbeat_seconds"):
+            gol.Params(turns=1, peer_heartbeat_seconds=-1.0)

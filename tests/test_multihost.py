@@ -163,6 +163,50 @@ def test_one_sided_sigterm_drains_the_collective(tmp_path):
     _launch_workers(tmp_path, "preempt", extra=(str(out),))
 
 
+def test_peer_sigkill_bounded_abort_and_resume(tmp_path):
+    """ISSUE 7 multihost leg: a FOLLOWER rank dies HARD (SIGKILL — no
+    drain, no teardown) mid-run with the peer heartbeat armed
+    (``Params.peer_heartbeat_seconds``).  The survivor must exit with the
+    stream sentinel within a bound — PeerLost from its own liveness
+    monitor, or the watchdog/transport when the kill lands mid-collective
+    (the same bounded-abort race ``faults_main`` documents) — and then
+    resume the newest periodic checkpoint single-device, byte-identical
+    to a never-killed run (see multihost_worker.peerloss_main).  The
+    victim's exit code IS the SIGKILL; only the survivor writes an
+    ok-file."""
+    out = tmp_path / "out"
+    out.mkdir()
+    nprocs = 2
+    coordinator = f"127.0.0.1:{free_port()}"
+    okfiles = [tmp_path / f"ok{i}" for i in range(nprocs)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), coordinator, str(nprocs), str(i),
+             str(okfiles[i]), "peerloss", str(out)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(nprocs)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            o, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("peerloss worker timed out (survivor wedged?)")
+        outs.append(o)
+    assert procs[1].returncode == -9, (
+        f"victim should die by SIGKILL, got {procs[1].returncode}:\n"
+        f"{outs[1][-3000:]}"
+    )
+    assert not okfiles[1].exists(), "the corpse wrote an ok-file"
+    assert procs[0].returncode == 0, f"survivor failed:\n{outs[0][-3000:]}"
+    assert okfiles[0].exists(), "survivor produced no ok-file"
+
+
 def test_two_process_frontier_parity(tmp_path):
     """Round-5 frontier strip kernel across a process-spanning mesh:
     skip_stable + superstep=0 on 512-row strips (frontier plan engaged),

@@ -194,6 +194,35 @@ class TestAdmissionController:
         # become resident: admitting past the budget just defers the OOM).
         assert ac.total_cells == 100
 
+    def test_degraded_capacity_scales_the_pod_budget(self):
+        """ISSUE 7: a capacity factor below 1.0 (the healthy share of the
+        pod's devices, synced from the mesh blacklist by the plane)
+        shrinks the effective pod cell budget — admission sheds against
+        what the surviving silicon can hold, and the rejection names the
+        degradation.  An unbounded pod (max_total_cells=0) keeps that
+        choice while degraded."""
+        cfg = ServeConfig(
+            max_sessions=4, max_queued=4, max_cells_per_session=100,
+            max_total_cells=200, retry_after_seconds=1.0,
+        )
+        ac = AdmissionController(cfg)
+        assert ac.effective_total_cells == 200
+        ac.capacity_factor = 0.5  # half the devices condemned
+        assert ac.effective_total_cells == 100
+        assert ac.admit("a", 100) == "run"
+        with pytest.raises(AdmissionRejected, match="degraded: 50%"):
+            ac.admit("b", 100)  # fits the full budget, not the degraded one
+        ac.capacity_factor = 1.0
+        assert ac.admit("b", 100) == "run"
+        unbounded = AdmissionController(
+            ServeConfig(
+                max_sessions=4, max_queued=4, max_cells_per_session=100,
+                max_total_cells=0,
+            )
+        )
+        unbounded.capacity_factor = 0.25
+        assert unbounded.effective_total_cells == 0  # 0 stays unbounded
+
     def test_duplicate_tenant_is_shed(self):
         ac = AdmissionController(self.CFG)
         ac.admit("a", 10)
@@ -446,6 +475,46 @@ class TestPlaneBasics:
         after = plane.health()
         assert not after["ready"] and after["draining"]
 
+    def test_degraded_pod_reports_and_admits_reduced_capacity(self, tmp_path):
+        """ISSUE 7 serving-plane leg: once a device lands on the
+        process-wide blacklist (a resident's elastic supervisor condemned
+        it), ``health()`` reports ``degraded`` with the lost-device count
+        and the scaled cell budget, and admission sheds against the
+        reduced capacity.  A degraded pod stays ready — it just holds
+        less."""
+        import jax
+
+        from distributed_gol_tpu.parallel import mesh as mesh_lib
+
+        n = len(jax.devices())
+        cells = W * H  # one tenant board
+        try:
+            with ServePlane(
+                ServeConfig(
+                    max_sessions=4, max_queued=0,
+                    max_cells_per_session=cells,
+                    max_total_cells=2 * cells,
+                )
+            ) as plane:
+                healthy = plane.health()
+                assert not healthy["degraded"] and healthy["devices_lost"] == 0
+                assert healthy["capacity"]["effective_total_cells"] == 2 * cells
+
+                # Half the rig dies: the budget falls below two boards.
+                mesh_lib.condemn(range(n // 2, n))
+                degraded = plane.health()
+                assert degraded["degraded"] is True
+                assert degraded["devices_lost"] == n - n // 2
+                assert degraded["capacity"]["effective_total_cells"] == cells
+                assert degraded["ready"]  # degraded, not dead
+
+                h = plane.submit("alice", tenant_params(tmp_path / "a", 1))
+                with pytest.raises(AdmissionRejected, match="degraded"):
+                    plane.submit("bob", tenant_params(tmp_path / "b", 2))
+                assert h.wait(timeout=120)
+        finally:
+            mesh_lib.clear_blacklist()
+
 
 # -- per-tenant obs labels (satellite) -----------------------------------------
 
@@ -537,11 +606,24 @@ pytestmark_chaos = pytest.mark.chaos
 HEALTHY_SEEDS = (101, 202)
 
 
-def submit_healthy(plane, tmp_path):
-    return [
-        plane.submit(f"good{i}", tenant_params(tmp_path / f"good{i}", seed))
-        for i, seed in enumerate(HEALTHY_SEEDS)
-    ]
+def submit_healthy(plane, tmp_path, pace_seconds=0.0):
+    """Submit the two healthy tenants.  ``pace_seconds > 0`` gives each a
+    latency-faulted backend (bit-identical; ~6x that long resident) so a
+    test asserting on slot occupancy cannot race a healthy tenant
+    completing on a warm-jit rig."""
+    handles = []
+    for i, seed in enumerate(HEALTHY_SEEDS):
+        p = tenant_params(tmp_path / f"good{i}", seed)
+        backend = None
+        if pace_seconds:
+            backend = FaultInjectionBackend(
+                Backend(p),
+                FaultPlan(
+                    [Fault(k, "latency", seconds=pace_seconds) for k in range(6)]
+                ),
+            )
+        handles.append(plane.submit(f"good{i}", p, backend=backend))
+    return handles
 
 
 def assert_pod_survives(plane, tmp_path, solo_oracle):
@@ -662,7 +744,12 @@ class TestTenantIsolation:
         with ServePlane(
             ServeConfig(max_sessions=3, max_queued=2)
         ) as plane:
-            healthy = submit_healthy(plane, tmp_path)  # 2 of 3 slots
+            # 2 of 3 slots, latency-paced (bit-identical; ~2 s residency)
+            # so the deterministic ladder below cannot race a healthy
+            # tenant COMPLETING — and freeing its slot — before the
+            # flood's first submission lands (warm-jit rigs are fast
+            # enough for that, and this suite's order is not a contract).
+            healthy = submit_healthy(plane, tmp_path, pace_seconds=0.3)
             flood = FloodTenant(
                 plane,
                 lambda t: tenant_params(tmp_path / t, 7),
@@ -884,6 +971,17 @@ class TestFlightReportRendering:
             {"kind": "preempt", "t": 5.0, "turn": 9},
             {"kind": "ckpt_skipped_unverified", "t": 6.0, "turn": 9},
             {"kind": "preempt_save_skipped", "t": 7.0, "turn": 9},
+            # The ISSUE 7 elastic-recovery kinds.
+            {"kind": "device_blacklist", "t": 7.2, "attempt": 3, "probed": 8,
+             "condemned": [7], "blacklist": [7]},
+            {"kind": "mesh_shrink", "t": 7.4, "attempt": 3,
+             "from_shape": [8, 1], "to_shape": [2, 2], "healthy": 7},
+            {"kind": "restart", "t": 7.5, "attempt": 3, "cause": "RuntimeError",
+             "from_turn": 20, "resume_turn": 15, "tier": "elastic",
+             "mesh_shape": [2, 2], "excluded_devices": [7]},
+            {"kind": "elastic_exhausted", "t": 7.6, "attempt": 4,
+             "error": "all condemned"},
+            {"kind": "peer_lost", "t": 7.8, "ranks": [1], "timeout_s": 1.5},
             {"kind": "some_future_kind", "t": 8.0, "detail": 42},
             {"kind": "abort", "t": 9.0, "cause": "RuntimeError"},
         ]
@@ -900,6 +998,13 @@ class TestFlightReportRendering:
         assert "graceful stop latched at turn 9" in text
         assert "checkpoint WITHHELD at turn 9" in text
         assert "emergency save WITHHELD at turn 9" in text
+        assert "elastic probe (attempt 3): 8 device(s) probed" in text
+        assert "condemned device(s) [7]; blacklist now [7]" in text
+        assert "mesh SHRUNK 8x1 -> 2x2 on 7 healthy device(s)" in text
+        assert "(elastic tier on mesh 2x2, devices [7] excluded)" in text
+        assert "elastic rung EXHAUSTED (attempt 4)" in text
+        assert "peer rank(s) [1] LOST" in text
+        assert "1.5s heartbeat bound" in text
         assert "detail=42" in text  # unknown kind: generic row, not dropped
 
 

@@ -153,29 +153,53 @@ def main():
 
 
 def print_faults_table(rec: dict) -> None:
-    """Render a ``bench.bench_faults`` record (ISSUE 2 + 5) as markdown:
-    the clean/armed controller-path rates plus the supervisor arm's MTTR
-    and restart columns."""
+    """Render a ``bench.bench_faults`` record (ISSUE 2 + 5 + 7) as
+    markdown: the clean/armed controller-path rates, the supervisor
+    arm's MTTR and restart columns, and the device-loss arm's MTTR plus
+    the mesh it shrank onto."""
     sup = rec["supervisor"]
     clean = rec["clean"]
     print()
     print(
         "| Fault arm | gens/s (median) | spread | reps | "
-        "MTTR (median s) | restarts | rollback turns |"
+        "MTTR (median s) | restarts | rollback turns | mesh |"
     )
-    print("|---|---|---|---|---|---|---|")
+    print("|---|---|---|---|---|---|---|---|")
     print(
         f"| clean | {clean['median']:,.0f} | {clean['spread']:.1%} | "
-        f"{clean['reps']} | n/a | n/a | n/a |"
+        f"{clean['reps']} | n/a | n/a | n/a | n/a |"
     )
     print(
         f"| armed | {rec['median']:,.0f} | {rec['spread']:.1%} | "
-        f"{rec['reps']} | n/a | n/a | n/a |"
+        f"{rec['reps']} | n/a | n/a | n/a | n/a |"
     )
     print(
         f"| supervisor | n/a | {sup['spread']:.1%} | {sup['reps']} | "
-        f"{sup['median']:.4f} | {sup['restarts']} | {sup['rollback_turns']} |"
+        f"{sup['median']:.4f} | {sup['restarts']} | {sup['rollback_turns']} "
+        "| same |"
     )
+    dev = rec.get("device_loss")
+    if dev and not dev.get("skipped"):
+        mesh = _mesh_cell(dev)
+        print(
+            f"| device loss | n/a | {dev['spread']:.1%} | {dev['reps']} | "
+            f"{dev['median']:.4f} | {dev['restarts']} | n/a | {mesh} |"
+        )
+    elif dev:
+        print(f"| device loss | skipped: {dev['skipped']} | | | | | | |")
+
+
+def _mesh_cell(dev: dict) -> str:
+    """`4x2 -> 2x2 (-dev 7)`: the topology shrink of a device-loss row."""
+    fy, fx = dev["mesh_from"]
+    cell = f"{fy}x{fx}"
+    if dev.get("mesh_to"):
+        ty, tx = dev["mesh_to"]
+        cell += f" -> {ty}x{tx}"
+    excluded = dev.get("excluded_devices")
+    if excluded:
+        cell += f" (-dev {','.join(str(d) for d in excluded)})"
+    return cell
 
 
 def print_serve_table(rec: dict) -> None:

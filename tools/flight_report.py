@@ -34,10 +34,62 @@ def _fmt_t(t: float, t0: float) -> str:
 # generic row, so the report never drops information.
 
 def _d_restart(r):
+    mesh = ""
+    if r.get("tier") == "elastic" and r.get("mesh_shape"):
+        ny, nx = r["mesh_shape"]
+        excluded = r.get("excluded_devices") or []
+        mesh = f" on mesh {ny}x{nx}"
+        if excluded:
+            mesh += f", devices {excluded} excluded"
     return (
         f"supervisor restart #{r.get('attempt', '?')} after "
         f"{r.get('cause', '?')}: rolled back turn {r.get('from_turn', '?')}"
-        f" -> {r.get('resume_turn', '?')} ({r.get('tier', '?')} tier)"
+        f" -> {r.get('resume_turn', '?')} ({r.get('tier', '?')} tier{mesh})"
+    )
+
+
+def _d_device_blacklist(r):
+    condemned = r.get("condemned") or []
+    verdict = (
+        f"condemned device(s) {condemned}"
+        if condemned
+        else "all probed devices healthy"
+    )
+    return (
+        f"elastic probe (attempt {r.get('attempt', '?')}): "
+        f"{r.get('probed', '?')} device(s) probed, {verdict}; "
+        f"blacklist now {r.get('blacklist', [])}"
+    )
+
+
+def _d_mesh_shrink(r):
+    fy, fx = r.get("from_shape", ("?", "?"))
+    ty, tx = r.get("to_shape", ("?", "?"))
+    return (
+        f"mesh SHRUNK {fy}x{fx} -> {ty}x{tx} on {r.get('healthy', '?')} "
+        f"healthy device(s) (attempt {r.get('attempt', '?')}): checkpoint "
+        "will be resharded onto the smaller mesh"
+    )
+
+
+def _d_elastic_exhausted(r):
+    cause = r.get("cause", "AllDevicesCondemned")
+    why = (
+        "no healthy device to rebuild on"
+        if cause == "AllDevicesCondemned"
+        else f"device probe failed ({cause}: {r.get('error', '?')})"
+    )
+    return (
+        f"elastic rung EXHAUSTED (attempt {r.get('attempt', '?')}): "
+        f"{why} — degrading to sentinel abort"
+    )
+
+
+def _d_peer_lost(r):
+    return (
+        f"peer rank(s) {r.get('ranks', '?')} LOST: silent past the "
+        f"{r.get('timeout_s', '?')}s heartbeat bound — aborting resumable "
+        "from the newest periodic checkpoint"
     )
 
 
@@ -92,6 +144,10 @@ def _d_preempt_save_skipped(r):
 _DESCRIBE = {
     "restart": _d_restart,
     "supervisor_exhausted": _d_supervisor_exhausted,
+    "device_blacklist": _d_device_blacklist,
+    "mesh_shrink": _d_mesh_shrink,
+    "elastic_exhausted": _d_elastic_exhausted,
+    "peer_lost": _d_peer_lost,
     "sdc_check": _d_sdc_check,
     "sdc_mismatch": _d_sdc_mismatch,
     "preempt": _d_preempt,
