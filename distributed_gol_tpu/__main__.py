@@ -296,6 +296,12 @@ def build_serve_parser() -> argparse.ArgumentParser:
     ap.add_argument("--drain-timeout", type=float, default=60.0,
                     help="seconds a SIGTERM drain waits for resident "
                     "sessions to emergency-checkpoint")
+    ap.add_argument("--batched", action="store_true",
+                    help="coalesce resident same-shape/same-rule tenants "
+                    "into shared launch cohorts (ISSUE 8): one batched "
+                    "device launch per superstep advances every cohort "
+                    "member — pair with an explicit --superstep so "
+                    "tenants share a dispatch schedule")
     return ap
 
 
@@ -341,6 +347,7 @@ def serve_main(argv) -> int:
         max_total_cells=args.max_total_cells,
         default_deadline_seconds=args.deadline,
         drain_timeout_seconds=args.drain_timeout,
+        batched=args.batched,
     )
 
     def tenant_params(name: str, w: int, h: int, turns: int) -> Params:

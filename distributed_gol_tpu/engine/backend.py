@@ -773,3 +773,199 @@ class Backend:
 
             self._viewer_fns["cycle_counts"] = fn
         return np.asarray(jax.device_get(fn(board)))
+
+
+class _SharedCounts:
+    """One device fetch for a whole cohort round's count vector: the
+    first member to force its count resolves ALL of them in a single
+    ``device_get`` (idempotent, double-checked under a lock), so a
+    16-member round pays one host sync instead of sixteen.  Slots are
+    ``__int__``-protocol objects — exactly what the controller's
+    ``_force`` (and the fault harness's poisoned/hanging scalars)
+    already speak at the dispatch seam."""
+
+    __slots__ = ("_arrays", "_values", "_lock")
+
+    def __init__(self, arrays):
+        self._arrays = arrays
+        self._values = None
+        import threading
+
+        self._lock = threading.Lock()
+
+    def resolve(self):
+        if self._values is None:
+            with self._lock:
+                if self._values is None:
+                    self._values = [
+                        int(v) for v in jax.device_get(self._arrays)
+                    ]
+                    self._arrays = None  # free the device handles
+        return self._values
+
+
+class _SlotCount:
+    """One board's alive count inside a :class:`_SharedCounts` round."""
+
+    __slots__ = ("_shared", "_i")
+
+    def __init__(self, shared: _SharedCounts, i: int):
+        self._shared = shared
+        self._i = i
+
+    def __int__(self) -> int:
+        return self._shared.resolve()[self._i]
+
+
+class BatchedBackend:
+    """One compiled program family for B same-shape boards (ISSUE 8):
+    the board-stack analog of :class:`Backend` behind the same dispatch
+    seam.  ``run_turns_async(stack, turns)`` advances a ``(B, H, W)``
+    uint8 world stack and returns it with a PER-BOARD alive-count
+    vector; :meth:`run_boards` is the fused list-in/list-out form the
+    serving plane's dispatch coalescer uses — stack, superstep, every
+    count reduction, and the unstack trace into ONE jitted program, so a
+    whole launch cohort costs one device launch however many tenants
+    share it (the per-launch-overhead amortiser BASELINE.md's all-dead
+    floor and BENCH_SERVE_PR6's 0.81x n16 scaling both point at).
+
+    Engine forms, mirroring :class:`Backend`'s ranking per slot:
+    ``pallas-packed`` = the leading-axis Pallas kernels (VMEM-resident
+    batched form for small boards, frontier megakernel for tiled ones —
+    ``ops.pallas_packed.batched_supports``), ``packed`` = the vmapped
+    XLA SWAR engine, ``roll`` = the vmapped stencil.  Every form is
+    bit-identical per slot to B independent runs (test-gated), so the
+    coalescer can regroup cohorts freely without touching results.
+
+    Single-device by design: cohorts exist to amortise per-launch
+    overhead of SMALL boards; big boards shard via the solo Backend."""
+
+    def __init__(self, params: Params):
+        if params.mesh_shape != (1, 1):
+            raise NotImplementedError(
+                "BatchedBackend is single-device: batch small boards, "
+                "shard big ones (mesh_shape must be (1, 1))"
+            )
+        self.params = params
+        self.table = jnp.asarray(params.rule.table)
+        shape = (params.image_height, params.image_width)
+        self.engine_used = self._resolve(params, shape)
+        if self.engine_used == "pallas-packed":
+            from distributed_gol_tpu.ops import pallas_packed
+
+            self._stack_fn = pallas_packed.make_batched_superstep_bytes(
+                params.rule, skip_tile_cap=params.skip_tile_cap or None
+            )
+        elif self.engine_used == "packed":
+            from distributed_gol_tpu.ops import packed
+
+            self._stack_fn = packed.make_batched_superstep(params.rule)
+        else:
+            table = self.table
+
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("turns",))
+            def roll_stack(stack, turns: int):
+                out = jax.vmap(
+                    lambda b: stencil.superstep(b, table, turns)
+                )(stack)
+                return out, jax.vmap(stencil.alive_count)(out)
+
+            self._stack_fn = roll_stack
+        self._fused = None  # the run_boards jit (retraces per arity)
+        self._init_metrics(params)
+
+    @staticmethod
+    def _resolve(params: Params, shape: tuple[int, int]) -> str:
+        """Requested engine -> the batched form that runs.  Same ranking
+        as the solo resolver minus the per-turn-viewer carve-outs (a
+        batched stack is headless by construction); 'pallas' has no
+        batched byte-kernel form and takes the packed tier."""
+        if params.engine == "roll":
+            return "roll"
+        from distributed_gol_tpu.ops import packed
+
+        if packed.supports(shape):
+
+            def kernel_ok():
+                from distributed_gol_tpu.ops import pallas_packed
+
+                return pallas_packed.batched_supports(
+                    (shape[0], shape[1] // 32)
+                )
+
+            if Backend._packed_kernel_upgrade(params, kernel_ok):
+                return "pallas-packed"
+            return "packed"
+        return "roll"
+
+    def _init_metrics(self, params: Params):
+        from distributed_gol_tpu.obs import metrics as obs_metrics
+
+        reg = obs_metrics.registry_for(params.metrics)
+        # Physical-launch truth for the serving bench: one bump per
+        # batched dispatch however many boards rode it (the coalescer's
+        # serve.batched_boards counter carries the cohort sizes).
+        self._m_dispatches = reg.counter(
+            f"backend.batched_dispatches.{self.engine_used}"
+        )
+        reg.info("backend.batched_engine", self.engine_used)
+
+    # -- board placement --------------------------------------------------------
+    def put(self, stack: np.ndarray) -> jax.Array:
+        """(B, H, W) uint8 world stack onto the device."""
+        return jnp.asarray(np.ascontiguousarray(stack, dtype=np.uint8))
+
+    def fetch(self, stack: jax.Array) -> np.ndarray:
+        return np.asarray(jax.device_get(stack))
+
+    # -- compute ----------------------------------------------------------------
+    def run_turns_async(
+        self, stack: jax.Array, turns: int
+    ) -> tuple[jax.Array, jax.Array]:
+        """Issue ``turns`` generations of every board in the stack as ONE
+        dispatch (unresolved, like ``Backend.run_turns_async``); returns
+        (stack, int[B] per-board alive counts)."""
+        self._m_dispatches.inc()
+        return self._stack_fn(stack, turns)
+
+    def run_turns(
+        self, stack: jax.Array, turns: int
+    ) -> tuple[jax.Array, np.ndarray]:
+        new_stack, counts = self.run_turns_async(stack, turns)
+        return new_stack, np.asarray(jax.device_get(counts))
+
+    def run_boards(self, boards, turns: int):
+        """Advance B same-shape boards ``turns`` generations in ONE
+        dispatch; returns (list of boards, list of per-board on-device
+        count scalars) in input order — the coalescer hands slot i back
+        to tenant i, whose controller forces its own count exactly as on
+        a solo backend (PR-2 retry/watchdog and the PR-5 fingerprint
+        legs see per-slot values, never the stack)."""
+        fn = self._fused
+        if fn is None:
+            from functools import partial
+
+            stack_fn = self._stack_fn
+
+            @partial(jax.jit, static_argnames=("turns",))
+            def fn(bs, turns: int):
+                out, counts = stack_fn(jnp.stack(bs), turns)
+                n = len(bs)
+                return (
+                    tuple(out[i] for i in range(n)),
+                    tuple(counts[i] for i in range(n)),
+                )
+
+            self._fused = fn
+        self._m_dispatches.inc()
+        outs, counts = fn(tuple(boards), turns)
+        shared = _SharedCounts(counts)
+        return list(outs), [_SlotCount(shared, i) for i in range(len(counts))]
+
+    def count(self, stack: jax.Array) -> np.ndarray:
+        """Per-board alive counts of a stack, synchronised."""
+        return np.asarray(
+            jax.device_get(jax.vmap(stencil.alive_count)(stack))
+        )

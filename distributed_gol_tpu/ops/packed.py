@@ -255,6 +255,53 @@ def steps_with_counts(a: jax.Array, rule: LifeRule, turns: int):
     return _steps_with_counts(a, rule, turns)
 
 
+# -- batched drivers (ISSUE 8): a leading board axis through the engine -------
+#
+# One dispatch advances B independent boards: the serving plane's cohort
+# lever.  Small boards are launch-overhead-bound (BASELINE.md's all-dead
+# floor pins 0.376 µs/stripe-slot of pure per-launch cost), so N tenants
+# issuing N launches per superstep scale at well under 1x on one device —
+# stacking them puts the overhead under ONE launch.  ``vmap`` is the
+# portable form (pure XLA, every backend); the Pallas megakernel grows an
+# explicit leading grid axis for the fast form (ops/pallas_packed.py).
+# Each slot is bit-identical to an independent run: vmap batches the
+# bitwise adder network per board and never mixes rows across boards
+# (test-gated, tests/test_batched.py).
+
+
+@partial(jax.jit, static_argnames=("rule", "turns"))
+def batched_superstep(stack: jax.Array, rule: LifeRule, turns: int) -> jax.Array:
+    """``turns`` generations of a (B, H, Wp) packed board stack in ONE
+    dispatch — each slot an independent torus."""
+    return jax.vmap(lambda a: superstep(a, rule, turns))(stack)
+
+
+def batched_alive_counts(stack: jax.Array) -> jax.Array:
+    """Per-board alive counts of a (B, H, Wp) packed stack: an int
+    vector of length B, one fused reduction (dtype per the
+    ``_count_dtype`` policy of the per-board cell count)."""
+    dtype = _count_dtype(stack.shape[1] * stack.shape[2] * WORD)
+    return jnp.sum(
+        jax.lax.population_count(stack), axis=(1, 2), dtype=dtype
+    )
+
+
+def make_batched_superstep(rule: LifeRule = CONWAY):
+    """``(stack_u8 (B, H, W), turns) -> (stack_u8, counts int[B])`` —
+    the batched engine-layer drop-in: pack, all generations, unpack, and
+    the per-board count reduction trace into one jitted program, so a
+    whole cohort costs one launch however many boards share it."""
+
+    @partial(jax.jit, static_argnames=("turns",))
+    def run(stack: jax.Array, turns: int):
+        p = jax.vmap(pack)(stack)
+        if turns:
+            p = batched_superstep(p, rule, turns)
+        return jax.vmap(unpack)(p), batched_alive_counts(p)
+
+    return run
+
+
 # -- byte-board drivers (engine-layer drop-ins) -------------------------------
 #
 # Same signatures as the ``ops/stencil.py`` factories: uint8 {0,255} in and

@@ -52,6 +52,8 @@ from distributed_gol_tpu.utils.compat import CompilerParams
 from distributed_gol_tpu.ops.packed import (
     _maj,
     apply_rule_planes,
+    batched_alive_counts,
+    batched_superstep as _xla_batched_superstep,
     pack,
     pack_vertical,
     unpack,
@@ -195,6 +197,7 @@ def _compiler_params(
     wp: int,
     skip_stable: bool = False,
     sequential_grid: bool = False,
+    grid_rank: int = 2,
 ) -> CompilerParams:
     """Raise Mosaic's scoped-VMEM ceiling (default 16 MB) to what the tile
     actually needs: the budgeted working set plus slack for DMA double
@@ -212,9 +215,9 @@ def _compiler_params(
     return CompilerParams(
         vmem_limit_bytes=min(ceiling, int(ws * factor) + (8 << 20)),
         # The megakernel's launch axis MUST run in issue order (SMEM state
-        # carries across grid steps); "arbitrary" semantics pin both dims
-        # sequential.
-        dimension_semantics=("arbitrary", "arbitrary")
+        # carries across grid steps); "arbitrary" semantics pin every dim
+        # sequential (the batched form adds a leading board axis, rank 3).
+        dimension_semantics=("arbitrary",) * grid_rank
         if sequential_grid
         else None,
     )
@@ -360,6 +363,38 @@ def _build_vmem_resident(
     return pl.pallas_call(
         partial(_vmem_kernel, turns=turns, rule=rule),
         out_shape=jax.ShapeDtypeStruct(vshape, jnp.uint32),
+        interpret=interpret,
+    )
+
+
+def _vmem_kernel_batched(x_ref, o_ref, *, turns, rule):
+    # Block shape (1, hw, w): one board per grid step, whole-board rotates
+    # stay exact per slot (each board is its own torus).
+    o_ref[0] = jax.lax.fori_loop(
+        0, turns, lambda _, a: _gen_vertical(a, rule), x_ref[0]
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _build_vmem_resident_batched(
+    nboards: int,
+    vshape: tuple[int, int],
+    rule: LifeRule,
+    turns: int,
+    interpret: bool,
+):
+    """The leading-axis batched form of :func:`_build_vmem_resident`
+    (ISSUE 8): grid ``(nboards,)`` over a ``(nboards, H // 32, W)``
+    vertically-packed stack — B whole supersteps of B independent small
+    boards in ONE pallas_call, the serving plane's per-launch-overhead
+    amortiser at exactly the board sizes it admits (512²…3072²)."""
+    hw, w = vshape
+    return pl.pallas_call(
+        partial(_vmem_kernel_batched, turns=turns, rule=rule),
+        grid=(nboards,),
+        in_specs=[pl.BlockSpec((1, hw, w), lambda b: (b, 0, 0))],
+        out_specs=pl.BlockSpec((1, hw, w), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nboards, hw, w), jnp.uint32),
         interpret=interpret,
     )
 
@@ -1250,12 +1285,28 @@ def _kernel_frontier_mega(
     rr8, rn8, rc128, rn128,
     acc, sems,
     *, tile_h, pad, grid, nlaunch, turns, rule, sub_rows, col_window,
+    nboards=1,
 ):
     """The WHOLE adaptive dispatch as one kernel: grid (nlaunch, grid)
     executes launches in row-major order (dimension_semantics
     "arbitrary" — sequential), so SMEM scratch carries the per-stripe
     interval/skip state across launches and the two HBM board refs
     ping-pong by launch parity.
+
+    Batched form (ISSUE 8): ``nboards > 1`` grows an explicit LEADING
+    grid axis — grid (nboards, nlaunch, grid) over boards stacked along
+    the row axis ((B·H, wp) refs), so B independent tori advance in ONE
+    pallas_call.  Board b's rows are [b·H, (b+1)·H); every HBM offset
+    uses the board-global stripe index ``gi = b·grid + i`` (the same
+    multiplication form as solo, so Mosaic's 8-alignment proofs carry),
+    wrap stays board-local (left/right reduce mod ``grid`` within the
+    board), and the tracked intervals live in the board-global row
+    frame.  The (2, grid) SMEM state is REUSED serially across boards —
+    sound because each board's launch 0 forces the full union exactly
+    like a solo dispatch's (stale cross-board state is never consumed
+    at l == 0; see the launch-0 notes below) — and ``sk_ref`` becomes a
+    per-board vector.  ``nboards == 1`` folds ``b = 0`` away at trace
+    time: the solo lowering is unchanged.
 
     Buffer protocol (round 5, rectangle writes): launch l reads the
     board written at l−1 (``oa`` for even l, holding S_l's input) and
@@ -1295,15 +1346,27 @@ def _kernel_frontier_mega(
     within one launch.  (The HBM board refs can't be indexed
     dynamically, hence the pl.when parity blocks around every DMA.)"""
     del xa, xb  # same memory as oa/ob (aliased); contents ARE the boards
-    l = pl.program_id(0)
-    i = pl.program_id(1)
+    if nboards == 1:
+        b = 0  # Python int: the board-global arithmetic below folds away
+        l = pl.program_id(0)
+        i = pl.program_id(1)
+    else:
+        b = pl.program_id(0)
+        l = pl.program_id(1)
+        i = pl.program_id(2)
     left = jax.lax.rem(i + grid - 1, grid)
     right = jax.lax.rem(i + 1, grid)
+    # Board-global stripe indices: all HBM offsets and the interval row
+    # frame use these; SMEM state stays indexed by the board-LOCAL i
+    # (one board in flight at a time — see the batched-form docstring).
+    gi = b * grid + i
+    g_left = b * grid + left
+    g_right = b * grid + right
     t6 = turns + _SKIP_PERIOD
-    w_lo = i * tile_h - pad
-    w_hi = (i + 1) * tile_h + pad - 1
-    c_lo = i * tile_h
-    c_hi = (i + 1) * tile_h - 1
+    w_lo = gi * tile_h - pad
+    w_hi = (gi + 1) * tile_h + pad - 1
+    c_lo = gi * tile_h
+    c_hi = (gi + 1) * tile_h - 1
     wp = tile.shape[1]
     wr = jax.lax.rem(l, 2)
     rd = 1 - wr
@@ -1386,23 +1449,25 @@ def _kernel_frontier_mega(
                 copy_rect(ob, oa, p_r8, p_n8, p_c128, p_n128)
 
     win_lo, m_lo, m_hi, windowed_ok = _frontier_placement(
-        u_lo, u_hi, i, tile_h, pad, turns, sub_rows
+        u_lo, u_hi, gi, tile_h, pad, turns, sub_rows
     )
     # Window top in board rows.  The natural form w_lo + win_lo contains
-    # the `i*tile_h - pad` subtraction whose 8-divisibility Mosaic cannot
+    # the `gi*tile_h - pad` subtraction whose 8-divisibility Mosaic cannot
     # prove (the recorded round-4 rule — hardware-only failure); keep the
     # arithmetic in 8-row CHUNK units and multiply once, which carries
     # the proof through every slice offset derived from it.
-    g8 = i * (tile_h // 8) - pad // 8 + win_lo // 8
+    g8 = gi * (tile_h // 8) - pad // 8 + win_lo // 8
     g_lo = g8 * 8
     if col_window is not None:
         win_c, c_ok, cw = _col_placement(u_clo, u_chi, turns, col_window, wp)
+        # Bounds are per BOARD: the window must not cross board b's own
+        # torus seam (rows b·H .. (b+1)·H of the stack).
         rect_ok = (
             hit
             & windowed_ok
             & c_ok
-            & (g_lo >= 0)
-            & (g_lo + sub_rows <= grid * tile_h)
+            & (g_lo >= b * grid * tile_h)
+            & (g_lo + sub_rows <= (b + 1) * grid * tile_h)
         )
     else:
         rect_ok = jnp.bool_(False)
@@ -1497,11 +1562,11 @@ def _kernel_frontier_mega(
     def _():
         @pl.when(even)
         def _():
-            _dma_window_in(oa, tile, i, left, right, tile_h, pad, sems)
+            _dma_window_in(oa, tile, gi, g_left, g_right, tile_h, pad, sems)
 
         @pl.when(jnp.logical_not(even))
         def _():
-            _dma_window_in(ob, tile, i, left, right, tile_h, pad, sems)
+            _dma_window_in(ob, tile, gi, g_left, g_right, tile_h, pad, sems)
 
         # Classic whole-window path: row-window / full tiers only (the
         # column tier lives in the rectangle route; a wrap-straddling
@@ -1509,7 +1574,7 @@ def _kernel_frontier_mega(
         route, lo0, hi0, lo1, hi1, clo, chi = _frontier_body(
             tile, aux, merge, colwin, sems,
             u_lo, u_hi, u_clo, u_chi,
-            i, tile_h, pad, turns, rule, sub_rows, None,
+            gi, tile_h, pad, turns, rule, sub_rows, None,
         )
         # Whole centre written ⇒ the change-rect is the whole stripe
         # (⊇ any C_{l−1}, so the union obligation holds for free).
@@ -1520,15 +1585,17 @@ def _kernel_frontier_mega(
 
         @pl.when(even)
         def _():
-            _dma_route_out(route, tile, merge, aux, ob, i, tile_h, pad, sems.at[0])
+            _dma_route_out(route, tile, merge, aux, ob, gi, tile_h, pad, sems.at[0])
 
         @pl.when(jnp.logical_not(even))
         def _():
-            _dma_route_out(route, tile, merge, aux, oa, i, tile_h, pad, sems.at[0])
+            _dma_route_out(route, tile, merge, aux, oa, gi, tile_h, pad, sems.at[0])
 
     @pl.when((l == nlaunch - 1) & (i == grid - 1))
     def _():
-        sk_ref[0] = acc[0]
+        # Per-board skip telemetry: board b's own accumulator, latched at
+        # its last grid step (acc resets at each board's launch 0).
+        sk_ref[b] = acc[0]
 
 
 # Canonical megakernel launch counts.  A dispatch's launch total is
@@ -1567,6 +1634,7 @@ def _build_dispatch_frontier(
     nlaunch: int,
     interpret: bool,
     tile_cap: int | None,
+    nboards: int = 1,
 ):
     """The frontier megakernel as ``(board, scratch_board) ->
     (board_a, board_b, skipped)`` — ``nlaunch`` launches of ``turns``
@@ -1576,6 +1644,12 @@ def _build_dispatch_frontier(
     S_{nlaunch−1}.  ``skipped`` sums the per-launch stability flags —
     the same telemetry series the per-launch form accumulated with
     ``jnp.sum`` per launch.
+
+    ``nboards > 1`` is the BATCHED form (ISSUE 8): the leading grid axis
+    runs ``nboards`` independent tori stacked along the row axis — board
+    refs are ``(nboards·H, wp)``, ``skipped`` a per-board vector — so N
+    small tenant boards amortise ONE launch (``shape`` stays the
+    per-board packed shape).
 
     Cache discipline: callers pass only ``_NLAUNCH_CANON`` values for
     ``nlaunch`` (via ``_nlaunch_chunks``), so the bounded cache holds the
@@ -1599,11 +1673,13 @@ def _build_dispatch_frontier(
         rule=rule,
         sub_rows=sub_rows,
         col_window=col_window,
+        nboards=nboards,
     )
+    grid_dims = (nlaunch, grid) if nboards == 1 else (nboards, nlaunch, grid)
     smem_i32 = lambda shp: pltpu.SMEM(shp, jnp.int32)  # noqa: E731
     return pl.pallas_call(
         kernel,
-        grid=(nlaunch, grid),
+        grid=grid_dims,
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
@@ -1614,9 +1690,9 @@ def _build_dispatch_frontier(
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((h, wp), jnp.uint32),
-            jax.ShapeDtypeStruct((h, wp), jnp.uint32),
-            jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((nboards * h, wp), jnp.uint32),
+            jax.ShapeDtypeStruct((nboards * h, wp), jnp.uint32),
+            jax.ShapeDtypeStruct((nboards,), jnp.int32),
         ],
         input_output_aliases={0: 0, 1: 1},
         scratch_shapes=[
@@ -1636,7 +1712,8 @@ def _build_dispatch_frontier(
             pltpu.SemaphoreType.DMA((3,)),
         ],
         compiler_params=_compiler_params(
-            tile_h, pad, wp, True, sequential_grid=True
+            tile_h, pad, wp, True,
+            sequential_grid=True, grid_rank=len(grid_dims),
         ),
         interpret=interpret,
     )
@@ -1985,6 +2062,98 @@ def _run_tiled(
     if with_stats:
         return board, skipped
     return board
+
+
+# -- batched stack drivers (ISSUE 8) -------------------------------------------
+
+
+def batched_supports(shape: tuple[int, int]) -> bool:
+    """Whether the leading-axis Pallas fast form exists for per-board
+    packed ``shape``: the VMEM-resident batched kernel (small boards —
+    the serving plane's bread and butter) or the batched frontier
+    megakernel (tiled boards hosting a frontier plan).  Shapes outside
+    both run the portable vmap form (``ops.packed.batched_superstep``),
+    which the engine layer selects instead."""
+    if shape[1] <= 0:
+        return False
+    if _vmem_resident_shape(*shape) is not None:
+        return True
+    if not _tiled_supports(shape):
+        return False
+    cap = default_skip_cap(shape[0])
+    t, adaptive = adaptive_launch_depth(shape, 10**6, cap)
+    return adaptive and _frontier_plan(shape, t, cap) is not None
+
+
+def _run_tiled_batched(stack, rule: LifeRule, turns: int, ip: bool, cap: int):
+    """(B, H, wp) packed stack through the leading-axis frontier
+    megakernel: canonical chunks run batched (boards stacked along the
+    row axis, one pallas_call per chunk); the sub-chunk tail and the
+    remainder ride the vmapped XLA packed engine — bit-identical, a
+    bounded share of the dispatch (< min(_NLAUNCH_CANON) launches).
+    Returns (stack, per-board skipped vector)."""
+    nb, h, wp = stack.shape
+    shape = (h, wp)
+    t, adaptive = adaptive_launch_depth(shape, turns, cap)
+    full, rem = divmod(turns, t)
+    skipped = jnp.zeros((nb,), jnp.int32)
+    if adaptive and full:
+        chunks, loose = _nlaunch_chunks(full)
+        flat = stack.reshape(nb * h, wp)
+        a = jnp.zeros_like(flat)
+        for c in chunks:
+            call = _build_dispatch_frontier(
+                shape, rule, t, c, ip, cap, nboards=nb
+            )
+            na, nbuf, sk = call(flat, a)
+            flat, a = (nbuf, na) if c % 2 else (na, nbuf)
+            skipped = skipped + sk
+        stack = flat.reshape(nb, h, wp)
+        rem += loose * t
+    else:
+        rem = turns
+    if rem:
+        stack = _xla_batched_superstep(stack, rule, rem)
+    return stack, skipped
+
+
+def make_batched_superstep_bytes(
+    rule: LifeRule = CONWAY,
+    interpret: bool | None = None,
+    skip_tile_cap: int | None = None,
+):
+    """``(stack_u8 (B, H, W), turns) -> (stack_u8, counts int[B])`` —
+    the batched engine-layer drop-in (ISSUE 8): B same-shape boards,
+    ONE launch family per dispatch.  Form selection mirrors the solo
+    driver: VMEM-resident boards take the leading-axis vertical kernel,
+    tiled boards with a frontier plan take the batched megakernel
+    (always adaptive — the skip proof is exact, so it can only win),
+    everything else the portable vmapped XLA engine.  Per-slot
+    bit-identity with B independent runs is test-gated across the
+    ``geometry_candidates()`` set (tests/test_batched.py)."""
+    cap = skip_tile_cap
+
+    @partial(jax.jit, static_argnames=("turns",))
+    def run(stack: jax.Array, turns: int):
+        ip = _use_interpret() if interpret is None else interpret
+        nb, h, w = stack.shape
+        pshape = (h, w // 32)
+        vshape = _vmem_resident_shape(*pshape)
+        if turns and vshape is not None:
+            v = jax.vmap(pack_vertical)(stack)
+            v = _build_vmem_resident_batched(nb, vshape, rule, turns, ip)(v)
+            # Popcount is packing-invariant: count on the vertical stack,
+            # no horizontal round-trip for the telemetry.
+            return jax.vmap(unpack_vertical)(v), batched_alive_counts(v)
+        p = jax.vmap(pack)(stack)
+        if turns and _tiled_supports(pshape):
+            rcap = cap if cap is not None else default_skip_cap(h)
+            p, _ = _run_tiled_batched(p, rule, turns, ip, rcap)
+        elif turns:
+            p = _xla_batched_superstep(p, rule, turns)
+        return jax.vmap(unpack)(p), batched_alive_counts(p)
+
+    return run
 
 
 def make_superstep_bytes(

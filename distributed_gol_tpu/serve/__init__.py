@@ -1,19 +1,23 @@
 """Multi-tenant serving plane (ISSUE 6): admission control, per-session
-fault isolation, graceful pod drain, health surface.  See
-``serve/plane.py`` for the architecture and docs/API.md "Serving" for
-the contracts."""
+fault isolation, graceful pod drain, health surface; plus the batched
+dispatch cohorts (ISSUE 8) that amortise one launch across N resident
+tenants.  See ``serve/plane.py`` for the architecture and docs/API.md
+"Serving" / "Batched serving" for the contracts."""
 
 from distributed_gol_tpu.serve.admission import (
     AdmissionController,
     AdmissionRejected,
     ServeConfig,
 )
+from distributed_gol_tpu.serve.batcher import CohortBatcher, cohort_key
 from distributed_gol_tpu.serve.plane import ServePlane, SessionHandle
 
 __all__ = [
     "AdmissionController",
     "AdmissionRejected",
+    "CohortBatcher",
     "ServeConfig",
     "ServePlane",
     "SessionHandle",
+    "cohort_key",
 ]

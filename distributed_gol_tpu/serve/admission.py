@@ -67,6 +67,44 @@ class ServeConfig:
     # bounded-memory (the same contract the queue bound enforces).
     # Resident and queued sessions are never evicted.
     max_retained_handles: int = 256
+    # --- batched dispatch cohorts (ISSUE 8; docs/API.md "Batched
+    # serving") ---
+    # Coalesce resident same-key sessions (``serve.batcher.cohort_key``:
+    # every dispatch-relevant Params field) into shared launch cohorts:
+    # each superstep, one BatchedBackend launch advances every cohort
+    # member's board — the per-launch-overhead amortiser that turns n16
+    # aggregate scaling from 0.81x (BENCH_SERVE_PR6) into fan-out.
+    # Off by default: solo launches are the PR-6 behaviour, byte-for-byte.
+    batched: bool = False
+    # How long a cohort round waits for the rest of its members before
+    # firing with whoever showed up.  Bounds the damage any slow/faulted
+    # member can do to its cohort-mates (per round); in steady state
+    # members arrive together and no round ever waits this long — the
+    # window only binds while a member is MISSING, so it should sit
+    # ABOVE the rig's worst thread-scheduling delay: a grace below it
+    # reads descheduled-but-healthy members as stragglers, fires
+    # partial rounds, and can cascade into mass eviction under CPU
+    # starvation (measured on a contended 2-core rig at 0.25 s: half
+    # the cohort evicted to solo launches, launches/superstep 16 -> 8
+    # instead of -> 1).
+    cohort_grace_seconds: float = 1.0
+    # OPTIONAL join-quiescence window: > 0 makes a round also fire once
+    # no new member has joined for this long (each join resets the
+    # clock; grace stays the hard cap) — an early-fire lever for pods
+    # whose members arrive in one tight burst and where waiting the
+    # full grace window for a dead slot costs real latency.  0
+    # (default) = off: rounds fire on full membership or the grace cap
+    # only.  Keep it comfortably above the cohort's inter-arrival
+    # spread — a window below it shatters rounds into near-solo
+    # launches and costs the very amortisation batching exists for
+    # (measured: 30 ms on a 2-core contended rig turned 1.0
+    # launches/superstep into 13.2).
+    cohort_quiesce_seconds: float = 0.0
+    # Consecutive missed rounds before a member is evicted from its
+    # cohort back to solo launches (the straggler/faulted-slot ladder).
+    # >= 2 so a one-off stall (GC pause, first checkpoint fetch) does
+    # not cost a healthy tenant its cohort.
+    cohort_evict_misses: int = 2
 
     def __post_init__(self):
         if self.max_sessions < 1:
@@ -88,6 +126,15 @@ class ServeConfig:
                 "max_retained_handles must be >= 0 (0 = drop terminal "
                 "handles immediately)"
             )
+        if self.cohort_grace_seconds <= 0:
+            raise ValueError("cohort_grace_seconds must be positive")
+        if not 0 <= self.cohort_quiesce_seconds <= self.cohort_grace_seconds:
+            raise ValueError(
+                "cohort_quiesce_seconds must be in [0, cohort_grace_seconds] "
+                "(0 = off)"
+            )
+        if self.cohort_evict_misses < 1:
+            raise ValueError("cohort_evict_misses must be >= 1")
 
 
 class AdmissionRejected(RuntimeError):

@@ -91,8 +91,9 @@ class SessionHandle:
     ``events`` is the session's own stream (the per-tenant analog of the
     reference's one events channel).  When the submitter brought a queue
     it owns draining it (the plane only TEES the producer side through
-    the digest — see :class:`_DigestTee`); otherwise the plane drains
-    the stream itself.  Either way the **digest** fields (``final``,
+    the digest — see :class:`_DigestTee`); otherwise the stream reduces
+    inline to the digest at the producer and retains nothing
+    (:class:`_DigestSink`).  Either way the **digest** fields (``final``,
     ``report``, ``errors``, ``checkpoint_turns``, ``last_turn``) are
     populated — they are what the drain receipt and terminal
     classification read — and bounded, so a session's events can never
@@ -195,6 +196,35 @@ class SessionHandle:
         )
 
 
+class _DigestSink(EventQueue):
+    """The PLANE-owned event stream (ISSUE 8 serving-overhead fix):
+    events digest inline at the producer and are retained nowhere —
+    the bounded digest was always the only consumer of an unwatched
+    stream, and the PR-6 drain thread that consumed it cost one extra
+    thread plus a wakeup per event per session (measurable GIL churn
+    at n16 with batched cohorts).  Subclasses :class:`EventQueue` so
+    the controller keeps its one-entry ``put_turns`` batching; a
+    caller-supplied queue still gets the :class:`_DigestTee` treatment
+    (every event forwarded)."""
+
+    def __init__(self, handle: SessionHandle):
+        super().__init__()
+        self._handle = handle
+
+    def put(self, item, block: bool = True, timeout: float | None = None):
+        if item is None:
+            # The terminal sentinel IS retained: a consumer waiting on a
+            # plane-owned stream (the shed-queue contract promises them
+            # a terminated stream) still observes the end.
+            super().put(item, block, timeout)
+        else:
+            self._handle._digest(item)
+
+    def put_turns(self, first: int, last: int) -> None:
+        if last >= first:
+            self._handle.last_turn = last
+
+
 class _DigestTee(EventQueue):
     """Producer-side wrapper around a CALLER-owned event queue: digests
     every event into the handle, then forwards it to the caller's queue
@@ -249,6 +279,14 @@ class ServePlane:
         self._lock = threading.Lock()
         self._state = threading.Condition(self._lock)
         self._admission = AdmissionController(self.config)
+        # Batched dispatch cohorts (ISSUE 8): the coalescer that groups
+        # resident same-key sessions into shared launches.  None = the
+        # PR-6 solo-launch plane, byte-for-byte.
+        self.batcher = None
+        if self.config.batched:
+            from distributed_gol_tpu.serve.batcher import CohortBatcher
+
+            self.batcher = CohortBatcher(self.config, metrics=metrics)
         self._handles: dict[str, SessionHandle] = {}  # latest per tenant
         # Terminal handles in completion order — the eviction ring that
         # keeps a churning-tenant pod's memory bounded (``_on_done``).
@@ -355,8 +393,24 @@ class ServePlane:
                 # receipt and classification see progress the plane
                 # never consumes (the caller keeps reading their queue).
                 handle.events = _DigestTee(handle, events)
+            else:
+                # Unwatched stream: digest inline, retain nothing — no
+                # per-session drain thread (see _DigestSink).
+                handle.events = _DigestSink(handle)
             handle._backend = backend
             handle._backend_factory = backend_factory
+            if (
+                self.batcher is not None
+                and backend is None
+                and backend_factory is None
+            ):
+                # Batched pods default every session's backend to a
+                # cohort member (solo Backend where the Params can't
+                # cohort); explicit backend/factory submissions — the
+                # chaos seams — keep what they brought.
+                handle._backend_factory = (
+                    lambda p, attempt: self.batcher.member_backend(p)
+                )
             handle.admitted_as = verdict
             self._handles[tenant] = handle
             self._c_admitted.inc()
@@ -390,15 +444,6 @@ class ServePlane:
         a tenant's failure must never propagate into the plane."""
         handle.status = "running"
         handle.t_start = time.perf_counter()
-        drainer = None
-        if handle._owns_events:
-            drainer = threading.Thread(
-                target=self._drain_digest,
-                args=(handle,),
-                name=f"gol-serve-digest-{handle.tenant}",
-                daemon=True,
-            )
-            drainer.start()
         exc: BaseException | None = None
         try:
             gol.run(
@@ -415,21 +460,9 @@ class ServePlane:
             # Terminal-stream guarantee: the engine emits its own
             # sentinel on every path except a failed first build; one
             # extra trailing sentinel is invisible to consumers (they
-            # stop at the first).
+            # stop at the first; the plane-owned _DigestSink drops it).
             handle.events.put(None)
-            if drainer is not None:
-                drainer.join(timeout=60)
         self._classify(handle, exc)
-
-    def _drain_digest(self, handle: SessionHandle) -> None:
-        """The plane-owned consumer: reduce an unwatched session's event
-        stream to the bounded digest as it is produced (memory stays
-        O(1) per session however long the run)."""
-        while True:
-            event = handle.events.get()
-            if event is None:
-                return
-            handle._digest(event)
 
     def _classify(self, handle: SessionHandle, exc: BaseException | None):
         """Map one finished run onto the handle's terminal state.  The
@@ -456,6 +489,10 @@ class ServePlane:
     def _on_done(self, handle: SessionHandle) -> None:
         """Free the slot, promote the longest-waiting admission (unless
         draining, which shed the queue), publish gauges."""
+        if self.batcher is not None:
+            # Cohort membership follows the plane's books: a terminal
+            # session leaves its cohort so rounds stop waiting for it.
+            self.batcher.retire(handle.tenant)
         with self._state:
             self._admission.release(handle.tenant)
             self._c_outcome[handle.status].inc()
@@ -662,6 +699,12 @@ class ServePlane:
             "sessions_parked": counters.get("serve.sessions_parked", 0),
             "sessions_failed": counters.get("serve.sessions_failed", 0),
             "rejected": counters.get("serve.rejected", 0),
+            # Batched-cohort surface (ISSUE 8): physical launch economics
+            # a balancer (or the bench) reads straight off health.
+            "batched": self.batcher is not None,
+            "batched_launches": counters.get("serve.batched_launches", 0),
+            "batched_boards": counters.get("serve.batched_boards", 0),
+            "cohort_evictions": counters.get("serve.cohort_evictions", 0),
             "tenants": tenants,
         }
 

@@ -1,0 +1,319 @@
+"""Batched-board engine (ISSUE 8): one launch for N boards.
+
+Three layers, each pinned bit-identical per slot to B independent runs:
+
+- **Portable form** (``ops/packed.py``): ``vmap`` over the packed SWAR
+  superstep — pure XLA, every backend.  Integer bitwise ops batch
+  exactly, so identity here is structural; the tests make it explicit.
+- **Fast forms** (``ops/pallas_packed.py``): an explicit leading-axis
+  grid dimension in the Pallas kernels — the VMEM-resident vertical
+  kernel for small boards and the frontier MEGAKERNEL for tiled ones
+  (boards stacked along the row axis, per-board toroidal wrap, the
+  (2, grid) SMEM interval state reused serially across boards).  The
+  identity matrix runs in interpret mode across ``B ∈ {1, 2, 7}`` ×
+  ``geometry_candidates()`` × both headline lane counts (wp = 512 and
+  wp = 2048 boards); hardware lowering is gated by
+  ``tools/hw_compile_gate.py``'s batched rows.
+- **Engine seam** (``engine/backend.py``): :class:`BatchedBackend`
+  resolves the batched form per the solo ranking and exposes
+  ``run_turns_async`` over ``(B, H, W)`` stacks plus the fused
+  ``run_boards`` the serving plane's coalescer launches through.
+
+The serving-plane half of the tentpole (cohort rendezvous, eviction,
+chaos) lives in ``tests/test_serve.py``.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_gol_tpu.engine.backend import Backend, BatchedBackend
+from distributed_gol_tpu.engine.params import Params
+from distributed_gol_tpu.models.life import CONWAY, HIGHLIFE
+from distributed_gol_tpu.ops import packed, pallas_packed, stencil
+
+rng = np.random.default_rng(8)
+
+
+def soup_stack(b, h, w, density=0.3):
+    return (rng.random((b, h, w)) < density).astype(np.uint8) * 255
+
+
+def glider(board, y, x):
+    for dy, dx in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+        board[y + dy, x + dx] = 255
+
+
+# -- portable vmap form --------------------------------------------------------
+
+
+class TestBatchedPackedOps:
+    def test_slots_match_independent_runs(self):
+        stack = soup_stack(3, 64, 128)
+        p = jnp.asarray(np.stack([np.asarray(packed.pack(jnp.asarray(b))) for b in stack]))
+        got = packed.batched_superstep(p, CONWAY, 17)
+        for i in range(3):
+            want = packed.superstep(packed.pack(jnp.asarray(stack[i])), CONWAY, 17)
+            np.testing.assert_array_equal(np.asarray(got[i]), np.asarray(want))
+
+    def test_per_board_counts(self):
+        stack = soup_stack(4, 32, 64)
+        p = jnp.stack([packed.pack(jnp.asarray(b)) for b in stack])
+        counts = packed.batched_alive_counts(p)
+        assert counts.shape == (4,)
+        for i in range(4):
+            assert int(counts[i]) == np.count_nonzero(stack[i])
+
+    def test_byte_driver_roundtrip_and_rule(self):
+        # A non-Conway rule through the batched driver: the rule is a
+        # static compile-time parameter per cohort, not global state.
+        stack = soup_stack(2, 32, 64)
+        run = packed.make_batched_superstep(HIGHLIFE)
+        out, counts = run(jnp.asarray(stack), 9)
+        solo = packed.make_superstep(HIGHLIFE)
+        for i in range(2):
+            want = solo(jnp.asarray(stack[i]), 9)
+            np.testing.assert_array_equal(np.asarray(out[i]), np.asarray(want))
+            assert int(counts[i]) == np.count_nonzero(np.asarray(want))
+
+    def test_zero_turns_counts_input(self):
+        stack = soup_stack(2, 32, 64)
+        out, counts = packed.make_batched_superstep(CONWAY)(jnp.asarray(stack), 0)
+        np.testing.assert_array_equal(np.asarray(out), stack)
+        assert [int(c) for c in counts] == [int(np.count_nonzero(b)) for b in stack]
+
+
+# -- leading-axis Pallas fast forms (interpret mode) ---------------------------
+
+
+class TestBatchedVmemResident:
+    """Small boards — the serving plane's admission class — take the
+    batched VMEM-resident vertical kernel: grid (B,), one pallas_call
+    for B whole supersteps."""
+
+    @pytest.mark.parametrize("b", [1, 3])
+    def test_slots_match_solo(self, b):
+        stack = soup_stack(b, 512, 512)
+        assert pallas_packed.is_vmem_resident((512, 16))
+        run = pallas_packed.make_batched_superstep_bytes(CONWAY)
+        out, counts = run(jnp.asarray(stack), 9)
+        solo = pallas_packed.make_superstep_bytes(CONWAY)
+        for i in range(b):
+            want = solo(jnp.asarray(stack[i]), 9)
+            np.testing.assert_array_equal(np.asarray(out[i]), np.asarray(want))
+            assert int(counts[i]) == np.count_nonzero(np.asarray(want))
+
+
+def _identity_board(h, w, slot):
+    """Per-slot content exercising distinct frontier tiers: a mid-board
+    glider (column tier), a quantum-straddling cluster (C=128 fallback),
+    a blinker fence (S-margin fallback), and ash — varied by slot so a
+    cross-slot mixup cannot cancel out."""
+    b = np.zeros((h, w), dtype=np.uint8)
+    if slot % 3 == 0:
+        glider(b, h // 3, min(w - 8, w // 2))
+        b[h - 30 : h - 28, 200:202] = 255  # far ash
+    elif slot % 3 == 1:
+        # Straddles the 128-word (4096-cell) placement quantum when the
+        # board is wide enough; plain mid-board residue otherwise.
+        x = 4090 if w > 8192 else w // 4
+        b[h // 2 : h // 2 + 2, x : x + 12 : 4] = 255
+    else:
+        y = min(h - 48, 2 * h // 3)
+        b[y : y + 40 : 6, 100:103] = 255  # blinker fence (tall-ish cluster)
+    return b
+
+
+def _run_batched_matrix(boards, turns, cap=512):
+    stack = jnp.stack([packed.pack(jnp.asarray(b)) for b in boards])
+    got, _ = pallas_packed._run_tiled_batched(stack, CONWAY, turns, True, cap)
+    for i, b in enumerate(boards):
+        want = packed.superstep(packed.pack(jnp.asarray(b)), CONWAY, turns)
+        np.testing.assert_array_equal(
+            np.asarray(got[i]),
+            np.asarray(want),
+            err_msg=f"slot {i} diverged from its solo run",
+        )
+
+
+def _mega_turns(shape, cap=512):
+    """A turn count whose decomposition holds a canonical megakernel
+    chunk (full = 8 launches ≥ min(_NLAUNCH_CANON)) — sub-chunk counts
+    would route to the vmapped tail and never run the megakernel."""
+    t, adaptive = pallas_packed.adaptive_launch_depth(shape, 960, cap)
+    assert adaptive
+    return 8 * t
+
+
+class TestBatchedMegakernel:
+    """The leading-axis frontier megakernel identity matrix (interpret
+    mode): B ∈ {1, 2, 7} × geometry candidates × the wp = 512 and
+    wp = 2048 lane counts.  Short boards keep interpret affordable; the
+    lane geometry (placement quanta, window widths, per-board seam
+    bounds) is the headline one.  The expensive corners of the matrix
+    are marked slow; tier-1 keeps every candidate at B = 2 plus the
+    B-sweep at a narrow board."""
+
+    H512, W512 = 1024, 16384  # wp = 512 — column tier engages
+    H2048, W2048 = 512, 65536  # wp = 2048 — the 65536² lane count
+    HN, WN = 1024, 4096  # wp = 128 — row tier only, cheap B sweep
+
+    @pytest.mark.parametrize(
+        "geom", pallas_packed.geometry_candidates(), ids=lambda g: g.label
+    )
+    def test_wp512_candidates_b2(self, geom):
+        shape = (self.H512, self.W512 // 32)
+        with pallas_packed.plan_geometry_override(geom):
+            assert pallas_packed._frontier_plan(shape, 18, 512) is not None
+            boards = [
+                _identity_board(self.H512, self.W512, s) for s in range(2)
+            ]
+            _run_batched_matrix(boards, _mega_turns(shape))
+
+    @pytest.mark.parametrize("b", [1, 7])
+    def test_narrow_board_b_sweep(self, b):
+        # B = 1 pins that the batched build IS the solo lowering (the
+        # board-global arithmetic folds away); B = 7 an odd batch with
+        # per-slot content variety and a soup slot.
+        shape = (self.HN, self.WN // 32)
+        boards = [_identity_board(self.HN, self.WN, s) for s in range(b)]
+        if b > 1:
+            boards[-1] = soup_stack(1, self.HN, self.WN)[0]
+        _run_batched_matrix(boards, _mega_turns(shape))
+
+    def test_wp2048_shipped_b2(self):
+        shape = (self.H2048, self.W2048 // 32)
+        boards = [_identity_board(self.H2048, self.W2048, s) for s in range(2)]
+        _run_batched_matrix(boards, _mega_turns(shape))
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize(
+        "geom", pallas_packed.geometry_candidates(), ids=lambda g: g.label
+    )
+    def test_wp512_candidates_b7_slow(self, geom):
+        shape = (self.H512, self.W512 // 32)
+        with pallas_packed.plan_geometry_override(geom):
+            boards = [
+                _identity_board(self.H512, self.W512, s) for s in range(7)
+            ]
+            _run_batched_matrix(boards, _mega_turns(shape))
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize(
+        "geom",
+        [g for g in pallas_packed.geometry_candidates()][1:],
+        ids=lambda g: g.label,
+    )
+    def test_wp2048_candidates_b2_slow(self, geom):
+        shape = (self.H2048, self.W2048 // 32)
+        with pallas_packed.plan_geometry_override(geom):
+            boards = [
+                _identity_board(self.H2048, self.W2048, s) for s in range(2)
+            ]
+            _run_batched_matrix(boards, _mega_turns(shape))
+
+    def test_per_board_skip_telemetry(self):
+        # An all-ash slot skips; an active slot does not — the sk vector
+        # separates them (per-board accumulator reset at each board's
+        # launch 0).
+        shape = (self.HN, self.WN // 32)
+        turns = _mega_turns(shape)
+        ash = np.zeros((self.HN, self.WN), dtype=np.uint8)
+        ash[100:102, 200:202] = 255  # one block: pure still life
+        active = _identity_board(self.HN, self.WN, 0)  # glider
+        stack = jnp.stack(
+            [packed.pack(jnp.asarray(b)) for b in (ash, active)]
+        )
+        out, sk = pallas_packed._run_tiled_batched(
+            stack, CONWAY, turns, True, 512
+        )
+        sk = np.asarray(sk)
+        assert sk.shape == (2,)
+        assert sk[0] > sk[1], f"ash slot should out-skip the glider slot: {sk}"
+
+    def test_batched_supports_gate(self):
+        assert pallas_packed.batched_supports((512, 16))  # vmem-resident
+        assert pallas_packed.batched_supports((self.H512, self.W512 // 32))
+        assert not pallas_packed.batched_supports((64, 3))  # nobody's shape
+        assert not pallas_packed.batched_supports((64, 0))
+
+
+# -- the engine seam -----------------------------------------------------------
+
+
+class TestBatchedBackend:
+    def _solo(self, params, board, turns):
+        be = Backend(params)
+        out, count = be.run_turns(be.put(board), turns)
+        return be.fetch(out), count
+
+    def test_roll_stack_matches_solo(self):
+        p = Params(image_width=16, image_height=16, engine="roll", superstep=4)
+        bb = BatchedBackend(p)
+        assert bb.engine_used == "roll"
+        stack = soup_stack(3, 16, 16, 0.25)
+        out, counts = bb.run_turns(bb.put(stack), 4)
+        for i in range(3):
+            want, wc = self._solo(p, stack[i], 4)
+            np.testing.assert_array_equal(np.asarray(out[i]), want)
+            assert int(counts[i]) == wc
+
+    def test_packed_stack_matches_solo(self):
+        p = Params(image_width=256, image_height=256, superstep=16)
+        bb = BatchedBackend(p)
+        assert bb.engine_used in ("packed", "pallas-packed")
+        stack = soup_stack(2, 256, 256)
+        out, counts = bb.run_turns(bb.put(stack), 16)
+        for i in range(2):
+            want, wc = self._solo(p, stack[i], 16)
+            np.testing.assert_array_equal(np.asarray(out[i]), want)
+            assert int(counts[i]) == wc
+
+    def test_run_boards_fused_form(self):
+        p = Params(image_width=64, image_height=64, superstep=8)
+        bb = BatchedBackend(p)
+        stack = soup_stack(4, 64, 64)
+        outs, counts = bb.run_boards([jnp.asarray(b) for b in stack], 8)
+        assert len(outs) == len(counts) == 4
+        for i in range(4):
+            want, wc = self._solo(p, stack[i], 8)
+            np.testing.assert_array_equal(np.asarray(outs[i]), want)
+            assert int(counts[i]) == wc
+
+    def test_async_seam_counts_are_unresolved_devices_values(self):
+        p = Params(image_width=32, image_height=32, superstep=4)
+        bb = BatchedBackend(p)
+        stack = bb.put(soup_stack(2, 32, 32))
+        out, counts = bb.run_turns_async(stack, 4)
+        # Per-board vector, forceable like any dispatch count.
+        assert int(counts[0]) >= 0 and int(counts[1]) >= 0
+        assert out.shape == stack.shape
+
+    def test_zero_turns(self):
+        p = Params(image_width=32, image_height=32)
+        bb = BatchedBackend(p)
+        stack = soup_stack(2, 32, 32)
+        out, counts = bb.run_turns(bb.put(stack), 0)
+        np.testing.assert_array_equal(np.asarray(out), stack)
+        assert [int(c) for c in counts] == [
+            int(np.count_nonzero(b)) for b in stack
+        ]
+
+    def test_mesh_is_rejected(self):
+        with pytest.raises(NotImplementedError, match="single-device"):
+            BatchedBackend(
+                Params(image_width=64, image_height=64, mesh_shape=(2, 1))
+            )
+
+    def test_batched_dispatch_counter(self):
+        from distributed_gol_tpu.obs import metrics as obs_metrics
+
+        p = Params(image_width=16, image_height=16, engine="roll")
+        bb = BatchedBackend(p)
+        before = obs_metrics.REGISTRY.snapshot()
+        bb.run_turns(bb.put(soup_stack(2, 16, 16)), 2)
+        delta = (
+            obs_metrics.REGISTRY.snapshot().delta(before).to_dict()["counters"]
+        )
+        assert delta.get("backend.batched_dispatches.roll") == 1

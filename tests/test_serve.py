@@ -1042,3 +1042,316 @@ class TestServeCli:
         for bad in ("a", "a:16x32", "a:16x32xfoo", ":16x16x1"):
             with pytest.raises(ValueError, match="NAME:WxHxTURNS"):
                 _parse_tenant_spec(bad)
+
+
+# -- batched dispatch cohorts (ISSUE 8 tentpole) --------------------------------
+#
+# N resident same-key sessions share ONE device launch per superstep
+# (serve/batcher.py).  Contracts pinned here: bit-identity of every
+# cohort-served tenant to its solo oracle, launch economics (one batched
+# launch per superstep, however many tenants), cohort-key separation for
+# any dispatch-relevant Params difference, per-tenant obs labels
+# surviving shared launches, and the chaos rows — a faulted or straggling
+# slot is evicted back to a solo launch while its healthy cohort-mates
+# stay bit-identical and batched.
+
+
+class TestCohortKey:
+    def test_identity_fields_do_not_split(self, tmp_path):
+        from distributed_gol_tpu.serve import cohort_key
+
+        a = tenant_params(tmp_path / "a", 1, tenant="alice")
+        b = tenant_params(tmp_path / "b", 2, tenant="bob")
+        assert cohort_key(a) == cohort_key(b)
+
+    @pytest.mark.parametrize(
+        "override",
+        [
+            {"sdc_check_every_turns": SUPERSTEP},
+            {"rule": "highlife"},
+            {"superstep": SUPERSTEP * 2},
+            {"turns": TURNS * 2},
+            {"engine": "packed"},
+            {"image_width": 32},
+        ],
+        ids=lambda o: next(iter(o)),
+    )
+    def test_dispatch_relevant_fields_split(self, tmp_path, override):
+        from distributed_gol_tpu.models.life import RULES
+        from distributed_gol_tpu.serve import cohort_key
+
+        if "rule" in override:
+            override = {"rule": RULES["highlife"]}
+        a = tenant_params(tmp_path, 1)
+        b = tenant_params(tmp_path, 1, **override)
+        assert cohort_key(a) != cohort_key(b)
+
+
+class TestBatchedCohorts:
+    def _plane(self, n=3, **kw):
+        return ServePlane(ServeConfig(max_sessions=n, batched=True, **kw))
+
+    def test_cohort_completes_bit_identical_one_launch_per_superstep(
+        self, tmp_path, solo_oracle
+    ):
+        """The headline contract: three tenants, six supersteps, six
+        batched launches carrying three boards each — and every tenant's
+        final board is byte-identical to its fault-free solo oracle."""
+        for seed in HEALTHY_SEEDS + (303,):
+            solo_oracle(seed)  # outside the launch-accounting window
+        before = obs_metrics.REGISTRY.snapshot()
+        with self._plane() as plane:
+            handles = [
+                plane.submit(f"t{s}", tenant_params(tmp_path / f"t{s}", s))
+                for s in HEALTHY_SEEDS + (303,)
+            ]
+            assert plane.wait_idle(timeout=120)
+            for h, seed in zip(handles, HEALTHY_SEEDS + (303,)):
+                assert_healthy_matches_oracle(h, solo_oracle, seed)
+            hl = plane.health()
+            assert hl["batched"]
+        counters = (
+            obs_metrics.REGISTRY.snapshot().delta(before).to_dict()["counters"]
+        )
+        supersteps = TURNS // SUPERSTEP
+        # Every one of the 3x6 member dispatches rode a batched launch
+        # (none fell back solo), and the physical launch count is one
+        # per superstep — at most one extra for a split start-up round,
+        # where a member dispatched before the rest had registered.
+        assert counters.get("serve.batched_boards") == 3 * supersteps
+        assert supersteps <= counters.get("serve.batched_launches") <= supersteps + 1
+        assert not counters.get("serve.cohort_evictions")
+        solo_launches = sum(
+            v for k, v in counters.items() if k.startswith("backend.dispatches.")
+        )
+        assert solo_launches == 0
+
+    def test_mismatched_params_do_not_share_a_cohort(self, tmp_path, solo_oracle):
+        """Satellite 3: same shape, different ``sdc_check_every_turns``
+        — the cohort key must split them (a silently shared launch would
+        desync the sentinel's dispatch schedule), and both still
+        complete to their oracles.  The proof is behavioural: every
+        fired round carried exactly ONE board (launches == boards), so
+        the two tenants never shared a launch."""
+        for seed in (101, 202):
+            solo_oracle(seed)
+        before = obs_metrics.REGISTRY.snapshot()
+        with self._plane() as plane:
+            plain = plane.submit(
+                "plain", tenant_params(tmp_path / "plain", 101)
+            )
+            sentinel = plane.submit(
+                "sentinel",
+                tenant_params(
+                    tmp_path / "sentinel", 202,
+                    sdc_check_every_turns=SUPERSTEP,
+                ),
+            )
+            assert plane.wait_idle(timeout=120)
+            assert_healthy_matches_oracle(plain, solo_oracle, 101)
+            assert_healthy_matches_oracle(sentinel, solo_oracle, 202)
+        counters = (
+            obs_metrics.REGISTRY.snapshot().delta(before).to_dict()["counters"]
+        )
+        launches = counters.get("serve.batched_launches", 0)
+        assert launches >= 2 * (TURNS // SUPERSTEP)
+        assert counters.get("serve.batched_boards") == launches
+
+    def test_per_tenant_labels_survive_cohort_launches(
+        self, tmp_path, solo_oracle
+    ):
+        """Satellite 2 pinned test: a cohort run's labelled snapshot
+        equals a solo run's — one batched dispatch still bumps each
+        tenant's own ``controller.dispatches``/``controller.turns``
+        (``DispatchRecorder`` is per-session), so ``health()`` per-tenant
+        counts stay truthful under shared launches."""
+        with self._plane() as plane:
+            handles = [
+                plane.submit(f"t{s}", tenant_params(tmp_path / f"t{s}", s))
+                for s in HEALTHY_SEEDS
+            ]
+            assert plane.wait_idle(timeout=120)
+            hl = plane.health()
+        for h, seed in zip(handles, HEALTHY_SEEDS):
+            assert_healthy_matches_oracle(h, solo_oracle, seed)
+            counters = h.report.snapshot["counters"]
+            t = h.tenant
+            # Identical to the solo-run values TestTenantLabels pins: the
+            # shared launch splits into per-tenant logical dispatches.
+            assert counters[f"controller.turns{{tenant={t}}}"] == TURNS
+            assert (
+                counters[f"controller.dispatches{{tenant={t}}}"]
+                == TURNS // SUPERSTEP
+            )
+            assert hl["tenants"][t]["turns"] == TURNS
+            assert hl["tenants"][t]["dispatches"] == TURNS // SUPERSTEP
+
+    def test_failed_batched_launch_demotes_round_to_solo(
+        self, tmp_path, solo_oracle, monkeypatch
+    ):
+        """A batched launch that FAILS (build/trace error at that arity)
+        demotes its whole round to permanent solo launches: one doomed
+        attempt, never one per superstep — and every session still
+        completes bit-identical on the inherited solo path."""
+        from distributed_gol_tpu.engine.backend import BatchedBackend
+
+        def boom(self, boards, turns):
+            raise RuntimeError("forced batched-launch failure")
+
+        monkeypatch.setattr(BatchedBackend, "run_boards", boom)
+        for seed in HEALTHY_SEEDS:
+            solo_oracle(seed)
+        before = obs_metrics.REGISTRY.snapshot()
+        with self._plane(n=2) as plane:
+            handles = [
+                plane.submit(f"t{s}", tenant_params(tmp_path / f"t{s}", s))
+                for s in HEALTHY_SEEDS
+            ]
+            assert plane.wait_idle(timeout=120)
+            for h, seed in zip(handles, HEALTHY_SEEDS):
+                assert_healthy_matches_oracle(h, solo_oracle, seed)
+        counters = (
+            obs_metrics.REGISTRY.snapshot().delta(before).to_dict()["counters"]
+        )
+        # <= 2 failed attempts (one per start-up round at worst), not one
+        # per superstep; all real work ran as solo dispatches.
+        assert 1 <= counters.get("serve.batched_launch_failures", 0) <= 2
+        assert not counters.get("serve.batched_launches")
+        assert sum(
+            v for k, v in counters.items()
+            if k.startswith("backend.dispatches.")
+        ) == 2 * (TURNS // SUPERSTEP)
+
+    def test_cohort_membership_follows_retirement(self, tmp_path, solo_oracle):
+        """A shorter run leaving the pod leaves its cohort (retire), so
+        later rounds stop waiting for it — the remaining tenants keep
+        batching to completion."""
+        for seed in HEALTHY_SEEDS:
+            solo_oracle(seed)
+        before = obs_metrics.REGISTRY.snapshot()
+        with self._plane() as plane:
+            short = plane.submit(
+                "short",
+                tenant_params(tmp_path / "short", 7, turns=SUPERSTEP),
+            )
+            long_h = [
+                plane.submit(f"t{s}", tenant_params(tmp_path / f"t{s}", s))
+                for s in HEALTHY_SEEDS
+            ]
+            assert plane.wait_idle(timeout=120)
+            assert short.status == "completed"
+            for h, seed in zip(long_h, HEALTHY_SEEDS):
+                assert_healthy_matches_oracle(h, solo_oracle, seed)
+            assert plane.batcher.cohort_of("short") is None
+        counters = (
+            obs_metrics.REGISTRY.snapshot().delta(before).to_dict()["counters"]
+        )
+        # The survivors' rounds after the short tenant left still batch
+        # (2 boards/round), so boards > launches.
+        assert counters["serve.batched_launches"] >= TURNS // SUPERSTEP
+        assert counters["serve.batched_boards"] > counters["serve.batched_launches"]
+
+
+@pytest.mark.chaos
+class TestCohortChaos:
+    def test_burst_faulted_slot_inside_a_cohort(self, tmp_path, solo_oracle):
+        """THE acceptance chaos row: a burst-faulted tenant INSIDE a
+        cohort parks alone (PR-2 retry budget), the two healthy
+        cohort-mates stay bit-identical to their solo oracles and keep
+        batching, and the pod survives."""
+        with ServePlane(
+            ServeConfig(
+                max_sessions=3,
+                batched=True,
+                cohort_grace_seconds=0.1,
+            ),
+            checkpoint_root=tmp_path / "ckpt",
+        ) as plane:
+            healthy = [
+                plane.submit(f"good{i}", tenant_params(tmp_path / f"good{i}", s))
+                for i, s in enumerate(HEALTHY_SEEDS)
+            ]
+            # Tenant stamped HERE (the plane normally stamps it at
+            # submit): member_backend cohorts by tenant identity.
+            sick_params = tenant_params(tmp_path / "sick", 999, tenant="sick")
+            # The fault harness wraps the COHORT MEMBER backend at the
+            # dispatch seam — exactly how it wraps a solo Backend — so
+            # the injected failures strike before the rendezvous and the
+            # sick tenant simply stops showing up for its cohort.
+            sick_member = plane.batcher.member_backend(sick_params)
+            assert sick_member.__class__.__name__ == "_CohortMember"
+            sick_backend = FaultInjectionBackend(
+                sick_member,
+                FaultPlan([Fault(2, "issue"), Fault(3, "issue")]),
+            )
+            sick = plane.submit("sick", sick_params, backend=sick_backend)
+            assert plane.wait_idle(timeout=180)
+            for h, seed in zip(healthy, HEALTHY_SEEDS):
+                assert_healthy_matches_oracle(h, solo_oracle, seed)
+            assert sick.status == "parked" and sick.resumable
+            assert "RuntimeError" in sick.error
+            assert plane.health()["live"]
+            # The pod still admits and completes fresh (batched) work.
+            after = plane.submit(
+                "after", tenant_params(tmp_path / "after", 303)
+            )
+            assert after.wait(timeout=120)
+            assert_healthy_matches_oracle(after, solo_oracle, 303)
+        # Parked-resumable means exactly that, cohort or not.
+        events: queue.Queue = queue.Queue()
+        gol.run(
+            tenant_params(tmp_path / "resumed", 999),
+            events,
+            session=Session(tmp_path / "ckpt" / "sick"),
+        )
+        while events.get(timeout=60) is not None:
+            pass
+        got = tmp_path / "resumed" / f"{W}x{H}x{TURNS}.pgm"
+        assert got.read_bytes() == solo_oracle(999)
+
+    def test_straggler_evicted_to_solo_launches(self, tmp_path, solo_oracle):
+        """The eviction ladder end-to-end: a latency-faulted slot misses
+        its cohort's rounds (grace-bounded), is evicted after the miss
+        budget, finishes SOLO bit-identical to its oracle — and the
+        healthy mates never slow below the grace bound per round."""
+        for seed in HEALTHY_SEEDS + (999,):
+            solo_oracle(seed)
+        before = obs_metrics.REGISTRY.snapshot()
+        with ServePlane(
+            ServeConfig(
+                max_sessions=3,
+                batched=True,
+                cohort_grace_seconds=0.05,
+                cohort_evict_misses=2,
+            )
+        ) as plane:
+            slow_params = tenant_params(tmp_path / "slow", 999, tenant="slow")
+            member = plane.batcher.member_backend(slow_params)
+            assert member.__class__.__name__ == "_CohortMember"
+            slow_backend = FaultInjectionBackend(
+                member,
+                FaultPlan(
+                    [Fault(k, "latency", seconds=0.6) for k in range(2, 5)]
+                ),
+            )
+            healthy = [
+                plane.submit(f"good{i}", tenant_params(tmp_path / f"good{i}", s))
+                for i, s in enumerate(HEALTHY_SEEDS)
+            ]
+            slow = plane.submit("slow", slow_params, backend=slow_backend)
+            assert plane.wait_idle(timeout=180)
+            for h, seed in zip(healthy, HEALTHY_SEEDS):
+                assert_healthy_matches_oracle(h, solo_oracle, seed)
+            # The straggler was evicted back to solo launches — and its
+            # run is still bit-identical (eviction is a performance
+            # decision, never a correctness one).
+            assert_healthy_matches_oracle(slow, solo_oracle, 999)
+            assert member.solo, "straggler should have been evicted"
+        counters = (
+            obs_metrics.REGISTRY.snapshot().delta(before).to_dict()["counters"]
+        )
+        assert counters.get("serve.cohort_evictions", 0) >= 1
+        # Evicted solo launches are visible as ordinary backend dispatches.
+        assert any(
+            k.startswith("backend.dispatches.") for k in counters
+        )

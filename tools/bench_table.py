@@ -54,6 +54,11 @@ def main():
                     help="also run bench.bench_serve (ISSUE 6) and render "
                     "the serving-plane rows: aggregate and per-tenant "
                     "gens/s at tenant counts {1,4,16} capped at N")
+    ap.add_argument("--batched", action="store_true",
+                    help="with --serve: A/B the solo-launch pod against "
+                    "the batched-cohort pod (ISSUE 8) and render the "
+                    "batched-vs-solo columns — launches per superstep, "
+                    "cohort sizes, aggregate scaling factor")
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -103,10 +108,18 @@ def main():
 
         print_faults_table(bench_faults(sizes[0], args.faults))
 
-    if args.serve:
+    if args.serve and args.batched:
+        from bench import bench_serve_batched
+
+        rec = bench_serve_batched(args.serve)
+        _lint_serve(rec)
+        print_serve_ab_table(rec)
+    elif args.serve:
         from bench import bench_serve
 
-        print_serve_table(bench_serve(args.serve))
+        rec = bench_serve(args.serve)
+        _lint_serve(rec)
+        print_serve_table(rec)
 
     if not args.paths:
         return
@@ -202,28 +215,62 @@ def _mesh_cell(dev: dict) -> str:
     return cell
 
 
+def _lint_serve(rec: dict) -> None:
+    """Same artifact discipline as bench.py's own printing path: every
+    metric row carries a well-formed stats block and every embedded
+    snapshot is schema-valid — a malformed record fails the run."""
+    from distributed_gol_tpu.obs import metrics as obs_metrics
+    from distributed_gol_tpu.utils import measure
+
+    measure.require_headline_stats(rec)
+    obs_metrics.require_embedded_metrics(rec)
+
+
 def print_serve_table(rec: dict) -> None:
-    """Render a ``bench.bench_serve`` record (ISSUE 6) as markdown: one
-    row per tenant count — aggregate pod throughput, the per-tenant rate
-    distribution (fairness), and the scaling efficiency vs N=1."""
+    """Render a ``bench.bench_serve`` record (ISSUE 6 + 8) as markdown:
+    one row per tenant count — aggregate pod throughput, the per-tenant
+    rate distribution (fairness), the scaling efficiency vs N=1, and
+    the physical launch economics from the embedded metrics snapshot
+    (launches per superstep; mean cohort size on batched pods)."""
     rows = rec["tenant_counts"]
     base = None
     print()
     print(
         "| Tenants | aggregate gens/s | per-tenant median | spread | "
-        "reps | scaling vs 1 |"
+        "reps | scaling vs 1 | launches/superstep | mean cohort |"
     )
-    print("|---|---|---|---|---|---|")
+    print("|---|---|---|---|---|---|---|---|")
     for key in sorted(rows, key=lambda k: int(k[1:])):
         r = rows[key]
         if base is None:
             base = r["aggregate_gps"]
         scale = f"{r['aggregate_gps'] / base:.2f}x" if base else "n/a"
+        launches = r.get("launches_per_superstep", "n/a")
+        cohort = r.get("mean_cohort_size")
         print(
             f"| {r['tenants']} | {r['aggregate_gps']:,.0f} | "
             f"{r['per_tenant_median_gps']:,.0f} | {r['spread']:.1%} | "
-            f"{r['reps']} | {scale} |"
+            f"{r['reps']} | {scale} | {launches} | "
+            f"{cohort if cohort is not None else 'n/a'} |"
         )
+
+
+def print_serve_ab_table(rec: dict) -> None:
+    """Render a ``bench.bench_serve_batched`` A/B record (ISSUE 8): the
+    solo-launch arm beside the batched-cohort arm, same workload — the
+    batched-vs-solo columns are the tentpole's acceptance numbers
+    (aggregate scaling factor, launches per superstep, cohort sizes)."""
+    for label, arm in (("solo", rec["solo"]), ("batched", rec["batched"])):
+        print(f"\n**serve arm: {label}** "
+              f"(scaling vs n1: {arm['scaling_vs_n1']}x)")
+        print_serve_table(arm)
+    lr = rec["launch_reduction"]
+    print(
+        f"\nA/B headline: scaling {rec['scaling']['solo']}x -> "
+        f"{rec['scaling']['batched']}x; launches/superstep "
+        f"{lr['solo_launches_per_superstep']} -> "
+        f"{lr['batched_launches_per_superstep']}"
+    )
 
 
 def metrics_cells(snap: dict | None) -> tuple[str, str, str]:

@@ -115,6 +115,35 @@ def _configs():
             args = [ext]
         jax.jit(call).lower(*args).compile()
 
+    def batched_mega(nboards, shape, turns):
+        """The leading-axis batched frontier megakernel (ISSUE 8): AOT-
+        compile one canonical chunk at batch ``nboards`` — the lowering
+        class interpret mode cannot gate (board-global ``gi = b·grid+i``
+        offset arithmetic must carry Mosaic's 8-alignment proofs with a
+        traced board index)."""
+        def lower():
+            cap = pp.default_skip_cap(shape[0])
+            call = pp._build_dispatch_frontier(
+                shape, CONWAY, turns, 8, False, cap, nboards=nboards
+            )
+            b = jax.ShapeDtypeStruct(
+                (nboards * shape[0], shape[1]), jnp.uint32
+            )
+            jax.jit(call).lower(b, b).compile()
+        return lower
+
+    def batched_vmem(nboards, size, turns):
+        """The leading-axis batched VMEM-resident kernel at a serving-
+        class board size: grid (B,), blocked 3-D specs."""
+        def lower():
+            vshape = pp._vmem_resident_shape(size, size // 32)
+            call = pp._build_vmem_resident_batched(
+                nboards, vshape, CONWAY, turns, False
+            )
+            v = jax.ShapeDtypeStruct((nboards,) + vshape, jnp.uint32)
+            jax.jit(call).lower(v).compile()
+        return lower
+
     cfgs = []
     for size, wp in ((16384, 512), (65536, 2048)):
         shape = (size, wp)
@@ -141,6 +170,15 @@ def _configs():
                 )
             )
         cfgs.append((f"{size}^2 plain", superstep(shape, False, 128)))
+        # Batched megakernel rows (ISSUE 8): representative B values at
+        # both headline sizes — B=2 everywhere, B=8 at the smaller board
+        # (a 16-tenant pod of 16384²-class boards is not the workload;
+        # the lowering class is what the gate covers).
+        for nb in (2, 8) if size == 16384 else (2,):
+            cfgs.append(
+                (f"{size}^2 batched B={nb} megakernel T={t_f}",
+                 batched_mega(nb, shape, t_f))
+            )
         for ny in (2, 4, 8):
             s = (size // ny, wp)
             scap = pp.default_skip_cap(s[0])
@@ -182,6 +220,9 @@ def _configs():
             )
         # One plain strip form per size covers the non-adaptive sharded path.
         cfgs.append((f"strip {(size // 4, wp)} plain T=16", strip("plain", (size // 4, wp), 16)))
+    # The serving plane's cohort workhorse: a 16-board batch of 512²
+    # VMEM-resident boards in one launch (ISSUE 8).
+    cfgs.append(("batched B=16 512^2 vmem-resident T=50", batched_vmem(16, 512, 50)))
     return cfgs
 
 
