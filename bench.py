@@ -849,6 +849,159 @@ def bench_device_loss(superstep: int) -> dict:
     return record
 
 
+def bench_frames(
+    size: int,
+    viewport: int = 1024,
+    reps: int = 5,
+    burnin: int = 0,
+    subscribers: int = 8,
+) -> dict:
+    """ISSUE 11: the spectator-streaming A/B — full-board pooled frame
+    fetch vs viewport-rect (ROI) fetch on the SAME board, interleaved
+    within each rep (arm-major ordering measured ~7x CPU-phase swings on
+    this rig, PR-8 note), each rep amplified per the measure.py
+    discipline; plus the FramePlane fan-out economics (one device fetch
+    per published turn serving N subscribers) and the viewport-vs-crop
+    bit-identity check.  Board content never changes the fetch cost, so
+    a fresh soup measures the same path a settled board pays; ``burnin``
+    exists for rigs that want the settled realism anyway."""
+    from distributed_gol_tpu.engine.backend import Backend
+    from distributed_gol_tpu.engine.params import Params
+    from distributed_gol_tpu.obs import metrics as obs_metrics
+    from distributed_gol_tpu.serve.frames import FramePlane
+    from distributed_gol_tpu.utils import measure
+
+    viewport = min(viewport, size)
+    p = Params(image_width=size, image_height=size, turns=10**6)
+    be = Backend(p)
+    board = be.put(make_board(size))
+    if burnin:
+        t0 = time.perf_counter()
+        board, _ = be.run_turns(board, burnin)
+        log(f"  frames burn-in: {burnin} gens in {time.perf_counter() - t0:.1f}s")
+    rect = (
+        (size - viewport) // 2,
+        (size - viewport) // 2,
+        viewport,
+        viewport,
+    )
+    fy, fx = p.frame_factors()  # full-board pooling factors
+    rfy, rfx = p.factors_for(viewport, viewport)
+
+    # Correctness leg of the acceptance bar: the rendered viewport must
+    # be bit-identical to the full-frame crop oracle.
+    full_np = be.fetch(board)
+    got = be.fetch_viewport(board, rect)
+    rows = (np.arange(viewport) + rect[0]) % size
+    cols = (np.arange(viewport) + rect[1]) % size
+    if not np.array_equal(got, full_np[rows[:, None], cols[None, :]]):
+        raise AssertionError("viewport fetch diverged from the crop oracle")
+    log(f"  frames identity: viewport == full-frame crop at {size}^2")
+
+    probe_full = lambda: be.probe_frame_fetch(board, fy, fx)  # noqa: E731
+    probe_roi = lambda: be.probe_frame_fetch(  # noqa: E731
+        board, rfy, rfx, rect=rect
+    )
+    probe_full()
+    probe_roi()  # both compiles outside the timed reps
+    noise = measure.sync_noise(lambda: _sync(board))
+    t0 = time.perf_counter()
+    probe_roi()
+    amp = measure.pick_amplification(
+        time.perf_counter() - t0, noise, target_seconds=0.25
+    )
+    full_s, roi_s = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(amp):
+            probe_full()
+        full_s.append((time.perf_counter() - t0) / amp)
+        t0 = time.perf_counter()
+        for _ in range(amp):
+            probe_roi()
+        roi_s.append((time.perf_counter() - t0) / amp)
+
+    # Byte economics.  Device-side bytes touched per frame: the full
+    # path pools the WHOLE board (O(H·W) reads) however small the wire
+    # frame; ROI touches the viewport only.  Wire bytes: the bit-packed
+    # payload each path actually ships.
+    full_cols = -(-size // fx)
+    roi_cols = -(-viewport // rfx)
+    full_wire = -(-size // fy) * (-(-full_cols // 8))
+    roi_wire = -(-viewport // rfy) * (-(-roi_cols // 8))
+
+    # Fan-out: one session board, N spectators, fetches/frame == 1.
+    plane = FramePlane(board_shape=(size, size))
+    rng = np.random.default_rng(0)
+    sub_side = min(256, viewport)
+    for _ in range(subscribers):
+        plane.subscribe(
+            (
+                int(rng.integers(0, size)),
+                int(rng.integers(0, size)),
+                sub_side,
+                sub_side,
+            ),
+            maxsize=4,
+        )
+    plane.publish(0, lambda r: be.fetch_viewport(board, r))  # compile warm-up
+    reg = obs_metrics.REGISTRY
+    snap0 = reg.snapshot()
+    fetches0 = reg.counter("frames.fetches").value
+    fan_turns = 10
+    pub_s = []
+    for turn in range(1, fan_turns + 1):
+        t0 = time.perf_counter()
+        plane.publish(turn, lambda r: be.fetch_viewport(board, r))
+        pub_s.append(time.perf_counter() - t0)
+    fetches = reg.counter("frames.fetches").value - fetches0
+
+    record = {
+        "bench": "frames",
+        "size": size,
+        "viewport": viewport,
+        "burnin": burnin,
+        "identity": True,
+        "amplification": amp,
+        "full_frame": {
+            "metric": f"gol_frames_{size}_full_fetch",
+            "unit": "frames/s",
+            "board_bytes_read": size * size,
+            "wire_bytes": full_wire,
+            **measure.summarize([1.0 / s for s in full_s]),
+        },
+        "roi_frame": {
+            "metric": f"gol_frames_{size}_roi{viewport}_fetch",
+            "unit": "frames/s",
+            "board_bytes_read": viewport * viewport,
+            "wire_bytes": roi_wire,
+            **measure.summarize([1.0 / s for s in roi_s]),
+        },
+        "bytes_ratio": (size * size) / (viewport * viewport),
+        "latency_ratio": measure.median(full_s) / measure.median(roi_s),
+        "fanout": {
+            "subscribers": subscribers,
+            "frames": fan_turns,
+            "fetches": int(fetches),
+            "fetches_per_frame": fetches / fan_turns,
+            "publish": {
+                "metric": f"gol_frames_{size}_fanout{subscribers}_publish",
+                "unit": "publishes/s",
+                **measure.summarize([1.0 / s for s in pub_s]),
+            },
+        },
+        "metrics": reg.snapshot().delta(snap0).to_dict(),
+    }
+    log(
+        f"  frames A/B: full {measure.median(full_s) * 1e3:.1f} ms/frame vs "
+        f"roi {measure.median(roi_s) * 1e3:.1f} ms/frame "
+        f"(x{record['latency_ratio']:.1f}); board bytes x"
+        f"{record['bytes_ratio']:.0f}; fan-out {subscribers} subs @ "
+        f"{record['fanout']['fetches_per_frame']:.2f} fetches/frame"
+    )
+    return record
+
+
 def _bench_serve_impl(
     n_max: int,
     size: int,
@@ -1377,6 +1530,25 @@ def main():
         "launches per superstep for both arms (BENCH_BATCH artifact).",
     )
     ap.add_argument(
+        "--frames",
+        action="store_true",
+        help="spectator-streaming mode (ISSUE 11): interleaved A/B of "
+        "full-board vs viewport-rect frame fetch (bytes/frame + fetch "
+        "latency, stats-linted), FramePlane fan-out economics "
+        "(fetches/frame == 1 at N subscribers), and the viewport-vs-"
+        "crop bit-identity check.  Uses --size at face value (the fetch "
+        "paths never run the engine, so 16384^2 records even on a CPU "
+        "rig) with --frames-viewport.  Prints one lint-checked JSON "
+        "line and exits (BENCH_ROI artifact).",
+    )
+    ap.add_argument(
+        "--frames-viewport",
+        type=int,
+        default=1024,
+        metavar="V",
+        help="viewport side for --frames (a VxV rect centred on the board)",
+    )
+    ap.add_argument(
         "--faults",
         metavar="PLAN",
         default=None,
@@ -1423,6 +1595,21 @@ def main():
         # The metrics-snapshot lint (ISSUE 4): same contract as the stats
         # lint above — a malformed embedded snapshot fails the run rather
         # than shipping a broken artifact.
+        obs_metrics.require_embedded_metrics(record)
+        print(json.dumps(record))
+        return
+
+    if args.frames:
+        # args.size deliberately uncapped: the frame-fetch paths never
+        # run the engine, so the headline 16384^2 board records on any
+        # rig (only put + gather + pool cross the device).
+        record = bench_frames(
+            args.size,
+            viewport=args.frames_viewport,
+            reps=max(args.reps, 5),
+            burnin=args.burnin,
+        )
+        measure.require_headline_stats(record)
         obs_metrics.require_embedded_metrics(record)
         print(json.dumps(record))
         return
@@ -1620,7 +1807,7 @@ def measure_65536(dev) -> dict:
     log(f"  65536x65536 settled: median {gps:,.0f} gens/s "
         f"(spread {qstats['spread']:.3f})")
 
-    _, skipped = run_s(board, kt2)
+    _, skipped, _act = run_s(board, kt2)
     total = pallas_packed.adaptive_tile_launches(
         (H, WP), kt2, pallas_packed.default_skip_cap(H)
     )

@@ -34,6 +34,7 @@ from distributed_gol_tpu.engine.events import (
     Event,
     EventQueue,
     FinalTurnComplete,
+    FrameDelta,
     FrameReady,
     ImageOutputComplete,
     MetricsReport,

@@ -85,6 +85,21 @@ def build_parser() -> argparse.ArgumentParser:
                          "the frame-fetch round-trip is measured at "
                          "viewer start and the stride raised to match on "
                          "slow links (local links keep a frame per turn)")
+    ap.add_argument("--viewport", default=None, metavar="Y0,X0,HxW",
+                    help="region-of-interest spectator viewport: render "
+                         "only this rect (toroidal anchor; a/d/w/x pan, "
+                         "+/- zoom mid-run).  Frame cost becomes "
+                         "O(viewport), not O(board) — what makes 16384^2+ "
+                         "boards watchable (e.g. 0,0,1024x1024)")
+    ap.add_argument("--frame-deltas", action="store_true", default=None,
+                    dest="frame_deltas",
+                    help="delta-encode frames (changed 8-row bands after "
+                         "a keyframe).  Default: auto — on exactly when "
+                         "--viewport is set")
+    ap.add_argument("--no-frame-deltas", action="store_false",
+                    dest="frame_deltas",
+                    help="force whole-frame FrameReady events even with a "
+                         "viewport")
     ap.add_argument("--max-dispatch-seconds", type=float, default=0.25,
                     help="adaptive-superstep target per dispatch; bounds "
                          "keypress latency at ~2x this value")
@@ -200,6 +215,17 @@ def params_from_args(args) -> Params:
     fh, _, fw = args.frame_max.partition("x")
     if not (fh.isdigit() and fw.isdigit()):
         raise ValueError(f"--frame-max wants HxW (e.g. 512x512), got {args.frame_max!r}")
+    viewport = None
+    if args.viewport is not None:
+        try:
+            y0, x0, size = args.viewport.split(",")
+            vh, _, vw = size.partition("x")
+            viewport = (int(y0), int(x0), int(vh), int(vw))
+        except ValueError:
+            raise ValueError(
+                "--viewport wants Y0,X0,HxW (e.g. 0,0,1024x1024), "
+                f"got {args.viewport!r}"
+            ) from None
     return Params(
         turns=args.turns,
         threads=args.t,
@@ -218,6 +244,8 @@ def params_from_args(args) -> Params:
         view_mode=args.view_mode,
         frame_max=(int(fh), int(fw)),
         frame_stride=args.frame_stride,
+        viewport=viewport,
+        frame_deltas=args.frame_deltas,
         max_dispatch_seconds=args.max_dispatch_seconds,
         skip_stable=args.skip_stable,
         skip_tile_cap=args.skip_tile_cap,

@@ -275,8 +275,15 @@ class Backend:
             # annotated span carries too.
             reg.info("backend.sharded_tier", self.sharded_tier)
             reg.info("backend.sharded_tier_policy", self.sharded_tier_policy)
+        # Viewport fetches (ISSUE 11): one bump per ROI device program
+        # dispatched (fetch_viewport / run_turn_with_viewport) — the
+        # fan-out proof reads this to show one fetch serving N viewers.
+        self._m_viewport_fetches = reg.counter("backend.viewport_fetches")
         if getattr(self, "_skip_fn", None) is not None:
             reg.gauge_fn("backend.skip_fraction", self.skip_fraction)
+            # Active-stripe count from the changed-tile bitmap (lazy —
+            # the list index costs nothing until a snapshot asks).
+            reg.gauge_fn("backend.active_tiles", self._active_tiles)
         if self.engine_used == "pallas-packed":
             reg.gauge_fn(
                 "backend.megakernel_cache_hits",
@@ -286,6 +293,86 @@ class Backend:
                 "backend.megakernel_cache_misses",
                 lambda: _megakernel_cache_stats()[1],
             )
+
+    @staticmethod
+    def normalize_rect(
+        rect, h: int, w: int
+    ) -> tuple[int, int, int, int]:
+        """Validate + canonicalise a viewport rect ``(y0, x0, vh, vw)``:
+        anchors wrap onto the torus (any int is legal — panning left past
+        0 lands at the far edge), sizes must fit the board.  One home for
+        every rect consumer (Backend fetches, the controller's ROI
+        viewer, the FramePlane coalescer)."""
+        y0, x0, vh, vw = (int(v) for v in rect)
+        if not (1 <= vh <= h and 1 <= vw <= w):
+            raise ValueError(
+                f"viewport {vh}x{vw} does not fit board {w}x{h} "
+                "(sizes must be within the board; the rect may wrap, "
+                "its extent may not exceed the torus)"
+            )
+        return y0 % h, x0 % w, vh, vw
+
+    def fetch_viewport(self, board, rect) -> np.ndarray:
+        """Fetch ONLY the viewer's rect ``(y0, x0, vh, vw)`` of the
+        device board — toroidal-wrap and shard-boundary-crossing rects
+        included — as a uint8 (vh, vw) array (ISSUE 11).
+
+        The device program is one fused extract + bit-pack jit per rect
+        SIZE (anchors are dynamic, so panning never recompiles): only
+        ``ceil(vw/8)·vh`` bytes cross the host link instead of the whole
+        board, which is the O(viewport) half of the O(viewport ∪
+        activity) frame contract.  Works on every engine × mesh — the
+        gather formulation (``stencil.viewport``) is engine-agnostic and
+        the SPMD partitioner owns cross-shard rects.  Like every other
+        fetch, blocking is the CALLER's concern: the controller and the
+        FramePlane wrap this in the dispatch watchdog."""
+        h, w = self.params.image_height, self.params.image_width
+        y0, x0, vh, vw = self.normalize_rect(rect, h, w)
+        fn = self._viewer_fns.get(("vfetch", vh, vw))
+        if fn is None:
+
+            @jax.jit
+            def fn(b, yy, xx):
+                sub = stencil.viewport(b, yy, xx, vh, vw)
+                return jnp.packbits(sub != 0, axis=-1)
+
+            self._viewer_fns[("vfetch", vh, vw)] = fn
+        self._m_viewport_fetches.inc()
+        bits = np.asarray(jax.device_get(fn(board, y0, x0)))
+        return np.unpackbits(bits, axis=-1, count=vw) * np.uint8(255)
+
+    def run_turn_with_viewport(
+        self, board: jax.Array, rect, fy: int, fx: int, turns: int = 1
+    ) -> tuple[jax.Array, int, np.ndarray]:
+        """The ROI form of :meth:`run_turn_with_frame`: ``turns``
+        generations, returning (board, alive count, device-pooled frame
+        of the viewport rect ``(y0, x0, vh, vw)`` after the last one).
+        Superstep, toroidal rect extract, pool, count, and bit-pack are
+        ONE fused dispatch — per-frame cost scales with the viewport,
+        not the board, which is what makes a 65536² run watchable
+        (ISSUE 11).  The jit specialises on rect SIZE and stride only;
+        pan anchors are dynamic operands."""
+        h, w = self.params.image_height, self.params.image_width
+        y0, x0, vh, vw = self.normalize_rect(rect, h, w)
+        fn = self._viewer_fns.get(("vframe", vh, vw, fy, fx, turns))
+        if fn is None:
+
+            @jax.jit
+            def fn(b, yy, xx):
+                nb = self._device_superstep(b, turns)
+                sub = stencil.viewport(nb, yy, xx, vh, vw)
+                pooled = stencil.frame_pool(sub, fy, fx)
+                return nb, stencil.alive_count(nb), jnp.packbits(
+                    pooled != 0, axis=-1
+                )
+
+            self._viewer_fns[("vframe", vh, vw, fy, fx, turns)] = fn
+        self._m_viewport_fetches.inc()
+        new_board, count, bits = fn(board, y0, x0)
+        count, bits = self.fetch_many(count, bits)
+        cols = -(-vw // fx)
+        frame = np.unpackbits(bits, axis=-1, count=cols) * np.uint8(255)
+        return new_board, int(count), frame
 
     def _skip_superstep(self, board, turns: int):
         """The adaptive pallas-packed engine with live skip telemetry.
@@ -299,7 +386,7 @@ class Backend:
         explicit experiments.  What IS live is the skip fraction
         (:meth:`skip_fraction`), the direct observability the round-2
         verdict asked for."""
-        new_board, skipped = self._skip_fn(board, turns)
+        new_board, skipped, act = self._skip_fn(board, turns)
         h, w = self.params.image_height, self.params.image_width
         if self.mesh is not None:
             from distributed_gol_tpu.parallel import pallas_halo
@@ -314,7 +401,7 @@ class Backend:
                 (h, w // 32), turns, self._skip_cap
             )
         if total:
-            self._skip_stats.append((skipped, total))
+            self._skip_stats.append((skipped, total, act))
             del self._skip_stats[:-3]
         return new_board
 
@@ -334,8 +421,56 @@ class Backend:
         stats = getattr(self, "_skip_stats", None)
         if not stats or len(stats) < 3:
             return None
-        skipped, total = stats[-3]
+        skipped, total, _act = stats[-3]
         return int(skipped) / total
+
+    def activity_bitmap(self) -> np.ndarray | None:
+        """Per-stripe changed-tile bitmap of the newest safely-resolved
+        adaptive dispatch (ISSUE 11; ROADMAP item 5): a bool vector, one
+        entry per adaptive row-stripe in top-to-bottom board order, True
+        where the stripe saw activity during that dispatch — measured
+        exactly by the frontier kernels (nonempty gen-T vs gen-(T+6)
+        diff at some launch), conservatively (not-proved-stable) by the
+        probing forms.  ``None`` before enough dispatches have run or on
+        engine × mesh combinations without adaptive telemetry (roll,
+        packed, non-adaptive pallas-packed) — callers needing
+        correctness must diff frames host-side; the bitmap is the
+        CHEAP superset that lets frame serving scale with the activity
+        frontier instead of the board.
+
+        Note the period-6 caveat: ash that oscillates (blinkers,
+        pulsars) reads INACTIVE — its cells do change between frames
+        sampled off-phase.  Delta-correct consumers (the ROI frame
+        encoder) therefore diff the fetched bytes and use this bitmap
+        only as telemetry / a fetch-shaping hint.
+
+        Same 2-dispatch lag as :meth:`skip_fraction`, so reading this
+        never stalls the pipelined controller."""
+        stats = getattr(self, "_skip_stats", None)
+        if not stats or len(stats) < 3:
+            return None
+        act = np.asarray(stats[-3][2])
+        if act.size == 0:
+            return None
+        return act > 0
+
+    def _active_tiles(self) -> float | None:
+        """Snapshot-time gauge body for ``backend.active_tiles``: the
+        number of True entries in :meth:`activity_bitmap` (None while
+        the bitmap is unavailable — lazy gauges drop None)."""
+        bm = self.activity_bitmap()
+        if bm is None:
+            return None
+        return float(int(bm.sum()))
+
+    def activity_tile_rows(self) -> int | None:
+        """Board rows per entry of :meth:`activity_bitmap` (None while
+        the bitmap is unavailable) — H / len(bitmap): the bitmap always
+        tiles the whole board top to bottom, on sharded meshes too."""
+        bm = self.activity_bitmap()
+        if bm is None:
+            return None
+        return self.params.image_height // len(bm)
 
     # Speed tier of each engine; a capability fallback moves DOWN this
     # ranking (all engines are bit-identical, so only speed is at stake —
@@ -602,13 +737,38 @@ class Backend:
         frame = np.unpackbits(bits, axis=-1, count=cols) * np.uint8(255)
         return new_board, int(count), frame
 
-    def probe_frame_fetch(self, board: jax.Array, fy: int, fx: int) -> None:
+    def probe_frame_fetch(
+        self, board: jax.Array, fy: int, fx: int, rect=None
+    ) -> None:
         """One frame-fetch round-trip WITHOUT advancing the simulation:
         the same pool + count + bit-pack dispatch and host transfer as
         ``run_turn_with_frame``, minus the superstep.  The controller
         times this at viewer start to measure the link's per-frame cost
         (the latency-adaptive stride policy); keeping the engine out of
-        it makes the probe safe on every engine × mesh combination."""
+        it makes the probe safe on every engine × mesh combination.
+
+        ``rect`` (ISSUE 11): probe the VIEWPORT fetch path instead —
+        extract + pool + bit-pack of only the rect, exactly what
+        ``run_turn_with_viewport`` ships — so the auto-stride policy is
+        sized from what an ROI viewer actually pays per frame, not the
+        full-board cost it never incurs."""
+        if rect is not None:
+            h, w = self.params.image_height, self.params.image_width
+            y0, x0, vh, vw = self.normalize_rect(rect, h, w)
+            fn = self._viewer_fns.get(("vframe_probe", vh, vw, fy, fx))
+            if fn is None:
+
+                @jax.jit
+                def fn(b, yy, xx):
+                    sub = stencil.viewport(b, yy, xx, vh, vw)
+                    pooled = stencil.frame_pool(sub, fy, fx)
+                    return stencil.alive_count(b), jnp.packbits(
+                        pooled != 0, axis=-1
+                    )
+
+                self._viewer_fns[("vframe_probe", vh, vw, fy, fx)] = fn
+            self.fetch_many(*fn(board, y0, x0))
+            return
         fn = self._viewer_fns.get(("frame_probe", fy, fx))
         if fn is None:
 
@@ -874,6 +1034,7 @@ class BatchedBackend:
 
             self._stack_fn = roll_stack
         self._fused = None  # the run_boards jit (retraces per arity)
+        self._batch_fns = {}  # fused stack-wide fetch programs (ISSUE 11)
         self._init_metrics(params)
 
     @staticmethod
@@ -969,3 +1130,27 @@ class BatchedBackend:
         return np.asarray(
             jax.device_get(jax.vmap(stencil.alive_count)(stack))
         )
+
+    def fetch_viewport(self, stack: jax.Array, rect) -> np.ndarray:
+        """The batched-slot form of :meth:`Backend.fetch_viewport`
+        (ISSUE 11): ONE fused extract + bit-pack dispatch over the whole
+        ``(B, H, W)`` stack, returning a uint8 ``(B, vh, vw)`` array —
+        every tenant's viewport off one launch, the same amortisation
+        the batched superstep buys.  (Cohort members fetch through their
+        SOLO surface — ``_CohortMember`` only overrides the superstep —
+        so this serves direct BatchedBackend drivers and benches.)"""
+        h, w = self.params.image_height, self.params.image_width
+        y0, x0, vh, vw = Backend.normalize_rect(rect, h, w)
+        fn = self._batch_fns.get(("vfetch", vh, vw))
+        if fn is None:
+
+            @jax.jit
+            def fn(s, yy, xx):
+                sub = jax.vmap(
+                    lambda b: stencil.viewport(b, yy, xx, vh, vw)
+                )(s)
+                return jnp.packbits(sub != 0, axis=-1)
+
+            self._batch_fns[("vfetch", vh, vw)] = fn
+        bits = np.asarray(jax.device_get(fn(stack, y0, x0)))
+        return np.unpackbits(bits, axis=-1, count=vw) * np.uint8(255)

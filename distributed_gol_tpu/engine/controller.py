@@ -43,6 +43,7 @@ from distributed_gol_tpu.engine.events import (
     DispatchError,
     EventQueue,
     FinalTurnComplete,
+    FrameDelta,
     FrameReady,
     ImageOutputComplete,
     MetricsReport,
@@ -269,12 +270,34 @@ class Controller:
         backend: Optional[Backend] = None,
         flight=None,
         stop=None,
+        frame_plane=None,
     ):
         self.params = params
         self.events = events
         self.key_presses = key_presses
         self.session = session if session is not None else default_session()
         self.backend = backend if backend is not None else Backend(params)
+        # -- region-of-interest frame plane (ISSUE 11) --
+        # Live viewport rect [y0, x0, vh, vw] (mutated by pan/zoom keys)
+        # or None = whole-board frames; the delta encoder's state; and
+        # the optional spectator fan-out hub (serve.frames.FramePlane)
+        # fed one coalesced publish per rendered turn.
+        self._rect = (
+            None
+            if params.viewport is None
+            else list(
+                Backend.normalize_rect(
+                    params.viewport, params.image_height, params.image_width
+                )
+            )
+        )
+        self._deltas_on = params.frame_deltas_enabled()
+        self._last_frame = None
+        self._frame_keyframe = True
+        self._rect_resized = False
+        self.frame_plane = frame_plane
+        if frame_plane is not None:
+            frame_plane.bind(params.image_height, params.image_width)
         # "completed" | "detached" ('q') | "killed" ('k') | "preempted"
         # (graceful stop: SIGTERM/SIGINT → emergency checkpoint → exit
         # paused-and-resumable)
@@ -455,6 +478,44 @@ class Controller:
             self._emit(StateChange(turn, State.QUITTING))
             self.session.quit()
             self._outcome = "killed"
+        elif self._rect is not None and key in self._VIEWPORT_KEYS:
+            self._pan_zoom(key)
+
+    # Viewport pan/zoom keys (ISSUE 11): a/d/w/x pan left/right/up/down
+    # by half a viewport; '+'/'=' zoom in (halve the rect about its
+    # centre), '-' zoom out (double, clamped to the board).  Chosen to
+    # avoid the reference's s/p/q/k; ignored on non-viewport runs.
+    _VIEWPORT_KEYS = frozenset("adwx+=-")
+    _VIEWPORT_MIN = 16  # smallest zoomed-in rect side, cells
+
+    def _pan_zoom(self, key: str):
+        """Mutate the live viewport rect; the next frame re-keyframes
+        (and, on a zoom, flags the resize so the auto-stride policy can
+        re-probe a materially different fetch)."""
+        h, w = self.params.image_height, self.params.image_width
+        y0, x0, vh, vw = self._rect
+        if key in "adwx":
+            dy = {"w": -vh // 2, "x": vh // 2}.get(key, 0)
+            dx = {"a": -vw // 2, "d": vw // 2}.get(key, 0)
+            y0, x0 = (y0 + dy) % h, (x0 + dx) % w
+        else:
+            cy, cx = y0 + vh // 2, x0 + vw // 2
+            if key == "-":
+                nvh, nvw = min(2 * vh, h), min(2 * vw, w)
+            else:
+                # Zoom-in floor: the smaller of _VIEWPORT_MIN, the board
+                # side, and the CURRENT size — so '+' never grows a rect
+                # (a sub-16 viewport stays put) and never exceeds a
+                # small board.
+                nvh = max(min(self._VIEWPORT_MIN, h, vh), vh // 2)
+                nvw = max(min(self._VIEWPORT_MIN, w, vw), vw // 2)
+            if (nvh, nvw) == (vh, vw):
+                return
+            vh, vw = nvh, nvw
+            y0, x0 = (cy - vh // 2) % h, (cx - vw // 2) % w
+            self._rect_resized = True
+        self._rect = [y0, x0, vh, vw]
+        self._frame_keyframe = True
 
     def _poll_keys(self, board, turn: int):
         """Drain pending keys; while paused, block here (stepping stops, the
@@ -1044,8 +1105,18 @@ class Controller:
             from distributed_gol_tpu.ops import stencil
 
             fy, fx = p.frame_factors()
-            pooled = np.asarray(stencil.frame_pool(np.asarray(board_np), fy, fx))
-            self._emit(FrameReady(start_turn, pooled, (fy, fx)))
+            src, rect = board_np, None
+            if self._rect is not None:
+                # ROI viewer (ISSUE 11): the starting KEYFRAME covers the
+                # viewport only — host-side toroidal crop of the freshly
+                # loaded world, same wrap semantics as the device path.
+                y0, x0, vh, vw = self._rect
+                rows = (np.arange(vh) + y0) % p.image_height
+                cols = (np.arange(vw) + x0) % p.image_width
+                src = board_np[rows[:, None], cols[None, :]]
+                rect = tuple(self._rect)
+            pooled = np.asarray(stencil.frame_pool(np.asarray(src), fy, fx))
+            self._emit(FrameReady(start_turn, pooled, (fy, fx), rect=rect))
 
         board = self.backend.put(board_np)
         state = _TickerState(start_turn, int(np.count_nonzero(board_np)))
@@ -1082,9 +1153,16 @@ class Controller:
         p = self.params
         wants_flips = p.wants_flips()
         fy, fx = p.frame_factors()
+        roi = self._rect is not None and not wants_flips
+        rect = tuple(self._rect) if roi else None
         stride = p.runtime_superstep()  # 1 for flips; frame_stride for frames
         auto_stride = not wants_flips and p.frame_stride == 0 and turn < p.turns
-        rtt = self._measure_frame_rtt(board, fy, fx, turn) if auto_stride else 0.0
+        rtt = (
+            self._measure_frame_rtt(board, fy, fx, turn, rect=rect)
+            if auto_stride
+            else 0.0
+        )
+        probed_area = rect[2] * rect[3] if roi else 0
         self.frame_stride_effective = stride
         warm_frames = 0
         while turn < p.turns:
@@ -1114,13 +1192,49 @@ class Controller:
                 state.set(turn, count)
                 self._emit_flips(turn, coords)
             else:
+                if roi:
+                    # The live rect: pan/zoom keys mutate it between
+                    # dispatches; a zoom also changes the pool factors.
+                    rect = tuple(self._rect)
+                    fy, fx = self._roi_factors(rect)
+                    if self._rect_resized:
+                        self._rect_resized = False
+                        area = rect[2] * rect[3]
+                        # Re-probe on a MATERIAL size change (>= 2x
+                        # either way): stride must be sized from the
+                        # fetch the viewer actually pays now, and a
+                        # re-warm re-times one generation at the new
+                        # rect (satellite: the auto-stride probe
+                        # measures the product fetch path).
+                        if auto_stride and not (
+                            probed_area // 2 < area < probed_area * 2
+                        ):
+                            rtt = self._measure_frame_rtt(
+                                board, fy, fx, turn, rect=rect
+                            )
+                            probed_area = area
+                            stride = 1
+                            warm_frames = 0
+                            self.frame_stride_effective = stride
                 k = min(stride, p.turns - turn)
                 t_disp = time.perf_counter()
-                board, count, frame = self._dispatch(
-                    lambda: self.backend.run_turn_with_frame(board, fy, fx, k),
-                    board,
-                    turn,
-                )
+                if roi:
+                    step_rect = rect
+                    board, count, frame = self._dispatch(
+                        lambda: self.backend.run_turn_with_viewport(
+                            board, step_rect, fy, fx, k
+                        ),
+                        board,
+                        turn,
+                    )
+                else:
+                    board, count, frame = self._dispatch(
+                        lambda: self.backend.run_turn_with_frame(
+                            board, fy, fx, k
+                        ),
+                        board,
+                        turn,
+                    )
                 if auto_stride and stride == 1:
                     # Dispatch 1 includes the jit compile — warm only;
                     # dispatch 2 times one true (generation + fetch) and
@@ -1134,7 +1248,26 @@ class Controller:
                 self._emit_turns(turn + 1, turn + k - 1)
                 turn += k
                 state.set(turn, count)
-                self._emit(FrameReady(turn, frame, (fy, fx)))
+                self._emit_frame(turn, frame, (fy, fx), rect)
+                if self.frame_plane is not None:
+                    # Spectator fan-out (ISSUE 11): ONE coalesced device
+                    # fetch per rendered turn serves every subscriber,
+                    # riding the FULL dispatch contract — watchdog AND
+                    # the retry policy — like every other per-turn
+                    # fetch (a transient fault in the spectator fetch
+                    # must not cost more than the frame dispatch it
+                    # follows would).
+                    fetch_board = board
+                    self.frame_plane.publish(
+                        turn,
+                        lambda r: self._dispatch(
+                            lambda: self.backend.fetch_viewport(
+                                fetch_board, r
+                            ),
+                            fetch_board,
+                            turn,
+                        ),
+                    )
             self._emit(TurnComplete(turn))
             # The unified per-dispatch record (ISSUE 4 satellite): timing
             # event, metrics bumps and flight-ring entry share ONE home
@@ -1144,18 +1277,59 @@ class Controller:
             self._guard_boundary(board_in, board, turn, k, count)
         return board, turn
 
+    def _roi_factors(self, rect) -> tuple[int, int]:
+        """(fy, fx) pooling factors for the LIVE viewport rect — the
+        dynamic-zoom form of ``Params.frame_factors`` (which only knows
+        the starting viewport)."""
+        return self.params.factors_for(rect[2], rect[3])
+
+    def _emit_frame(self, turn: int, frame, factors, rect):
+        """Emit one rendered frame: a FrameReady keyframe when the delta
+        protocol is off, not yet anchored, or just re-anchored (first
+        frame, pan/zoom, shape change); else the changed-band FrameDelta
+        against the last delivered frame (``engine/frames.py`` — the ONE
+        wire-format home shared with the FramePlane fan-out)."""
+        if not self._deltas_on:
+            self._emit(FrameReady(turn, frame, factors, rect=rect))
+            return
+        from distributed_gol_tpu.engine import frames as frames_lib
+
+        last = self._last_frame
+        self._last_frame = frame
+        if (
+            last is None
+            or self._frame_keyframe
+            or last.shape != frame.shape
+        ):
+            self._frame_keyframe = False
+            self._emit(FrameReady(turn, frame, factors, rect=rect))
+            return
+        bands = frames_lib.delta_bands(last, frame)
+        self._emit(FrameDelta(turn, bands=bands, factors=factors, rect=rect))
+
     def _measure_frame_rtt(
-        self, board, fy: int, fx: int, turn: int = 0, probes: int = 3
+        self,
+        board,
+        fy: int,
+        fx: int,
+        turn: int = 0,
+        probes: int = 3,
+        rect=None,
     ) -> float:
         """Median round-trip of one frame fetch (pool + count + bit-pack
         + host transfer, no simulation — ``Backend.probe_frame_fetch``),
-        first call excluded (jit compile).  Device work goes through the
-        standard dispatch contract (watchdog + retry); ``turn`` is the
-        run's TRUE current turn — a terminal probe failure parks the
-        board as a checkpoint, and a resumed run (turn > 0) must park at
-        its real turn, not 0, or the resume would replay generations on
-        an already-advanced board."""
-        probe = lambda: self.backend.probe_frame_fetch(board, fy, fx)  # noqa: E731
+        first call excluded (jit compile).  With ``rect`` (ISSUE 11) the
+        probe runs the VIEWPORT fetch path, so the auto-stride policy is
+        sized from what an ROI viewer actually pays — probing the
+        full-board pool would size the stride for a cost the run never
+        incurs.  Device work goes through the standard dispatch contract
+        (watchdog + retry); ``turn`` is the run's TRUE current turn — a
+        terminal probe failure parks the board as a checkpoint, and a
+        resumed run (turn > 0) must park at its real turn, not 0, or the
+        resume would replay generations on an already-advanced board."""
+        probe = lambda: self.backend.probe_frame_fetch(  # noqa: E731
+            board, fy, fx, rect=rect
+        )
         self._dispatch(probe, board, turn)  # compile
         times = []
         for _ in range(max(1, probes)):

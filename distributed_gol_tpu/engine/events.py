@@ -114,6 +114,35 @@ class FrameReady(Event):
     # scalar fields.
     frame: object = field(default=None, compare=False)
     factors: tuple = (1, 1)  # (fy, fx) pooling factors
+    # Viewport rect (y0, x0, height, width) in BOARD cells this frame
+    # covers (ISSUE 11), or None for a whole-board frame — viewers pin
+    # pan/zoom changes to it.  A FrameReady is a KEYFRAME in the delta
+    # protocol: it replaces the viewer's buffer wholesale and re-anchors
+    # subsequent FrameDelta bands.
+    rect: tuple | None = None
+
+
+@dataclass(frozen=True)
+class FrameDelta(Event):
+    """Changed bands of one rendered frame against the previously
+    delivered frame (framework extension, ISSUE 11) — the delta half of
+    the spectator-streaming wire format.
+
+    ``bands`` is a sequence of ``(y0, rows)`` pairs: ``rows`` is a uint8
+    (n, cols) array replacing frame rows ``y0 .. y0 + n - 1`` in place;
+    rows outside every band are UNCHANGED from the previous frame and
+    must not be touched by the viewer (pinned by test — the in-place
+    contract is what keeps a million-viewer fan-out's per-frame work
+    O(activity), not O(viewport)).  Bands are 8-row-aligned, disjoint,
+    and ascending; an empty ``bands`` is a legal frame (nothing in the
+    viewport changed — the turn still ticks).  Deltas only ever follow a
+    FrameReady keyframe with the same ``rect``; any viewport change
+    re-keyframes.  Ordering matches FrameReady: delivered before the
+    turn's TurnComplete."""
+
+    bands: Sequence = field(default_factory=tuple, compare=False)
+    factors: tuple = (1, 1)
+    rect: tuple | None = None
 
 
 @dataclass(frozen=True)
@@ -401,6 +430,7 @@ AnyEvent = Union[
     CellFlipped,
     CellsFlipped,
     FrameReady,
+    FrameDelta,
     TurnComplete,
     TurnsCompleted,
     CycleDetected,

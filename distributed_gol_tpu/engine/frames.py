@@ -1,0 +1,61 @@
+"""Frame delta codec — ONE home for the spectator-streaming wire format
+(ISSUE 11).
+
+A frame stream is a KEYFRAME (``FrameReady``: the whole rendered frame)
+followed by DELTAS (``FrameDelta``: the changed 8-row bands against the
+previously delivered frame).  Encoding happens host-side by diffing the
+fetched bytes — exact by construction, which is what lets the device-side
+activity bitmap stay a telemetry hint (period-6 ash oscillates without
+tripping it; the byte diff catches every change).  The encoder here, the
+controller's ROI viewer, the FramePlane fan-out hub, and the viewers'
+in-place appliers all speak exactly this format, so they can never drift.
+
+Cost shape: ``delta_bands`` is O(viewport) host work per frame (one
+elementwise compare) and O(activity ∩ viewport) wire bytes; ``apply_bands``
+touches ONLY the changed rows — the in-place contract a million-viewer
+fan-out needs (pinned by test).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: Rows per delta band.  8 matches the packed engines' alignment quantum
+#: and keeps band bookkeeping negligible against the row payload.
+BAND_ROWS = 8
+
+
+def delta_bands(
+    prev: np.ndarray, new: np.ndarray, band_rows: int = BAND_ROWS
+) -> tuple:
+    """The changed ``band_rows``-row bands of ``new`` against ``prev``
+    (same shape), as a tuple of ``(y0, rows)`` pairs — ``rows`` copies,
+    so the caller may keep mutating ``new``.  Empty tuple = identical
+    frames (a legal, cheap delta)."""
+    if prev.shape != new.shape:
+        raise ValueError(
+            f"delta frames must match: {prev.shape} vs {new.shape}"
+        )
+    h = new.shape[0]
+    hot_rows = (prev != new).any(axis=1)
+    bands = []
+    for y in range(0, h, band_rows):
+        end = min(y + band_rows, h)
+        if hot_rows[y:end].any():
+            bands.append((y, new[y:end].copy()))
+    return tuple(bands)
+
+
+def apply_bands(buf: np.ndarray, bands) -> np.ndarray:
+    """Apply delta ``bands`` to ``buf`` IN PLACE (and return it).  Rows
+    outside every band are not touched — the viewer-side half of the
+    in-place contract."""
+    for y0, rows in bands:
+        buf[y0 : y0 + rows.shape[0], : rows.shape[1]] = rows
+    return buf
+
+
+def bands_nbytes(bands) -> int:
+    """Payload bytes of a delta (the rows only — the per-band scalar is
+    noise), for the bytes/frame telemetry."""
+    return int(sum(rows.nbytes for _, rows in bands))

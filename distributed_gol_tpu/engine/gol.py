@@ -33,6 +33,7 @@ def run(
     backend: Optional[Backend] = None,
     stop=None,
     backend_factory=None,
+    frame_plane=None,
 ) -> None:
     """Drive one whole simulation, blocking until the event stream ends.
 
@@ -47,7 +48,12 @@ def run(
     ``backend_factory(params, attempt)`` is the build seam the serving
     plane and chaos harnesses use (ISSUE 6): supervised runs hand it to
     the supervisor's rebuild ladder; unsupervised runs call it once with
-    ``attempt=0``.  An explicit ``backend`` wins for attempt 0."""
+    ``attempt=0``.  An explicit ``backend`` wins for attempt 0.
+
+    ``frame_plane`` (a ``serve.frames.FramePlane``, ISSUE 11) attaches a
+    spectator fan-out hub: a frame-mode run publishes one coalesced
+    viewport fetch per rendered turn to it, serving every subscriber's
+    rect + delta stream off that single device fetch."""
     if params.restart_limit > 0:
         from distributed_gol_tpu.engine.supervisor import supervise
 
@@ -59,11 +65,20 @@ def run(
             backend,
             backend_factory=backend_factory,
             stop=stop,
+            frame_plane=frame_plane,
         )
     else:
         if backend is None and backend_factory is not None:
             backend = backend_factory(params, 0)
-        Controller(params, events, key_presses, session, backend, stop=stop).run()
+        Controller(
+            params,
+            events,
+            key_presses,
+            session,
+            backend,
+            stop=stop,
+            frame_plane=frame_plane,
+        ).run()
 
 
 def start(
@@ -74,11 +89,21 @@ def start(
     backend: Optional[Backend] = None,
     stop=None,
     backend_factory=None,
+    frame_plane=None,
 ) -> threading.Thread:
     """``go gol.Run(...)``: run in a daemon thread, return it."""
     t = threading.Thread(
         target=run,
-        args=(params, events, key_presses, session, backend, stop, backend_factory),
+        args=(
+            params,
+            events,
+            key_presses,
+            session,
+            backend,
+            stop,
+            backend_factory,
+            frame_plane,
+        ),
         name="gol-run",
         daemon=True,
     )

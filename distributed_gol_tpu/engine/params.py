@@ -114,6 +114,29 @@ class Params:
     # Controller._auto_frame_stride for the policy).  An explicit N >= 1
     # always wins.  Ignored outside frame mode.
     frame_stride: int = 0
+    # Region-of-interest spectator viewport (ISSUE 11): ``(y0, x0,
+    # height, width)`` in board cells, or None for the whole board.
+    # With a viewport, an attached viewer runs in FRAME mode regardless
+    # of board size and every frame is a fused superstep + toroidal rect
+    # extract + pool + bit-pack of ONLY the rect — per-frame cost scales
+    # with the viewport, not the board (O(viewport ∪ activity); the
+    # round-5 full-board path fetched O(H·W) per frame, which is why a
+    # 65536² run simulating at 12.5k gens/s was unwatchable).  The
+    # anchor may be any integers (it wraps the torus: rects straddling
+    # the seam or a shard boundary are fine); the SIZE must fit the
+    # board.  Viewer keys pan (a/d/w/x — left/right/up/down by half a
+    # viewport) and zoom ('+'/'-' — halve/double the rect about its
+    # centre) the rect mid-run; the pygame window maps the arrow keys
+    # to the same actions.
+    viewport: tuple[int, int, int, int] | None = None
+    # Delta-encoded frames (ISSUE 11): after a keyframe (``FrameReady``),
+    # ship only the changed 8-row bands of each rendered frame as
+    # ``FrameDelta`` events, applied in place by the viewers — the wire
+    # cost becomes O(activity within the viewport).  Keyframes re-arm on
+    # every viewport change.  None (default) = AUTO: deltas on exactly
+    # when a viewport is set (full-board frame runs keep the byte-for-
+    # byte round-5 FrameReady stream); explicit True/False always wins.
+    frame_deltas: bool | None = None
     # Whole-board cycle detection for headless runs: every N device
     # dispatches, probe (asynchronously, off the critical path) whether
     # advancing 6 generations reproduces the board exactly.  Once it does,
@@ -297,6 +320,21 @@ class Params:
             raise ValueError(
                 "frame_stride must be >= 1, or 0 for latency-adaptive"
             )
+        if self.viewport is not None:
+            vp = tuple(int(v) for v in self.viewport)
+            if len(vp) != 4:
+                raise ValueError(
+                    f"viewport must be (y0, x0, height, width), got {self.viewport!r}"
+                )
+            if not (
+                1 <= vp[2] <= self.image_height
+                and 1 <= vp[3] <= self.image_width
+            ):
+                raise ValueError(
+                    f"viewport size {vp[3]}x{vp[2]} does not fit board "
+                    f"{self.image_width}x{self.image_height}"
+                )
+            object.__setattr__(self, "viewport", vp)
         ny, nx = self.mesh_shape
         if ny < 1 or nx < 1:
             raise ValueError(f"mesh_shape must be positive, got {self.mesh_shape}")
@@ -435,18 +473,38 @@ class Params:
             return False
         if self.view_mode == "frame":
             return True
+        # A viewport is a frame-mode request by construction (ISSUE 11):
+        # rect extraction + pooling IS the frame path, whatever the board
+        # size — unless the viewer explicitly demanded exact flips.
+        if self.viewport is not None and self.view_mode != "flips":
+            return True
         return (
             self.view_mode == "auto"
             and self.image_width * self.image_height > self._FLIP_VIEW_MAX_CELLS
         )
 
-    def frame_factors(self) -> tuple[int, int]:
-        """(fy, fx) pooling factors mapping the board into frame_max."""
+    def frame_deltas_enabled(self) -> bool:
+        """The resolved frame-delta policy (None = auto: deltas exactly
+        when a viewport is set, so full-board frame runs stay
+        byte-for-byte the round-5 stream)."""
+        if self.frame_deltas is not None:
+            return self.frame_deltas
+        return self.viewport is not None
+
+    def factors_for(self, vh: int, vw: int) -> tuple[int, int]:
+        """(fy, fx) pooling factors mapping a (vh, vw) region into
+        ``frame_max`` — ONE home for the ceil-pooling math (the static
+        :meth:`frame_factors`, the controller's live-zoom rects, and the
+        bench's wire-byte accounting all call here)."""
         fh, fw = self.frame_max
-        return (
-            max(1, -(-self.image_height // fh)),
-            max(1, -(-self.image_width // fw)),
-        )
+        return (max(1, -(-vh // fh)), max(1, -(-vw // fw)))
+
+    def frame_factors(self) -> tuple[int, int]:
+        """(fy, fx) pooling factors mapping the rendered region — the
+        viewport when one is set, else the whole board — into frame_max."""
+        if self.viewport is not None:
+            return self.factors_for(self.viewport[2], self.viewport[3])
+        return self.factors_for(self.image_height, self.image_width)
 
     # Auto skip_stable engages at or beyond this run length: ~20× the
     # measured settling time of a 512²-class soup (≈5k turns) and long

@@ -151,6 +151,7 @@ class Supervisor:
         backend_factory: Optional[Callable[[Params, int], Backend]] = None,
         stop: Optional[GracefulStop] = None,
         device_probe: Optional[Callable] = None,
+        frame_plane=None,
     ):
         self.params = params
         self.events = events
@@ -159,6 +160,11 @@ class Supervisor:
         self._first_backend = backend
         self._backend_factory = backend_factory
         self.stop = stop
+        # Spectator fan-out hub (ISSUE 11): survives restarts — every
+        # attempt's controller publishes to the SAME hub, so subscribers
+        # ride through a recovery (their next frame is a keyframe; the
+        # hub re-anchors on the rebuilt backend's fetches).
+        self.frame_plane = frame_plane
         # The health-classification seam of the elastic rung:
         # ``device_probe(devices) -> (healthy, condemned)``.  Default is
         # the real put/fetch probe, watchdog-bounded by the dispatch
@@ -382,6 +388,7 @@ class Supervisor:
                     self._build_backend(attempt),
                     flight=self.flight,
                     stop=self.stop,
+                    frame_plane=self.frame_plane,
                 )
             except BaseException as e:
                 # A failed REBUILD (attempt >= 1) must still honour the
@@ -515,6 +522,7 @@ def supervise(
     backend_factory: Optional[Callable[[Params, int], Backend]] = None,
     stop: Optional[GracefulStop] = None,
     device_probe: Optional[Callable] = None,
+    frame_plane=None,
 ) -> Supervisor:
     """Run one supervised simulation (see :class:`Supervisor`); returns
     the supervisor so callers can read ``history`` /
@@ -532,6 +540,7 @@ def supervise(
         backend_factory,
         stop,
         device_probe=device_probe,
+        frame_plane=frame_plane,
     )
     sup.run()
     return sup

@@ -1279,7 +1279,7 @@ def _copy_rect(
 
 
 def _kernel_frontier_mega(
-    xa, xb, oa, ob, sk_ref,
+    xa, xb, oa, ob, sk_ref, act_ref,
     tile, aux, merge, colwin,
     ilo0, ihi0, ilo1, ihi1, iclo, ichi,
     rr8, rn8, rc128, rn128,
@@ -1377,6 +1377,17 @@ def _kernel_frontier_mega(
     def _():
         acc[0] = 0
 
+    @pl.when(first)
+    def _():
+        # Per-stripe activity accumulator (ISSUE 11): zeroed at each
+        # board's launch 0, bumped by put_state whenever the stripe
+        # MEASURES a nonempty active interval — i.e. its gen-(T+6) state
+        # differs from gen T somewhere.  Counting measured activity (not
+        # computed launches) keeps launch 0's forced full union from
+        # painting every stripe active: a dead stripe measures an empty
+        # interval even when forced to compute.
+        act_ref[gi] = 0
+
     # Neighbour intervals from the previous launch's state row, placed
     # into this stripe's frame: the left neighbour's rows sit directly
     # above even across the torus wrap (content-wise that IS where its
@@ -1418,6 +1429,12 @@ def _kernel_frontier_mega(
         rn8[wr, i] = n8
         rc128[wr, i] = c128
         rn128[wr, i] = n128
+        # Activity telemetry: exactly one put_state per (stripe, launch)
+        # — the three routes are mutually exclusive — so this counts
+        # launches where the stripe published a nonempty interval.
+        act_ref[gi] = act_ref[gi] + (
+            jnp.asarray(lo0) <= jnp.asarray(hi0)
+        ).astype(jnp.int32)
 
     def copy_rect(src, dst, r8, n8, c128, n128):
         # The shared chunked-rect copier (one home with the sharded strip
@@ -1637,13 +1654,18 @@ def _build_dispatch_frontier(
     nboards: int = 1,
 ):
     """The frontier megakernel as ``(board, scratch_board) ->
-    (board_a, board_b, skipped)`` — ``nlaunch`` launches of ``turns``
-    generations in ONE pallas_call.  Both board args are aliased onto
-    the first two outputs (ping-pong pair); the final state is output
-    ``nlaunch % 2`` (b for odd, a for even), the other buffer holds
-    S_{nlaunch−1}.  ``skipped`` sums the per-launch stability flags —
-    the same telemetry series the per-launch form accumulated with
-    ``jnp.sum`` per launch.
+    (board_a, board_b, skipped, activity)`` — ``nlaunch`` launches of
+    ``turns`` generations in ONE pallas_call.  Both board args are
+    aliased onto the first two outputs (ping-pong pair); the final state
+    is output ``nlaunch % 2`` (b for odd, a for even), the other buffer
+    holds S_{nlaunch−1}.  ``skipped`` sums the per-launch stability
+    flags — the same telemetry series the per-launch form accumulated
+    with ``jnp.sum`` per launch.  ``activity`` (int32[nboards·grid],
+    ISSUE 11) counts, per stripe, the launches of this dispatch where
+    the stripe measured a nonempty active interval (gen T+6 != gen T
+    somewhere in it) — the per-stripe changed-tile telemetry
+    ``Backend.activity_bitmap`` surfaces; 0 = the stripe was ash (period
+    dividing 6) for the whole dispatch.
 
     ``nboards > 1`` is the BATCHED form (ISSUE 8): the leading grid axis
     runs ``nboards`` independent tori stacked along the row axis — board
@@ -1688,11 +1710,13 @@ def _build_dispatch_frontier(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((nboards * h, wp), jnp.uint32),
             jax.ShapeDtypeStruct((nboards * h, wp), jnp.uint32),
             jax.ShapeDtypeStruct((nboards,), jnp.int32),
+            jax.ShapeDtypeStruct((nboards * grid,), jnp.int32),
         ],
         input_output_aliases={0: 0, 1: 1},
         scratch_shapes=[
@@ -1911,11 +1935,13 @@ def make_superstep(
     mostly-stable regions and costs a few % while everything is active.
 
     ``skip_tile_cap`` bounds the adaptive tile height (None = the
-    measured size-aware default, ``default_skip_cap``); ``with_stats`` makes the
-    returned fn yield ``(board, skipped_tiles)`` — the Backend's cap
-    auto-tune signal.  The denominator (`adaptive_tile_launches`) is a
-    host-side computation so the caller never has to force a device
-    value just to know the launch count.
+    measured size-aware default, ``default_skip_cap``); ``with_stats``
+    makes the returned fn yield ``(board, skipped_tiles, activity)`` —
+    the Backend's cap auto-tune signal plus the per-stripe activity
+    vector behind ``Backend.activity_bitmap`` (ISSUE 11; empty when the
+    dispatch carried no adaptive telemetry).  The denominator
+    (`adaptive_tile_launches`) is a host-side computation so the caller
+    never has to force a device value just to know the launch count.
     """
     cap = skip_tile_cap
 
@@ -1936,7 +1962,9 @@ def make_superstep(
             v = pack_vertical(unpack(board))
             v = _build_vmem_resident(vshape, rule, turns, ip)(v)
             board = pack(unpack_vertical(v))
-        return (board, jnp.int32(0)) if with_stats else board
+        if with_stats:
+            return board, jnp.int32(0), jnp.zeros((0,), jnp.int32)
+        return board
 
     return run
 
@@ -1980,6 +2008,9 @@ def _run_tiled(
         adaptive = False
     full, rem = divmod(turns, t)
     skipped = jnp.int32(0)
+    # Per-stripe activity vector (ISSUE 11): empty for dispatches with no
+    # adaptive telemetry — the Backend reads empty as "no bitmap".
+    act = jnp.zeros((0,), jnp.int32)
     if adaptive and full:
         # State (skip flags; plus active intervals for the frontier
         # kernel) is carried between the identical-geometry launches of
@@ -1997,6 +2028,7 @@ def _run_tiled(
         # gens/s before the unroll).
         tile_h = _plan_tile(shape, t, cap)
         grid = shape[0] // tile_h
+        act = jnp.zeros((grid,), jnp.int32)
         fplan = _frontier_plan(shape, t, cap)
         if fplan is not None:
             # Frontier-tracked megakernel: the dispatch runs as canonical
@@ -2009,11 +2041,12 @@ def _run_tiled(
             a = jnp.zeros_like(board)
             for c in chunks:
                 call = _build_dispatch_frontier(shape, rule, t, c, ip, cap)
-                na, nb, sk = call(board, a)
+                na, nb, sk, act_c = call(board, a)
                 # Canonical sizes are even — final board in output a —
                 # but thread generally so the invariant isn't load-bearing.
                 board, a = (nb, na) if c % 2 else (na, nb)
                 skipped = skipped + sk[0]
+                act = act + act_c
             if loose:
                 # Sub-chunk tail: the per-launch probing form (bitmap
                 # elision), not a one-off megakernel length.  Launch 1 of
@@ -2026,22 +2059,37 @@ def _run_tiled(
                     nb, st = call(st, board, prev)
                     board, prev = nb, board
                     skipped = skipped + jnp.sum(st)
+                    # Probing-form activity: tiles NOT proved stable this
+                    # launch (conservative — a computed-but-quiet tile
+                    # still counts; the megakernel chunks above carry the
+                    # exact measured series).
+                    act = act + (1 - st)
         else:
             call = _build_launch_adaptive(shape, rule, t, ip, cap)
             st0 = jnp.zeros((grid,), jnp.int32)
 
             def body(_, carry):
-                a, b, st, sk = carry
+                a, b, st, sk, ac = carry
                 nb1, nst1 = call(st, b, a)
                 nb2, nst2 = call(nst1, nb1, b)
-                return nb1, nb2, nst2, sk + jnp.sum(nst1) + jnp.sum(nst2)
+                return (
+                    nb1,
+                    nb2,
+                    nst2,
+                    sk + jnp.sum(nst1) + jnp.sum(nst2),
+                    ac + (1 - nst1) + (1 - nst2),
+                )
 
-            a, board, st, skipped = jax.lax.fori_loop(
-                0, full // 2, body, (jnp.zeros_like(board), board, st0, skipped)
+            a, board, st, skipped, act = jax.lax.fori_loop(
+                0,
+                full // 2,
+                body,
+                (jnp.zeros_like(board), board, st0, skipped, act),
             )
             if full % 2:
                 board, nst = call(st, board, a)
                 skipped = skipped + jnp.sum(nst)
+                act = act + (1 - nst)
     elif full:
         call = _build_launch(shape, rule, t, ip, False, cap)
         board = jax.lax.fori_loop(0, full, lambda _, b: call(b), board)
@@ -2060,7 +2108,7 @@ def _run_tiled(
     if rem:
         board = _build_launch(shape, rule, rem, ip, False, cap)(board)
     if with_stats:
-        return board, skipped
+        return board, skipped, act
     return board
 
 
@@ -2105,7 +2153,9 @@ def _run_tiled_batched(stack, rule: LifeRule, turns: int, ip: bool, cap: int):
             call = _build_dispatch_frontier(
                 shape, rule, t, c, ip, cap, nboards=nb
             )
-            na, nbuf, sk = call(flat, a)
+            # Per-stripe activity is discarded here: batched stacks are
+            # headless by construction, so nothing consumes the bitmap.
+            na, nbuf, sk, _act = call(flat, a)
             flat, a = (nbuf, na) if c % 2 else (na, nbuf)
             skipped = skipped + sk
         stack = flat.reshape(nb, h, wp)
@@ -2182,12 +2232,14 @@ def make_superstep_bytes(
                 pack(board), rule, turns, ip, skip_stable, cap, with_stats
             )
             if with_stats:
-                b, sk = res
-                return unpack(b), sk
+                b, sk, act = res
+                return unpack(b), sk, act
             return unpack(res)
         if turns:
             v = _build_vmem_resident(vshape, rule, turns, ip)(pack_vertical(board))
             board = unpack_vertical(v)
-        return (board, jnp.int32(0)) if with_stats else board
+        if with_stats:
+            return board, jnp.int32(0), jnp.zeros((0,), jnp.int32)
+        return board
 
     return run

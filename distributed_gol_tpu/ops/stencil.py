@@ -108,6 +108,28 @@ def frame_pool(board: jax.Array, fy: int, fx: int) -> jax.Array:
     return board.reshape(ph // fy, fy, pw // fx, fx).max(axis=(1, 3))
 
 
+@partial(jax.jit, static_argnames=("vh", "vw"))
+def viewport(board: jax.Array, y0, x0, vh: int, vw: int) -> jax.Array:
+    """Toroidal (vh, vw) window of ``board`` anchored at (y0, x0) — the
+    region-of-interest extraction every spectator-streaming path shares
+    (ISSUE 11).  ``y0``/``x0`` are DYNAMIC (traced) so panning a viewer
+    never recompiles; only the window SIZE specialises the program.
+
+    Wrap handling is index arithmetic, not data movement: two chained
+    1-D gathers with pre-modded indices, so a rect straddling the torus
+    seam (either axis, or both) costs the same as an interior one —
+    O(vh·W + vh·vw) device reads instead of the O(H·W) a roll-then-slice
+    formulation would pay.  Works unchanged on sharded boards (the SPMD
+    partitioner owns the cross-shard gather), which is what makes one
+    implementation serve every engine × mesh at the Backend seam."""
+    h, w = board.shape
+    # jnp.mod (floor mod) keeps indices in range for negative anchors too
+    # (a viewer panning left past x = 0 wraps to the far edge).
+    rows = jnp.mod(jnp.int32(y0) + jnp.arange(vh, dtype=jnp.int32), h)
+    cols = jnp.mod(jnp.int32(x0) + jnp.arange(vw, dtype=jnp.int32), w)
+    return jnp.take(jnp.take(board, rows, axis=0), cols, axis=1)
+
+
 @jax.jit
 def flip_mask(prev: jax.Array, new: jax.Array) -> jax.Array:
     """Cells that changed between two boards, as a uint8 0/1 mask.

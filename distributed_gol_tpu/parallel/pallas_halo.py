@@ -394,7 +394,7 @@ def _decode_state6(slab):
 
 
 def _kernel_frontier_mega_strip(
-    ids_ref, xa, xb, oa, ob, sk_ref,
+    ids_ref, xa, xb, oa, ob, sk_ref, act_ref,
     tile, aux, merge, colwin,
     nhalo, shalo, tstate, bstate, nstate, sstate,
     ilo0, ihi0, ilo1, ihi1, iclo, ichi,
@@ -434,6 +434,13 @@ def _kernel_frontier_mega_strip(
     @pl.when(first & (i == 0))
     def _():
         acc[0] = 0
+
+    @pl.when(first)
+    def _():
+        # Per-stripe activity accumulator (ISSUE 11) — the strip form of
+        # the single-device megakernel's: zeroed at launch 0, bumped by
+        # put_state on a nonempty measured interval.
+        act_ref[i] = 0
 
     def mk_exchange(rd_board, k):
         """Transfer k of the launch's exchange: 0 board-up, 1 board-down,
@@ -566,6 +573,12 @@ def _kernel_frontier_mega_strip(
         rn8[wr, i] = n8
         rc128[wr, i] = c128
         rn128[wr, i] = n128
+        # Activity telemetry (one put_state per stripe per launch —
+        # routes are mutually exclusive): launches where this stripe
+        # measured a nonempty active interval.
+        act_ref[i] = act_ref[i] + (
+            jnp.asarray(lo0) <= jnp.asarray(hi0)
+        ).astype(jnp.int32)
         # Edge stripes also publish the slab the next launch's exchange
         # ships to the neighbours (both slabs on a one-stripe strip).
         vec = _encode_state6((lo0, hi0, lo1, hi1, clo, chi))
@@ -770,7 +783,11 @@ def _build_dispatch_frontier_strip(
     remote: bool,
 ):
     """The in-kernel-exchange strip megakernel as ``(ids, board,
-    scratch_board) -> (board_a, board_b, skipped)`` — ``nlaunch`` launches
+    scratch_board) -> (board_a, board_b, skipped, activity)`` —
+    ``activity`` (int32[grid], ISSUE 11) counts per LOCAL stripe the
+    launches where it measured a nonempty active interval (the sharded
+    out-spec concatenates per-device vectors into the board-global
+    bitmap ``Backend.activity_bitmap`` serves) — ``nlaunch`` launches
     of ``turns`` generations in ONE pallas_call per device, halos and
     interval state exchanged inside (``_kernel_frontier_mega_strip``).
     ``ids`` is int32[3]: north neighbour y, south neighbour y, own x mesh
@@ -817,11 +834,13 @@ def _build_dispatch_frontier_strip(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((h_loc, wp), jnp.uint32),
             jax.ShapeDtypeStruct((h_loc, wp), jnp.uint32),
             jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((grid,), jnp.int32),
         ],
         input_output_aliases={1: 0, 2: 1},
         scratch_shapes=[
@@ -1251,18 +1270,23 @@ def make_superstep(
     dispatch's identical-geometry launches, zeroed at dispatch start).
     ``skip_tile_cap`` bounds the adaptive tile height (None = the default
     measured size-aware default from the strip height,
-    ``pallas_packed.default_skip_cap``).  ``with_stats`` returns ``(board, skipped)``
-    where ``skipped`` counts skip-branch tile-launches across all devices
-    and full launches of the dispatch (the replicated result of one
-    all-reduce per launch) — same live-telemetry contract as the
-    single-device kernel."""
+    ``pallas_packed.default_skip_cap``).  ``with_stats`` returns
+    ``(board, skipped, activity)`` where ``skipped`` counts skip-branch
+    tile-launches across all devices and full launches of the dispatch
+    (the replicated result of one all-reduce per launch) and
+    ``activity`` (int32[ny·grid], ISSUE 11) is the board-global
+    per-stripe activity vector in top-to-bottom board order (empty when
+    the dispatch carries no adaptive telemetry) — same live-telemetry
+    contract as the single-device kernel."""
     ny = mesh.shape["y"]
     raw_cap = skip_tile_cap
 
     @partial(jax.jit, static_argnames=("turns",))
     def run(board: jax.Array, turns: int):
         if turns == 0:
-            return (board, jnp.int32(0)) if with_stats else board
+            if with_stats:
+                return board, jnp.int32(0), jnp.zeros((0,), jnp.int32)
+            return board
         ip = _use_interpret() if interpret is None else interpret
         h, wp = board.shape
         strip = (h // ny, wp)
@@ -1402,7 +1426,7 @@ def make_superstep(
                 shard_map,
                 mesh=mesh,
                 in_specs=(BOARD_SPEC, BOARD_SPEC),
-                out_specs=(BOARD_SPEC, BOARD_SPEC, P("y")),
+                out_specs=(BOARD_SPEC, BOARD_SPEC, P("y"), P("y")),
                 check_vma=False,
             )
             def step(local, prev):
@@ -1422,6 +1446,10 @@ def make_superstep(
         # non-skip path, which never consulted the helper, derives none.
         adaptive_t = skip_stable and t_adaptive
         skipped = jnp.int32(0)
+        # Board-global per-stripe activity (ISSUE 11): ny·grid entries in
+        # device order == top-to-bottom board order; empty when the
+        # dispatch carries no adaptive telemetry.
+        act = jnp.zeros((0,), jnp.int32)
         # use_ici already conjoins the adaptive/frontier-plan capability
         # with the mesh policy; the dispatch branch below only adds the
         # "at least one full launch" requirement.
@@ -1442,11 +1470,13 @@ def make_superstep(
             grid = strip[0] // tile_h
             chunks, loose = _nlaunch_chunks(full)
             a = jnp.zeros_like(board)
+            act = jnp.zeros((ny * grid,), jnp.int32)
             for c in chunks:
                 step_c = make_dispatch_ici(t, c)
-                na, nb, sk = step_c(board, a)
+                na, nb, sk, act_c = step_c(board, a)
                 board, a = (nb, na) if c % 2 else (na, nb)
                 skipped = skipped + jnp.sum(sk)
+                act = act + act_c
             if loose:
                 step_l = make_step(t, adaptive_ok=True)
                 st = jnp.zeros((ny * grid,), jnp.int32)
@@ -1455,6 +1485,7 @@ def make_superstep(
                     nb, nst = step_l(st, board, prev)
                     board, prev, st = nb, board, nst
                     skipped = skipped + jnp.sum(nst)
+                    act = act + (1 - nst)
         elif adaptive_t and full and fplan is not None:
             # Frontier strip kernel (round 5): tracked intervals replace
             # the probe + bitmap; state is carried across launches in the
@@ -1473,29 +1504,38 @@ def make_superstep(
             ch0 = jnp.full((ny * grid,), wp - 1, jnp.int32)
             ps0 = jnp.zeros((ny * grid,), jnp.int32)
 
+            def launch_activity(r):
+                # A launch's measured activity per stripe: either tracked
+                # row interval nonempty (lo <= hi) in the state it
+                # publishes for the next launch.
+                return ((r[2] <= r[3]) | (r[4] <= r[5])).astype(jnp.int32)
+
             def fbody(_, carry):
-                a, b, ps, l0, h0, l1, h1, cl, ch, sk = carry
+                a, b, ps, l0, h0, l1, h1, cl, ch, sk, ac = carry
                 r1 = step_t(ps, l0, h0, l1, h1, cl, ch, b, a)
                 nb1, st1 = r1[0], r1[1]
                 r2 = step_t(st1, *r1[2:], nb1, b)
                 nb2, st2 = r2[0], r2[1]
                 return (nb1, nb2, st2) + tuple(r2[2:]) + (
                     sk + jnp.sum(st1) + jnp.sum(st2),
+                    ac + launch_activity(r1) + launch_activity(r2),
                 )
 
+            act = jnp.zeros((ny * grid,), jnp.int32)
             out = jax.lax.fori_loop(
                 0,
                 full // 2,
                 fbody,
                 (jnp.zeros_like(board), board, ps0, lo0, hi0,
-                 e_lo, e_hi, cl0, ch0, skipped),
+                 e_lo, e_hi, cl0, ch0, skipped, act),
             )
             a, board, ps = out[0], out[1], out[2]
-            skipped = out[-1]
+            skipped, act = out[-2], out[-1]
             if full % 2:
-                r = step_t(ps, *out[3:-1], board, a)
+                r = step_t(ps, *out[3:-2], board, a)
                 board = r[0]
                 skipped = skipped + jnp.sum(r[1])
+                act = act + launch_activity(r)
         elif adaptive_t and full:
             grid = strip[0] // _strip_plan_tile(strip, t, cap)
             step_t = make_step(t, adaptive_ok=True)
@@ -1509,19 +1549,32 @@ def make_superstep(
             # boundary, not executed skip branches
             # (Backend.skip_fraction documents the trade).
             st0 = jnp.zeros((ny * grid,), jnp.int32)
+            act = jnp.zeros((ny * grid,), jnp.int32)
 
             def body(_, carry):
-                a, b, st, sk = carry
+                a, b, st, sk, ac = carry
                 nb1, nst1 = step_t(st, b, a)
                 nb2, nst2 = step_t(nst1, nb1, b)
-                return nb1, nb2, nst2, sk + jnp.sum(nst1) + jnp.sum(nst2)
+                return (
+                    nb1,
+                    nb2,
+                    nst2,
+                    sk + jnp.sum(nst1) + jnp.sum(nst2),
+                    # Probing-form activity: tiles not proved stable this
+                    # launch (conservative, like the single-device form).
+                    ac + (1 - nst1) + (1 - nst2),
+                )
 
-            a, board, st, skipped = jax.lax.fori_loop(
-                0, full // 2, body, (jnp.zeros_like(board), board, st0, skipped)
+            a, board, st, skipped, act = jax.lax.fori_loop(
+                0,
+                full // 2,
+                body,
+                (jnp.zeros_like(board), board, st0, skipped, act),
             )
             if full % 2:
                 board, nst = step_t(st, board, a)
                 skipped = skipped + jnp.sum(nst)
+                act = act + (1 - nst)
         elif full:
             step_t = make_step(t)
             board = jax.lax.fori_loop(0, full, lambda _, b: step_t(b), board)
@@ -1536,7 +1589,7 @@ def make_superstep(
         if rem:
             board = make_step(rem)(board)
         if with_stats:
-            return board, skipped
+            return board, skipped, act
         return board
 
     return run
@@ -1564,11 +1617,13 @@ def make_superstep_bytes(
     @partial(jax.jit, static_argnames=("turns",))
     def run(board: jax.Array, turns: int):
         if turns == 0:
-            return (board, jnp.int32(0)) if with_stats else board
+            if with_stats:
+                return board, jnp.int32(0), jnp.zeros((0,), jnp.int32)
+            return board
         p = jax.lax.with_sharding_constraint(pack(board), packed_sharding(mesh))
         if with_stats:
-            out, skipped = inner(p, turns)
-            return unpack(out), skipped
+            out, skipped, act = inner(p, turns)
+            return unpack(out), skipped, act
         return unpack(inner(p, turns))
 
     return run

@@ -1,8 +1,10 @@
 """Multi-tenant serving plane (ISSUE 6): admission control, per-session
 fault isolation, graceful pod drain, health surface; plus the batched
 dispatch cohorts (ISSUE 8) that amortise one launch across N resident
-tenants.  See ``serve/plane.py`` for the architecture and docs/API.md
-"Serving" / "Batched serving" for the contracts."""
+tenants, and the spectator frame fan-out hub (ISSUE 11) that serves N
+viewers' viewports off one device fetch per turn.  See
+``serve/plane.py`` for the architecture and docs/API.md "Serving" /
+"Batched serving" / "Spectator streaming" for the contracts."""
 
 from distributed_gol_tpu.serve.admission import (
     AdmissionController,
@@ -10,12 +12,15 @@ from distributed_gol_tpu.serve.admission import (
     ServeConfig,
 )
 from distributed_gol_tpu.serve.batcher import CohortBatcher, cohort_key
+from distributed_gol_tpu.serve.frames import FramePlane, FrameSubscriber
 from distributed_gol_tpu.serve.plane import ServePlane, SessionHandle
 
 __all__ = [
     "AdmissionController",
     "AdmissionRejected",
     "CohortBatcher",
+    "FramePlane",
+    "FrameSubscriber",
     "ServeConfig",
     "ServePlane",
     "SessionHandle",

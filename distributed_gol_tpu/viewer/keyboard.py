@@ -19,7 +19,11 @@ import sys
 import threading
 from typing import Callable, Optional
 
-KEYS = frozenset("spqk")
+
+# s/p/q/k are the reference's control keys (``sdl/loop.go:15-28``);
+# a/d/w/x pan and '+'/'='/'-' zoom a region-of-interest viewport
+# (ISSUE 11) — forwarded unconditionally, ignored by non-viewport runs.
+KEYS = frozenset("spqk" + "adwx+=-")
 
 
 def keyboard_listener(

@@ -23,6 +23,7 @@ from distributed_gol_tpu.engine.events import (
     CellFlipped,
     CellsFlipped,
     FinalTurnComplete,
+    FrameDelta,
     FrameReady,
     TurnComplete,
     TurnsCompleted,
@@ -88,7 +89,14 @@ def run_terminal(
         elif isinstance(e, FrameReady):
             # Large boards: the engine ships a device-pooled frame instead
             # of per-cell flips; render it directly (it IS the view).
-            shadow = np.asarray(e.frame)
+            # COPY: FrameDelta bands apply in place below, and the
+            # producer keeps the delivered keyframe as its delta base.
+            shadow = np.array(e.frame, dtype=np.uint8, copy=True)
+        elif isinstance(e, FrameDelta):
+            # ROI delta stream (ISSUE 11): touch only the changed bands.
+            from distributed_gol_tpu.engine.frames import apply_bands
+
+            apply_bands(shadow, e.bands)
         elif isinstance(e, (TurnComplete, TurnsCompleted)):
             # TurnsCompleted: batch telemetry (one event per dispatch);
             # reachable here only with flip_events="off", where there is

@@ -29,6 +29,7 @@ from distributed_gol_tpu.engine.events import (
     CellFlipped,
     CellsFlipped,
     FinalTurnComplete,
+    FrameDelta,
     FrameReady,
     TurnComplete,
     TurnsCompleted,
@@ -69,9 +70,21 @@ class Window:
         self._pixels[y, x] ^= 0xFF
 
     def set_frame(self, frame: np.ndarray) -> None:
-        """Replace the buffer wholesale — the FrameReady feed (device-
-        pooled frames; no reference equivalent, it fetched every pixel)."""
-        self._pixels = np.ascontiguousarray(frame, dtype=np.uint8)
+        """Replace the buffer wholesale — the FrameReady keyframe feed
+        (device-pooled frames; no reference equivalent, it fetched every
+        pixel).  Always a COPY: the engine keeps the delivered frame as
+        its delta base, so in-place band application here must never
+        reach back into the producer's array."""
+        self._pixels = np.array(frame, dtype=np.uint8, copy=True)
+
+    def apply_delta(self, bands) -> None:
+        """Apply a FrameDelta's changed bands IN PLACE (ISSUE 11): rows
+        outside every band are not touched — the viewer-side half of the
+        O(activity) in-place contract, pinned by test (the round-5 path
+        rebuilt the whole buffer per frame via ``set_frame``)."""
+        from distributed_gol_tpu.engine.frames import apply_bands
+
+        apply_bands(self._pixels, bands)
 
     def render_frame(self) -> None:
         """Present the buffer (``sdl/window.go:56-64``): grayscale →
@@ -92,6 +105,19 @@ class Window:
             pygame.K_p: "p",
             pygame.K_q: "q",
             pygame.K_k: "k",
+            # Viewport pan/zoom (ISSUE 11): letters and arrows pan, +/-
+            # zoom — the same chars the terminal keyboard forwards.
+            pygame.K_a: "a",
+            pygame.K_d: "d",
+            pygame.K_w: "w",
+            pygame.K_x: "x",
+            pygame.K_LEFT: "a",
+            pygame.K_RIGHT: "d",
+            pygame.K_UP: "w",
+            pygame.K_DOWN: "x",
+            pygame.K_PLUS: "+",
+            pygame.K_EQUALS: "+",
+            pygame.K_MINUS: "-",
         }
         for ev in pygame.event.get():
             if ev.type == pygame.QUIT:
@@ -127,9 +153,17 @@ def run_window(
     if window is None:
         if params.wants_frames():
             fy, fx = params.frame_factors()
-            window = Window(
-                -(-params.image_width // fx), -(-params.image_height // fy)
-            )
+            if params.viewport is not None:
+                # ROI viewer (ISSUE 11): the window shows the viewport's
+                # pooled frame; zoom changes arrive as new-shape
+                # keyframes, which set_frame adopts wholesale.
+                _, _, vh, vw = params.viewport
+                window = Window(-(-vw // fx), -(-vh // fy))
+            else:
+                window = Window(
+                    -(-params.image_width // fx),
+                    -(-params.image_height // fy),
+                )
         else:
             window = Window(params.image_width, params.image_height)
     final = None
@@ -153,6 +187,8 @@ def run_window(
                     window.flip_pixel(c.x, c.y)
             elif isinstance(e, FrameReady):
                 window.set_frame(np.asarray(e.frame))
+            elif isinstance(e, FrameDelta):
+                window.apply_delta(e.bands)
             elif isinstance(e, (TurnComplete, TurnsCompleted)):
                 now = time.monotonic()
                 if now - last_draw >= min_dt:

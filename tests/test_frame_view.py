@@ -247,10 +247,12 @@ class TestLatencyAdaptiveStride:
         if fake_rtt is not None:
             monkeypatch.setattr(
                 Controller, "_measure_frame_rtt",
-                lambda self, board, fy, fx, turn=0, probes=3: fake_rtt,
+                lambda self, board, fy, fx, turn=0, probes=3, rect=None: (
+                    fake_rtt
+                ),
             )
         else:
-            def _boom(self, board, fy, fx, turn=0, probes=3):
+            def _boom(self, board, fy, fx, turn=0, probes=3, rect=None):
                 raise AssertionError(
                     "RTT probe must not run with an explicit frame_stride"
                 )

@@ -160,7 +160,7 @@ def test_sharded_ping_pong_multi_launch_elision(rng, mesh_shape):
         mesh, CONWAY, skip_stable=True, skip_tile_cap=64, with_stats=True
     )
     for turns in (4 * t, 5 * t, 4 * t + 20):  # both parities + remainder split
-        out, skipped = run(pb, turns)
+        out, skipped, _act = run(pb, turns)
         ref = packed.superstep(p, CONWAY, turns)
         assert np.array_equal(np.asarray(out), np.asarray(ref)), turns
         total = pallas_halo.adaptive_strip_launches(
@@ -183,7 +183,7 @@ class TestShardedFrontier:
         mesh = make_mesh(mesh_shape)
         p = packed.pack(jnp.asarray(board_np))
         pb = jax.device_put(np.asarray(p), packed_sharding(mesh))
-        out, sk = pallas_halo.make_superstep(
+        out, sk, _act = pallas_halo.make_superstep(
             mesh, CONWAY, skip_stable=True, with_stats=True
         )(pb, turns)
         return np.asarray(packed.unpack(out)), int(sk)
@@ -289,7 +289,7 @@ class TestInKernelICI:
         mesh = make_mesh((1, 1))
         p = packed.pack(jnp.asarray(board_np))
         pb = jax.device_put(np.asarray(p), packed_sharding(mesh))
-        out, sk = pallas_halo.make_superstep(
+        out, sk, _act = pallas_halo.make_superstep(
             mesh, CONWAY, skip_stable=True, with_stats=True, **kw
         )(pb, turns)
         return np.asarray(packed.unpack(out)), int(sk)
@@ -443,7 +443,7 @@ class TestInKernelICI:
         mesh = make_mesh((1, 1))
         p = packed.pack(jnp.asarray(board))
         pb = jax.device_put(np.asarray(p), packed_sharding(mesh))
-        out, _ = pallas_halo.make_superstep(
+        out, _, _act = pallas_halo.make_superstep(
             mesh, CONWAY, skip_stable=True, with_stats=True, in_kernel=True
         )(pb, 100)
         assert np.array_equal(np.asarray(packed.unpack(out)), golden)

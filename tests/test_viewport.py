@@ -1,0 +1,738 @@
+"""Region-of-interest frame plane (ISSUE 11): viewport fetch bit-identity
+vs the full-frame crop oracle across engines × meshes × rect kinds, the
+delta wire format (encode == apply, reconstruction equals dense frames
+over a soup run), the per-stripe activity bitmap, the viewport-aware
+auto-stride probe, and the FramePlane fan-out economics (one device
+fetch per published turn for any subscriber count)."""
+
+import os
+import queue
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("SDL_VIDEODRIVER", "dummy")
+
+import jax.numpy as jnp
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.engine import frames as frames_lib
+from distributed_gol_tpu.engine.backend import Backend
+from distributed_gol_tpu.engine.events import (
+    FinalTurnComplete,
+    FrameDelta,
+    FrameReady,
+)
+from distributed_gol_tpu.engine.params import Params
+from distributed_gol_tpu.engine.pgm import write_pgm
+from distributed_gol_tpu.models.life import CONWAY
+from distributed_gol_tpu.ops import stencil
+from distributed_gol_tpu.serve.frames import FramePlane, _cyclic_bound
+
+
+def soup(h, w, density=0.25, seed=11):
+    rng = np.random.default_rng(seed)
+    return np.where(rng.random((h, w)) < density, 255, 0).astype(np.uint8)
+
+
+def crop(board, rect):
+    """The toroidal crop oracle every identity test compares against."""
+    y0, x0, vh, vw = rect
+    h, w = board.shape
+    rows = (np.arange(vh) + y0) % h
+    cols = (np.arange(vw) + x0) % w
+    return board[rows[:, None], cols[None, :]]
+
+
+class TestViewportOp:
+    def test_matches_oracle_every_wrap_kind(self):
+        b = soup(96, 64, seed=1)
+        jb = jnp.asarray(b)
+        for rect in [
+            (10, 10, 20, 20),  # interior
+            (90, 10, 20, 20),  # wraps y
+            (10, 60, 20, 20),  # wraps x
+            (90, 60, 20, 20),  # wraps both
+            (-5, -7, 20, 20),  # negative anchors wrap too
+            (0, 0, 96, 64),  # the whole board
+        ]:
+            got = np.asarray(
+                stencil.viewport(jb, rect[0], rect[1], rect[2], rect[3])
+            )
+            assert np.array_equal(got, crop(b, rect)), rect
+
+    def test_dynamic_anchor_shares_one_compilation(self):
+        # Pan must not recompile: the jit specialises on SIZE only.
+        b = jnp.asarray(soup(64, 64))
+        f = stencil.viewport
+        first = np.asarray(f(b, 0, 0, 16, 16))
+        panned = np.asarray(f(b, 7, 9, 16, 16))
+        assert first.shape == panned.shape == (16, 16)
+        # Same underlying compiled callable across anchors is implied by
+        # static_argnames; the behavioural check is the oracle above.
+
+
+# Engine × mesh matrix for the Backend-seam identity tests; the
+# pallas-packed rows run interpret mode hermetically (conftest pins CPU).
+_CONFIGS = [
+    ("roll", (1, 1)),
+    ("packed", (1, 1)),
+    ("pallas-packed", (1, 1)),
+    ("roll", (2, 1)),
+    ("packed", (2, 1)),
+    ("pallas-packed", (2, 1)),
+]
+
+
+class TestBackendFetchViewport:
+    # Rect kinds: interior, toroidal-wrap (both axes), and one that
+    # straddles the (2,1)-mesh shard boundary at H/2.
+    _RECTS = [
+        (10, 40, 48, 64),
+        (230, 230, 48, 64),
+        (104, 0, 48, 64),  # straddles row 128 on a (2,1) mesh of 256 rows
+    ]
+
+    @pytest.mark.parametrize("engine,mesh", _CONFIGS)
+    def test_identity_vs_full_fetch_crop(self, engine, mesh):
+        size = 256
+        b = soup(size, size, seed=5)
+        p = Params(
+            image_width=size,
+            image_height=size,
+            turns=10,
+            engine=engine,
+            mesh_shape=mesh,
+            metrics=False,
+        )
+        be = Backend(p)
+        dev = be.put(b)
+        dev, _ = be.run_turns(dev, 4)
+        full = be.fetch(dev)
+        for rect in self._RECTS:
+            got = be.fetch_viewport(dev, rect)
+            assert np.array_equal(got, crop(full, rect)), (engine, mesh, rect)
+
+    def test_fused_viewport_frame_matches_crop(self):
+        size = 256
+        b = soup(size, size, seed=6)
+        p = Params(
+            image_width=size, image_height=size, turns=10, engine="roll",
+            metrics=False,
+        )
+        be = Backend(p)
+        dev = be.put(b)
+        rect = (240, 240, 64, 64)  # wraps both axes
+        nb, count, frame = be.run_turn_with_viewport(dev, rect, 1, 1, 3)
+        full = be.fetch(nb)
+        assert count == int(np.count_nonzero(full))
+        assert np.array_equal(frame, (crop(full, rect) != 0) * np.uint8(255))
+
+    def test_rect_must_fit_board(self):
+        p = Params(image_width=64, image_height=64, turns=1, metrics=False)
+        be = Backend(p)
+        dev = be.put(soup(64, 64))
+        with pytest.raises(ValueError, match="does not fit"):
+            be.fetch_viewport(dev, (0, 0, 65, 10))
+
+
+class TestActivityBitmap:
+    def _adaptive_backend(self):
+        # A tiled adaptive board: W % 4096 == 0, cap 64 -> 4 stripes of
+        # 64 rows; glider in stripe 1, ash elsewhere.
+        H, W = 256, 4096
+        b = np.zeros((H, W), np.uint8)
+        for dy, dx in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+            b[100 + dy, 600 + dx] = 255
+        b[10:12, 50:52] = 255  # still life in stripe 0
+        p = Params(
+            image_width=W,
+            image_height=H,
+            turns=10**6,
+            engine="pallas-packed",
+            skip_stable=True,
+            skip_tile_cap=64,
+            metrics=False,
+        )
+        # The small test board is dual-eligible (VMEM-resident AND
+        # tiled); the explicit skip_stable trade is announced — scoped
+        # here, the adaptive telemetry is exactly what the test wants.
+        with pytest.warns(UserWarning, match="forces the tiled kernel"):
+            be = Backend(p)
+        return be, b
+
+    def test_bitmap_marks_active_stripes_only(self):
+        be, b = self._adaptive_backend()
+        assert be.activity_bitmap() is None  # nothing resolved yet
+        dev = be.put(b)
+        for _ in range(3):  # the 2-dispatch safety lag needs 3 dispatches
+            dev, _ = be.run_turns(dev, 36)
+        bm = be.activity_bitmap()
+        assert bm is not None and bm.dtype == bool and bm.shape == (4,)
+        assert bm[1], "the glider's stripe must read active"
+        assert not bm[0], "still-life stripe must read inactive"
+        assert not bm[3], "empty stripe must read inactive"
+        assert be.activity_tile_rows() == 64
+
+    def test_bitmap_none_without_adaptive_telemetry(self):
+        p = Params(
+            image_width=128, image_height=128, turns=10, engine="roll",
+            metrics=False,
+        )
+        be = Backend(p)
+        dev = be.put(soup(128, 128))
+        for _ in range(3):
+            dev, _ = be.run_turns(dev, 5)
+        assert be.activity_bitmap() is None
+        assert be.activity_tile_rows() is None
+
+    def test_active_tiles_gauge_published(self):
+        from distributed_gol_tpu.obs import metrics as obs_metrics
+
+        H, W = 256, 4096
+        b = np.zeros((H, W), np.uint8)
+        for dy, dx in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+            b[100 + dy, 600 + dx] = 255
+        p = Params(
+            image_width=W,
+            image_height=H,
+            turns=10**6,
+            engine="pallas-packed",
+            skip_stable=True,
+            skip_tile_cap=64,
+        )
+        with pytest.warns(UserWarning, match="forces the tiled kernel"):
+            be = Backend(p)
+        dev = be.put(b)
+        for _ in range(3):
+            dev, _ = be.run_turns(dev, 36)
+        snap = obs_metrics.REGISTRY.snapshot().to_dict()
+        assert snap["gauges"].get("backend.active_tiles") == 1.0
+        assert "backend.skip_fraction" in snap["gauges"]
+
+    def test_sharded_bitmap_is_board_global(self):
+        H, W = 4096, 4096
+        b = np.zeros((H, W), np.uint8)
+        for dy, dx in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+            b[3000 + dy, 600 + dx] = 255
+        p = Params(
+            image_width=W,
+            image_height=H,
+            turns=10**6,
+            engine="pallas-packed",
+            mesh_shape=(2, 1),
+            skip_stable=True,
+            skip_tile_cap=512,
+            metrics=False,
+        )
+        be = Backend(p)
+        dev = be.put(b)
+        for _ in range(3):
+            dev, _ = be.run_turns(dev, 36)
+        bm = be.activity_bitmap()
+        assert bm is not None and bm.shape == (8,)  # 2 devices x 4 stripes
+        rows = be.activity_tile_rows()
+        assert rows == 512
+        # The glider lives near row 3000 -> stripe 5 (device 1, local 1).
+        assert bm[3000 // rows]
+        assert not bm[0]
+
+
+class TestDeltaCodec:
+    def test_bands_roundtrip_and_untouched_rows(self):
+        prev = soup(64, 40, seed=2)
+        new = prev.copy()
+        new[17, 3] ^= 255
+        new[40:44, 10:20] ^= 255
+        bands = frames_lib.delta_bands(prev, new)
+        ys = [y for y, _ in bands]
+        assert ys == [16, 40], "8-row bands covering exactly the changes"
+        buf = prev.copy()
+        frames_lib.apply_bands(buf, bands)
+        assert np.array_equal(buf, new)
+
+    def test_identical_frames_empty_delta(self):
+        f = soup(32, 32, seed=3)
+        assert frames_lib.delta_bands(f, f.copy()) == ()
+
+    def test_window_applies_deltas_in_place_without_touching_others(self):
+        pytest.importorskip("pygame")
+        from distributed_gol_tpu.viewer.window import Window
+
+        w = Window(32, 32)
+        try:
+            base = np.zeros((32, 32), np.uint8)
+            w.set_frame(base)
+            # Poison the buffer rows OUTSIDE the band with a sentinel the
+            # engine never produces; an apply that rewrites the whole
+            # frame (the round-5 set_frame path) would erase it.
+            w._pixels[:] = 7
+            rows = np.full((8, 32), 255, np.uint8)
+            w.apply_delta(((8, rows),))
+            assert np.array_equal(w._pixels[8:16], rows)
+            assert (w._pixels[:8] == 7).all() and (w._pixels[16:] == 7).all(), (
+                "unchanged-tile rows must not be touched"
+            )
+            # set_frame must COPY: mutating the window buffer afterwards
+            # must not reach back into the producer's array.
+            w.set_frame(base)
+            w._pixels[0, 0] = 99
+            assert base[0, 0] == 0
+        finally:
+            w.destroy()
+
+
+class TestROIViewerRun:
+    """The 200-turn soup proof: the delta stream reconstructs frames
+    bit-identical to the dense crop oracle at every rendered turn."""
+
+    @pytest.mark.slow
+    def test_delta_stream_reconstructs_dense_frames_200_turns(
+        self, tmp_path
+    ):
+        self._roi_run(tmp_path, turns=200)
+
+    def test_delta_stream_reconstructs_dense_frames(self, tmp_path):
+        # The tier-1-sized form of the 200-turn soup proof (same code
+        # path, shorter run).
+        self._roi_run(tmp_path, turns=40)
+
+    def _roi_run(self, tmp_path, turns):
+        img = tmp_path / "images"
+        img.mkdir()
+        size = 128
+        board = soup(size, size, seed=9)
+        write_pgm(img / f"{size}x{size}.pgm", board)
+        rect = (100, 100, 64, 64)  # wraps both axes
+        p = Params(
+            turns=turns,
+            image_width=size,
+            image_height=size,
+            no_vis=False,
+            viewport=rect,
+            frame_stride=1,
+            images_dir=img,
+            out_dir=tmp_path,
+            engine="roll",
+            metrics=False,
+        )
+        assert p.wants_frames() and p.frame_deltas_enabled()
+        ev = queue.Queue()
+        gol.run(p, ev)
+        # Oracle: independent roll-stencil evolution + toroidal crop.
+        table = jnp.asarray(CONWAY.table)
+        b = jnp.asarray(board)
+        oracle = {0: board}
+        for t in range(1, turns + 1):
+            b = stencil.step(b, table)
+            oracle[t] = np.asarray(b)
+        buf = None
+        frames = []
+        deltas = keyframes = 0
+        while True:
+            e = ev.get()
+            if e is None:
+                break
+            if isinstance(e, FrameReady):
+                keyframes += 1
+                buf = np.array(e.frame, copy=True)
+                assert e.rect == rect
+                frames.append((e.completed_turns, buf.copy()))
+            elif isinstance(e, FrameDelta):
+                deltas += 1
+                frames_lib.apply_bands(buf, e.bands)
+                frames.append((e.completed_turns, buf.copy()))
+        assert len(frames) == turns + 1  # initial keyframe + one per turn
+        assert keyframes == 2 and deltas == turns - 1
+        for t, f in frames:
+            want = (crop(oracle[t], rect) != 0) * np.uint8(255)
+            assert np.array_equal(f, want), f"turn {t}"
+
+    def test_full_board_frame_stream_unchanged_without_viewport(
+        self, tmp_path
+    ):
+        # No viewport => deltas stay off and the stream is the round-5
+        # FrameReady-per-turn contract, byte for byte.
+        img = tmp_path / "images"
+        img.mkdir()
+        size = 2048  # above _FLIP_VIEW_MAX_CELLS => frame mode
+        board = np.zeros((size, size), np.uint8)
+        board[0:2, 0:2] = 255
+        write_pgm(img / f"{size}x{size}.pgm", board)
+        p = Params(
+            turns=3,
+            image_width=size,
+            image_height=size,
+            no_vis=False,
+            view_mode="frame",
+            frame_stride=1,
+            images_dir=img,
+            out_dir=tmp_path,
+            engine="roll",
+            metrics=False,
+        )
+        assert not p.frame_deltas_enabled()
+        ev = queue.Queue()
+        gol.run(p, ev)
+        kinds = []
+        while True:
+            e = ev.get()
+            if e is None:
+                break
+            kinds.append(type(e).__name__)
+        assert "FrameDelta" not in kinds
+        assert kinds.count("FrameReady") == 4  # initial + one per turn
+
+
+class TestViewportStrideProbe:
+    def test_probe_measures_viewport_fetch_path(self, tmp_path, monkeypatch):
+        """ISSUE 11 satellite: with ROI frames the auto-stride probe must
+        time the viewport-rect fetch, not the full-board pool."""
+        img = tmp_path / "images"
+        img.mkdir()
+        size = 128
+        write_pgm(img / f"{size}x{size}.pgm", soup(size, size, seed=4))
+        rect = (0, 0, 64, 64)
+        p = Params(
+            turns=4,
+            image_width=size,
+            image_height=size,
+            no_vis=False,
+            viewport=rect,
+            frame_stride=0,  # latency-adaptive: the probe runs
+            images_dir=img,
+            out_dir=tmp_path,
+            engine="roll",
+            metrics=False,
+        )
+        probed = []
+        orig = Backend.probe_frame_fetch
+
+        def spy(self, board, fy, fx, rect=None):
+            probed.append(rect)
+            return orig(self, board, fy, fx, rect=rect)
+
+        monkeypatch.setattr(Backend, "probe_frame_fetch", spy)
+        ev = queue.Queue()
+        gol.run(p, ev)
+        while ev.get() is not None:
+            pass
+        assert probed, "auto-stride must probe at viewer start"
+        assert all(r == rect for r in probed), (
+            "every probe must measure the viewport fetch path"
+        )
+
+    def test_zoom_reprobes_materially_resized_viewport(
+        self, tmp_path, monkeypatch
+    ):
+        img = tmp_path / "images"
+        img.mkdir()
+        size = 128
+        write_pgm(img / f"{size}x{size}.pgm", soup(size, size, seed=4))
+        p = Params(
+            turns=8,
+            image_width=size,
+            image_height=size,
+            no_vis=False,
+            viewport=(0, 0, 64, 64),
+            frame_stride=0,
+            images_dir=img,
+            out_dir=tmp_path,
+            engine="roll",
+            metrics=False,
+        )
+        probed = []
+        orig = Backend.probe_frame_fetch
+
+        def spy(self, board, fy, fx, rect=None):
+            probed.append(rect)
+            return orig(self, board, fy, fx, rect=rect)
+
+        monkeypatch.setattr(Backend, "probe_frame_fetch", spy)
+        keys = queue.Queue()
+        keys.put("+")  # zoom in: 64x64 -> 32x32, a 4x area change
+        ev = queue.Queue()
+        gol.run(p, ev, key_presses=keys)
+        while ev.get() is not None:
+            pass
+        sizes = {(r[2], r[3]) for r in probed}
+        assert (64, 64) in sizes, "the starting viewport was probed"
+        assert (32, 32) in sizes, (
+            "a material zoom must re-probe the new viewport size"
+        )
+
+    def test_pan_zoom_arithmetic(self):
+        from distributed_gol_tpu.engine.controller import Controller
+
+        p = Params(
+            image_width=256,
+            image_height=256,
+            turns=1,
+            no_vis=False,
+            viewport=(0, 0, 64, 64),
+            engine="roll",
+            metrics=False,
+        )
+        c = Controller(p, queue.Queue())
+        c._pan_zoom("d")
+        assert c._rect == [0, 32, 64, 64] and c._frame_keyframe
+        c._pan_zoom("x")
+        assert c._rect == [32, 32, 64, 64]
+        c._pan_zoom("a")
+        c._pan_zoom("w")
+        assert c._rect == [0, 0, 64, 64]
+        c._pan_zoom("w")  # wraps the torus
+        assert c._rect == [224, 0, 64, 64]
+        c._rect = [0, 0, 64, 64]
+        c._pan_zoom("+")
+        assert c._rect == [16, 16, 32, 32] and c._rect_resized
+        c._pan_zoom("-")
+        assert c._rect == [0, 0, 64, 64]
+        c._pan_zoom("-")  # zoom out clamps at the board
+        c._pan_zoom("-")
+        assert c._rect[2:] == [256, 256]
+        # Zoom-in floor (review finding): '+' never GROWS a sub-16 rect
+        # and never mints a rect larger than a small board.
+        c._rect = [0, 0, 8, 8]
+        c._pan_zoom("+")
+        assert c._rect[2:] == [8, 8]
+        p_small = Params(
+            image_width=8,
+            image_height=8,
+            turns=1,
+            no_vis=False,
+            viewport=(0, 0, 8, 8),
+            engine="roll",
+            metrics=False,
+        )
+        cs = Controller(p_small, queue.Queue())
+        cs._pan_zoom("+")
+        assert cs._rect[2:] == [8, 8], "zoom must not exceed the board"
+
+
+class TestCyclicBound:
+    def test_interior_union(self):
+        assert _cyclic_bound([(10, 20), (40, 10)], 100) == (10, 40)
+
+    def test_wrapping_union_shorter_than_interior(self):
+        # Rects at both edges: the wrap-crossing window is shortest.
+        y0, ext = _cyclic_bound([(90, 8), (2, 8)], 100)
+        assert (y0, ext) == (90, 20)
+
+    def test_spread_covers_with_one_window(self):
+        # Three spread rects: one 70-row window still covers them all.
+        assert _cyclic_bound([(0, 10), (30, 10), (60, 10)], 90) == (0, 70)
+
+    def test_spread_degrades_to_full_axis(self):
+        # No window shorter than the ring covers these; one full-axis
+        # fetch (still ONE fetch, never two) is the degradation.
+        assert _cyclic_bound([(0, 30), (30, 30), (60, 30)], 90) == (0, 90)
+
+    def test_single(self):
+        assert _cyclic_bound([(95, 10)], 100) == (95, 10)
+
+
+class TestFramePlaneFanOut:
+    def _serve(self, n_subs, turns=4, size=256, seed=13):
+        from distributed_gol_tpu.obs import metrics as obs_metrics
+
+        rng = np.random.default_rng(seed)
+        b = soup(size, size, seed=seed)
+        p = Params(
+            image_width=size, image_height=size, turns=10, engine="roll",
+        )
+        be = Backend(p)
+        dev = be.put(b)
+        plane = FramePlane(board_shape=(size, size))
+        subs = [
+            plane.subscribe(
+                (
+                    int(rng.integers(0, size)),
+                    int(rng.integers(0, size)),
+                    64,
+                    64,
+                ),
+                maxsize=turns + 1,
+            )
+            for _ in range(n_subs)
+        ]
+        reg = obs_metrics.REGISTRY
+        fetches0 = reg.counter("frames.fetches").value
+        for turn in range(1, turns + 1):
+            dev, _ = be.run_turns(dev, 1)
+            stats = plane.publish(turn, lambda r: be.fetch_viewport(dev, r))
+            assert stats["subscribers"] == n_subs
+        fetches = reg.counter("frames.fetches").value - fetches0
+        return be, dev, subs, fetches, turns
+
+    @pytest.mark.parametrize("n_subs", [1, 8, 32])
+    def test_one_fetch_per_frame_any_subscriber_count(self, n_subs):
+        be, dev, subs, fetches, turns = self._serve(n_subs)
+        assert fetches == turns, "fetches/frame == 1 regardless of N"
+        full = be.fetch(dev)
+        size = full.shape[0]
+        for s in subs:
+            got = s.reconstruct()
+            want = (crop(full, s.rect) != 0) * np.uint8(255)
+            assert np.array_equal(got, want)
+
+    def test_mid_stream_viewport_change_rekeyframes(self):
+        size = 128
+        b = soup(size, size, seed=21)
+        p = Params(
+            image_width=size, image_height=size, turns=10, engine="roll",
+            metrics=False,
+        )
+        be = Backend(p)
+        dev = be.put(b)
+        plane = FramePlane(board_shape=(size, size))
+        sub = plane.subscribe((0, 0, 32, 32), maxsize=16)
+        plane.publish(1, lambda r: be.fetch_viewport(dev, r))
+        plane.set_viewport(sub, (50, 50, 48, 48))
+        plane.publish(2, lambda r: be.fetch_viewport(dev, r))
+        evs = []
+        while True:
+            try:
+                evs.append(sub.events.get_nowait())
+            except queue.Empty:
+                break
+        assert [type(e).__name__ for e in evs] == ["FrameReady", "FrameReady"]
+        full = be.fetch(dev)
+        want = (crop(full, (50, 50, 48, 48)) != 0) * np.uint8(255)
+        assert np.array_equal(np.asarray(evs[-1].frame), want)
+
+    def test_slow_subscriber_drops_oldest_then_rekeyframes(self):
+        size = 64
+        b = soup(size, size, seed=22)
+        p = Params(
+            image_width=size, image_height=size, turns=64, engine="roll",
+            metrics=False,
+        )
+        be = Backend(p)
+        dev = be.put(b)
+        plane = FramePlane(board_shape=(size, size))
+        sub = plane.subscribe((0, 0, 32, 32), maxsize=2)
+        for turn in range(1, 8):
+            dev, _ = be.run_turns(dev, 1)
+            plane.publish(turn, lambda r: be.fetch_viewport(dev, r))
+        # The consumer fell 5 frames behind; reconstruction must still
+        # converge because a drop forces the next ship to keyframe.
+        got = sub.reconstruct()
+        full = be.fetch(dev)
+        want = (crop(full, sub.rect) != 0) * np.uint8(255)
+        assert np.array_equal(got, want)
+
+    def test_reconstruct_skips_deltas_whose_keyframe_was_evicted(self):
+        # Drop-oldest can evict the anchoring keyframe while its deltas
+        # survive; reconstruct must skip the orphans (review finding),
+        # not crash applying bands to a None buffer.
+        size = 64
+        b = soup(size, size, seed=24)
+        p = Params(
+            image_width=size, image_height=size, turns=64, engine="roll",
+            metrics=False,
+        )
+        be = Backend(p)
+        dev = be.put(b)
+        plane = FramePlane(board_shape=(size, size))
+        sub = plane.subscribe((0, 0, 32, 32), maxsize=3)
+        for turn in range(1, 6):
+            dev, _ = be.run_turns(dev, 1)
+            plane.publish(turn, lambda r: be.fetch_viewport(dev, r))
+        # maxsize 3 with 5 ships: the turn-1 keyframe was evicted and the
+        # queue leads with orphan deltas; the post-drop re-keyframe then
+        # converges the stream.
+        got = sub.reconstruct()
+        full = be.fetch(dev)
+        want = (crop(full, sub.rect) != 0) * np.uint8(255)
+        assert np.array_equal(got, want)
+
+    def test_unbound_publish_refuses(self):
+        plane = FramePlane()
+        plane.subscribe((0, 0, 8, 8))
+        with pytest.raises(ValueError, match="unbound"):
+            plane.publish(1, lambda r: np.zeros((8, 8), np.uint8))
+
+    def test_controller_attached_plane_publishes_each_rendered_turn(
+        self, tmp_path
+    ):
+        from distributed_gol_tpu.obs import metrics as obs_metrics
+
+        img = tmp_path / "images"
+        img.mkdir()
+        size = 128
+        board = soup(size, size, seed=23)
+        write_pgm(img / f"{size}x{size}.pgm", board)
+        p = Params(
+            turns=5,
+            image_width=size,
+            image_height=size,
+            no_vis=False,
+            viewport=(0, 0, 64, 64),
+            frame_stride=1,
+            images_dir=img,
+            out_dir=tmp_path,
+            engine="roll",
+        )
+        plane = FramePlane()
+        subs = [plane.subscribe((i * 16, i * 8, 32, 32), maxsize=8) for i in range(3)]
+        reg = obs_metrics.REGISTRY
+        fetches0 = reg.counter("frames.fetches").value
+        ev = queue.Queue()
+        gol.run(p, ev, frame_plane=plane)
+        final = None
+        while True:
+            e = ev.get()
+            if e is None:
+                break
+            if isinstance(e, FinalTurnComplete):
+                final = e
+        assert final is not None and final.completed_turns == 5
+        assert reg.counter("frames.fetches").value - fetches0 == 5
+        # Every spectator's reconstruction equals the final board's crop.
+        final_np = np.zeros((size, size), np.uint8)
+        for c in final.alive:
+            final_np[c.y, c.x] = 255
+        for s in subs:
+            got = s.reconstruct()
+            want = (crop(final_np, s.rect) != 0) * np.uint8(255)
+            assert np.array_equal(got, want)
+
+
+class TestParamsViewport:
+    def test_viewport_forces_frame_mode_any_board_size(self):
+        p = Params(
+            image_width=512, image_height=512, no_vis=False,
+            viewport=(0, 0, 128, 128),
+        )
+        assert p.wants_frames() and not p.wants_flips()
+        assert p.frame_factors() == (1, 1)  # viewport fits frame_max
+
+    def test_viewport_validation(self):
+        with pytest.raises(ValueError, match="does not fit"):
+            Params(image_width=64, image_height=64, viewport=(0, 0, 65, 64))
+        with pytest.raises(ValueError, match="y0, x0"):
+            Params(image_width=64, image_height=64, viewport=(0, 0, 64))
+
+    def test_frame_deltas_resolution(self):
+        assert not Params().frame_deltas_enabled()
+        assert Params(
+            image_width=64, image_height=64, viewport=(0, 0, 32, 32)
+        ).frame_deltas_enabled()
+        assert Params(frame_deltas=True).frame_deltas_enabled()
+        assert not Params(
+            image_width=64,
+            image_height=64,
+            viewport=(0, 0, 32, 32),
+            frame_deltas=False,
+        ).frame_deltas_enabled()
+
+    def test_viewport_pooling_factors(self):
+        p = Params(
+            image_width=16384,
+            image_height=16384,
+            no_vis=False,
+            viewport=(0, 0, 1024, 1024),
+        )
+        # The viewport pools into frame_max, not the board.
+        assert p.frame_factors() == (2, 2)

@@ -57,10 +57,22 @@ class TestWindow:
     def test_poll_keys_maps_spqk_and_quit(self):
         w = Window(8, 8)
         for key in (pygame.K_s, pygame.K_p, pygame.K_q, pygame.K_k,
-                    pygame.K_x):  # x: not a binding, must be ignored
+                    pygame.K_z):  # z: not a binding, must be ignored
             pygame.event.post(pygame.event.Event(pygame.KEYDOWN, key=key))
         pygame.event.post(pygame.event.Event(pygame.QUIT))
         assert w.poll_keys() == ["s", "p", "q", "k", "q"]
+
+    def test_poll_keys_maps_viewport_pan_zoom(self):
+        # ISSUE 11: letters/arrows pan, +/- zoom — the same chars the
+        # terminal keyboard forwards (ignored by non-viewport runs).
+        w = Window(8, 8)
+        for key in (pygame.K_a, pygame.K_d, pygame.K_w, pygame.K_x,
+                    pygame.K_LEFT, pygame.K_RIGHT, pygame.K_UP,
+                    pygame.K_DOWN, pygame.K_EQUALS, pygame.K_MINUS):
+            pygame.event.post(pygame.event.Event(pygame.KEYDOWN, key=key))
+        assert w.poll_keys() == [
+            "a", "d", "w", "x", "a", "d", "w", "x", "+", "-",
+        ]
         w.destroy()
 
 

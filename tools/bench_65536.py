@@ -141,7 +141,7 @@ def main():
     if args.skip_stable:
         # One stats dispatch at the SAME depth as the timed runs, so the
         # recorded fraction describes the benchmarked launch plan.
-        _, skipped = superstep(b, args.kturns)
+        _, skipped, _act = superstep(b, args.kturns)
         total = pallas_packed.adaptive_tile_launches(
             b.shape, args.kturns, pallas_packed.default_skip_cap(b.shape[0])
         )

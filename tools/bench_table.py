@@ -59,6 +59,13 @@ def main():
                     "the batched-cohort pod (ISSUE 8) and render the "
                     "batched-vs-solo columns — launches per superstep, "
                     "cohort sizes, aggregate scaling factor")
+    ap.add_argument("--frames", action="store_true",
+                    help="also run bench.bench_frames (ISSUE 11) and "
+                    "render the spectator-streaming A/B: full-board vs "
+                    "viewport-rect frame fetch (bytes/frame, fetch "
+                    "latency) plus the FramePlane fan-out row")
+    ap.add_argument("--frames-viewport", type=int, default=1024,
+                    metavar="V", help="viewport side for --frames")
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -107,6 +114,13 @@ def main():
         from bench import bench_faults
 
         print_faults_table(bench_faults(sizes[0], args.faults))
+
+    if args.frames:
+        from bench import bench_frames
+
+        print_frames_table(
+            bench_frames(sizes[-1], viewport=args.frames_viewport)
+        )
 
     if args.serve and args.batched:
         from bench import bench_serve_batched
@@ -163,6 +177,44 @@ def main():
                 f"| {size}² | {label} | {gps:,.0f} | {spread} | {reps} | "
                 f"{ratio} | {cache} | {retries} | {skip} |"
             )
+
+
+def print_frames_table(rec: dict) -> None:
+    """Render a ``bench.bench_frames`` record (ISSUE 11) as markdown:
+    the full-board vs viewport-rect frame-fetch A/B (board bytes read,
+    wire bytes, frames/s with spread) and the fan-out row proving one
+    device fetch per published frame whatever the subscriber count."""
+    from distributed_gol_tpu.utils import measure
+
+    measure.require_headline_stats(rec)
+    size, vp = rec["size"], rec["viewport"]
+    print()
+    print(
+        "| Frame path | board bytes/frame | wire bytes | frames/s "
+        "(median) | spread | reps |"
+    )
+    print("|---|---|---|---|---|---|")
+    for label, row in (
+        (f"{size}² full-board", rec["full_frame"]),
+        (f"{size}² viewport {vp}²", rec["roi_frame"]),
+    ):
+        print(
+            f"| {label} | {row['board_bytes_read']:,} | "
+            f"{row['wire_bytes']:,} | {row['median']:,.1f} | "
+            f"{row['spread']:.1%} | {row['reps']} |"
+        )
+    fan = rec["fanout"]
+    pub = fan["publish"]
+    print(
+        f"| fan-out ({fan['subscribers']} subscribers) | — | — | "
+        f"{pub['median']:,.1f} publishes/s | {pub['spread']:.1%} | "
+        f"{pub['reps']} |"
+    )
+    print(
+        f"\nboard-bytes ratio x{rec['bytes_ratio']:.0f}, frame-latency "
+        f"ratio x{rec['latency_ratio']:.2f}, fetches/frame "
+        f"{fan['fetches_per_frame']:.2f} (identity: {rec['identity']})"
+    )
 
 
 def print_faults_table(rec: dict) -> None:

@@ -30,7 +30,7 @@ def main(size=16384, dispatches=4, kturns=1008):
     a, p = board, board
     for i in range(dispatches):
         t0 = time.perf_counter()
-        a, skipped = adaptive(a, kturns)
+        a, skipped, _act = adaptive(a, kturns)
         _sync(a)
         dt = time.perf_counter() - t0
         total = pallas_packed.adaptive_tile_launches(

@@ -190,7 +190,7 @@ def decompose(
     _sync(board)
     log(f"  settled compile+first dispatch: {time.perf_counter() - t0:.1f}s")
     board, settled = _quiet_row(run, board, kt, reps, target_seconds, device_reps)
-    _, skipped = run_s(board, kt)
+    _, skipped, _act = run_s(board, kt)
     total = pp.adaptive_tile_launches(shape, kt, cap)
     skip_frac = int(skipped) / total if total else None
     active = (1.0 - skip_frac) * grid if skip_frac is not None else None
@@ -259,7 +259,7 @@ def decompose(
         b2 = rc(board, kt)
         _sync(b2)
         b2, st = _quiet_row(rc, b2, kt, reps, target_seconds, device_reps)
-        _, sk = run_c(b2, kt)
+        _, sk, _act = run_c(b2, kt)
         tot = pp.adaptive_tile_launches(shape, kt, c)
         cap_rows[str(c)] = {
             "metric": f"gol_decompose_{size}_cap{c}",

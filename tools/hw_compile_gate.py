@@ -132,6 +132,41 @@ def _configs():
             jax.jit(call).lower(b, b).compile()
         return lower
 
+    def viewport_fetch(size, vh, vw, turns):
+        """The ROI frame programs (ISSUE 11): the fused superstep +
+        toroidal rect extract + pool + bit-pack viewer dispatch and the
+        bare viewport fetch, at a headline board with a 1024² viewport.
+        XLA lowerings (gather + packbits around the engine superstep),
+        but the superstep inside IS the adaptive megakernel — the gate
+        proves the composition lowers on real hardware at sizes the
+        hermetic suite cannot hold in memory."""
+        def lower():
+            from distributed_gol_tpu.ops import stencil
+
+            run = pp.make_superstep_bytes(CONWAY, skip_stable=True)
+
+            @jax.jit
+            def vframe(b, yy, xx):
+                nb = run(b, turns)
+                sub = stencil.viewport(nb, yy, xx, vh, vw)
+                pooled = stencil.frame_pool(sub, 2, 2)
+                return nb, stencil.alive_count(nb), jnp.packbits(
+                    pooled != 0, axis=-1
+                )
+
+            board = jax.ShapeDtypeStruct((size, size), jnp.uint8)
+            i32 = jax.ShapeDtypeStruct((), jnp.int32)
+            vframe.lower(board, i32, i32).compile()
+
+            @jax.jit
+            def vfetch(b, yy, xx):
+                return jnp.packbits(
+                    stencil.viewport(b, yy, xx, vh, vw) != 0, axis=-1
+                )
+
+            vfetch.lower(board, i32, i32).compile()
+        return lower
+
     def batched_vmem(nboards, size, turns):
         """The leading-axis batched VMEM-resident kernel at a serving-
         class board size: grid (B,), blocked 3-D specs."""
@@ -220,6 +255,13 @@ def _configs():
             )
         # One plain strip form per size covers the non-adaptive sharded path.
         cfgs.append((f"strip {(size // 4, wp)} plain T=16", strip("plain", (size // 4, wp), 16)))
+        # ROI viewport-fetch programs (ISSUE 11) at both headline sizes:
+        # the spectator-streaming dispatch must lower wrapped around the
+        # same adaptive engine the headline rows gate.
+        cfgs.append(
+            (f"{size}^2 viewport-fetch 1024^2 T={t_f}",
+             viewport_fetch(size, 1024, 1024, t_f))
+        )
     # The serving plane's cohort workhorse: a 16-board batch of 512²
     # VMEM-resident boards in one launch (ISSUE 8).
     cfgs.append(("batched B=16 512^2 vmem-resident T=50", batched_vmem(16, 512, 50)))
