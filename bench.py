@@ -1703,6 +1703,59 @@ def main():
     print(json.dumps(record))
 
 
+def bench_telemetry_overhead(
+    size: int = 256,
+    budget_seconds: float = 2.0,
+    reps: int = 3,
+    sample_seconds: float = 0.05,
+) -> dict:
+    """The ISSUE-12 sampler-overhead arm: interleaved A/B controller-path
+    reps with the TelemetrySampler off vs ON at an aggressive cadence
+    (20 Hz — 20x the production default, so the pilot-scale number
+    UPPER-bounds real deployments).  Interleaving is the bench_faults
+    methodology: background-load drift on a shared rig hits both arms
+    alike, and the verdict tolerance is each arm's own measured rep
+    envelope (floored at 30% for quiet-rig runs where both envelopes
+    land tiny — the same floor the metrics-overhead test uses)."""
+    from distributed_gol_tpu.obs.timeseries import TelemetrySampler
+    from distributed_gol_tpu.utils import measure
+
+    off_rates, on_rates = [], []
+    for _ in range(reps):
+        gps, _ = bench_controller_path(
+            size, budget_seconds=budget_seconds, superstep=256
+        )
+        if gps > 0:
+            off_rates.append(gps)
+        sampler = TelemetrySampler(interval=sample_seconds).start()
+        try:
+            gps, _ = bench_controller_path(
+                size, budget_seconds=budget_seconds, superstep=256
+            )
+        finally:
+            sampler.stop()
+        if gps > 0:
+            on_rates.append(gps)
+    if not off_rates or not on_rates:
+        return {"error": "no surviving reps", "off": off_rates, "on": on_rates}
+    off = measure.summarize(off_rates)
+    on = measure.summarize(on_rates)
+    envelope = off["spread"] + on["spread"]
+    tolerance = max(0.3, envelope)
+    rel = abs(on["median"] - off["median"]) / off["median"]
+    return {
+        "metric": f"gol_telemetry_overhead_pilot_{size}x{size}",
+        "unit": "generations/sec",
+        "value": round(on["median"], 2),
+        **on,
+        "sampler_off": off,
+        "sample_seconds": sample_seconds,
+        "overhead_rel": round(rel, 4),
+        "tolerance": round(tolerance, 4),
+        "within_rep_spread": rel <= tolerance,
+    }
+
+
 def pilot_record(dev) -> dict:
     """``--pilot``: the whole record shape — engine row with quiet stats,
     controller-path row, bit-identity — at toy scale (256², fixed shallow
@@ -1747,6 +1800,11 @@ def pilot_record(dev) -> dict:
             "value": round(cp_gps, 2),
             **cp_stats,
         }
+    # Telemetry-overhead arm (ISSUE 12): sampler on vs off, interleaved,
+    # asserted within the rep spread by tier-1 (test_bench_pilot).
+    record["telemetry_overhead"] = bench_telemetry_overhead(
+        size, budget_seconds=2.0, reps=3
+    )
     ok = verify_engine(size, engine, turns=16)
     if ok is not None:
         record["bit_identical"] = ok

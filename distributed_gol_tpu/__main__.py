@@ -198,6 +198,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "records (dispatches, retries, watchdog fires, "
                          "checkpoints) and dump flight-<ts>.json next to the "
                          "checkpoint dir when a run dies; 0 disables")
+    ap.add_argument("--telemetry-port", type=int, default=None, metavar="PORT",
+                    help="continuous telemetry endpoints for this run "
+                         "(ISSUE 12): /metrics (OpenMetrics) and /healthz "
+                         "(JSON) on PORT (0 = an ephemeral port, published "
+                         "as the telemetry.endpoint info label), served "
+                         "bounded-time from the sampler's latest in-memory "
+                         "sample; needs --metrics (the default)")
+    ap.add_argument("--telemetry-sample-seconds", type=float, default=0.0,
+                    metavar="S",
+                    help="registry sampling cadence for the telemetry "
+                         "plane (0 = off unless --telemetry-port is set, "
+                         "which defaults the cadence to 1s)")
     # Multi-host: launch the same command on every host (the reference's
     # hand-launched broker/worker fleet, broker/broker.go:191-205); process
     # 0 is the controller, the rest are followers.
@@ -265,6 +277,7 @@ def params_from_args(args) -> Params:
         peer_heartbeat_seconds=args.peer_heartbeat,
         metrics=args.metrics,
         flight_recorder_depth=args.flight_recorder_depth,
+        telemetry_sample_seconds=args.telemetry_sample_seconds,
     )
 
 
@@ -330,6 +343,36 @@ def build_serve_parser() -> argparse.ArgumentParser:
                     "device launch per superstep advances every cohort "
                     "member — pair with an explicit --superstep so "
                     "tenants share a dispatch schedule")
+    # Continuous telemetry + SLOs (ISSUE 12; docs/API.md "Telemetry
+    # export").
+    ap.add_argument("--telemetry-port", type=int, default=None,
+                    metavar="PORT",
+                    help="expose /metrics (OpenMetrics), /healthz, and "
+                    "/slo on PORT (0 = ephemeral; the bound URL is "
+                    "printed to stderr) — bounded-time scrapes served "
+                    "from the pod sampler's latest sample")
+    ap.add_argument("--telemetry-sample-seconds", type=float, default=1.0,
+                    help="pod registry sampling cadence (the staleness "
+                    "bound of health/scrape responses); 0 disables the "
+                    "sampler and every health() takes a direct snapshot")
+    ap.add_argument("--slo-latency", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="per-tenant latency SLO: the configured "
+                    "percentile of dispatches must resolve within "
+                    "SECONDS (0 = no latency objective)")
+    ap.add_argument("--slo-latency-percentile", type=float, default=0.99)
+    ap.add_argument("--slo-error-rate", type=float, default=0.0,
+                    metavar="FRACTION",
+                    help="per-tenant error-rate SLO: at most FRACTION of "
+                    "dispatch attempts may fail (0 = no error objective)")
+    ap.add_argument("--slo-fast-window", type=float, default=60.0,
+                    metavar="SECONDS")
+    ap.add_argument("--slo-slow-window", type=float, default=300.0,
+                    metavar="SECONDS")
+    ap.add_argument("--slo-burn-threshold", type=float, default=2.0,
+                    help="burn-rate alert threshold: page when BOTH "
+                    "windows burn the error budget faster than this "
+                    "multiple of the sustainable pace")
     return ap
 
 
@@ -368,15 +411,25 @@ def serve_main(argv) -> int:
     if args.readopt and not args.checkpoint_root:
         ap.error("--readopt needs --checkpoint-root")
 
-    config = ServeConfig(
-        max_sessions=args.max_sessions,
-        max_queued=args.max_queued,
-        max_cells_per_session=args.max_cells,
-        max_total_cells=args.max_total_cells,
-        default_deadline_seconds=args.deadline,
-        drain_timeout_seconds=args.drain_timeout,
-        batched=args.batched,
-    )
+    try:
+        config = ServeConfig(
+            max_sessions=args.max_sessions,
+            max_queued=args.max_queued,
+            max_cells_per_session=args.max_cells,
+            max_total_cells=args.max_total_cells,
+            default_deadline_seconds=args.deadline,
+            drain_timeout_seconds=args.drain_timeout,
+            batched=args.batched,
+            telemetry_sample_seconds=args.telemetry_sample_seconds,
+            slo_latency_seconds=args.slo_latency,
+            slo_latency_percentile=args.slo_latency_percentile,
+            slo_error_rate=args.slo_error_rate,
+            slo_fast_window_seconds=args.slo_fast_window,
+            slo_slow_window_seconds=args.slo_slow_window,
+            slo_burn_threshold=args.slo_burn_threshold,
+        )
+    except ValueError as e:
+        ap.error(str(e))
 
     def tenant_params(name: str, w: int, h: int, turns: int) -> Params:
         return Params(
@@ -396,6 +449,15 @@ def serve_main(argv) -> int:
 
     plane = ServePlane(config, checkpoint_root=args.checkpoint_root)
     restore = plane.install()  # SIGTERM -> graceful drain
+    telemetry = None
+    if args.telemetry_port is not None:
+        from distributed_gol_tpu.serve.telemetry import serve_plane_telemetry
+
+        telemetry = serve_plane_telemetry(plane, port=args.telemetry_port)
+        print(
+            f"telemetry: {telemetry.url}/metrics /healthz /slo",
+            file=sys.stderr,
+        )
     try:
         if args.readopt:
             for name, info in plane.resumable_tenants().items():
@@ -426,6 +488,8 @@ def serve_main(argv) -> int:
         print(json.dumps({"health": plane.health(), "sessions": summary}))
     finally:
         restore()
+        if telemetry is not None:
+            telemetry.close()
         plane.close()
     bad = [h for h in handles if h.status == "failed"]
     return 1 if bad else 0
@@ -448,12 +512,34 @@ def main(argv=None) -> int:
     )
 
     if args.coordinator is not None:
+        # The telemetry endpoints are single-host for now (the sampler
+        # samples this process's registry only).
         return run_multihost(args, params, session)
 
+    if args.telemetry_port is not None:
+        if not args.metrics:
+            # gol.run gates the whole telemetry plane on the registry:
+            # say so instead of printing an endpoint that never binds.
+            print("telemetry disabled: --no-metrics", file=sys.stderr)
+        elif args.telemetry_port:
+            print(
+                f"telemetry: /metrics + /healthz on "
+                f"http://127.0.0.1:{args.telemetry_port}",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                "telemetry: /metrics + /healthz on an ephemeral port "
+                "(published as the telemetry.endpoint info label)",
+                file=sys.stderr,
+            )
     return _drive(
         args,
         params,
-        lambda events, keys, stop: start(params, events, keys, session, stop=stop),
+        lambda events, keys, stop: start(
+            params, events, keys, session, stop=stop,
+            telemetry_port=args.telemetry_port,
+        ),
     )
 
 

@@ -271,8 +271,14 @@ class Controller:
         flight=None,
         stop=None,
         frame_plane=None,
+        run_id: Optional[str] = None,
     ):
         self.params = params
+        # Correlation id (ISSUE 12): stamped on the terminal
+        # MetricsReport, every flight dump, and every checkpoint sidecar.
+        # The supervisor passes ONE id across all restart attempts of a
+        # logical run; unsupervised runs mint their own here.
+        self.run_id = run_id or metrics_lib.new_run_id(params.tenant)
         self.events = events
         self.key_presses = key_presses
         self.session = session if session is not None else default_session()
@@ -608,6 +614,9 @@ class Controller:
             self.metrics.counter(
                 f"faults.failures.{type(error).__name__}"
             ).inc()
+            # The per-tenant failure counter (ISSUE 12): what the SLO
+            # tracker's error-rate objective reads off the sampler ring.
+            self._dispatch_rec.record_failure()
             terminal = (
                 isinstance(error, DispatchTimeout)
                 or attempt > p.retry_limit
@@ -703,6 +712,10 @@ class Controller:
             # run's metrics-so-far, so a postmortem can read a crashed
             # run's telemetry off its last checkpoint.
             metrics=self._run_metrics() if self.params.metrics else None,
+            # Correlation stamp (ISSUE 12): joins this sidecar to the
+            # run's MetricsReport, flight dumps, and scrape series.
+            run_id=self.run_id,
+            tenant=self.params.tenant,
         )
 
     def _checkpoint_due(self, turn: int) -> bool:
@@ -1053,6 +1066,8 @@ class Controller:
                 error=str(exc),
                 turn=self._dispatch_rec.last_turn,
                 metrics=metrics,
+                run_id=self.run_id,
+                tenant=self.params.tenant,
             )
         except Exception:  # noqa: BLE001 — the abort must still propagate
             pass
@@ -1719,6 +1734,8 @@ class Controller:
                     turn,
                     snapshot=metrics_lib.aggregate_snapshots(snaps),
                     processes=len(snaps),
+                    run_id=self.run_id,
+                    tenant=self.params.tenant,
                 )
             )
         if self._outcome == "completed":

@@ -288,10 +288,18 @@ class MetricsReport(Event):
     process's snapshot through the broadcast seam, so ``processes``
     records how many were merged.  Excluded from equality like
     ``FrameReady.frame``: two reports compare by (turn, processes) — the
-    snapshot carries wall-clock values no two runs share."""
+    snapshot carries wall-clock values no two runs share.
+
+    ``run_id`` / ``tenant`` (ISSUE 12): the correlation stamp shared
+    with the run's flight dumps and checkpoint sidecars, so a scrape
+    series, a postmortem, and a resumed session can be joined offline.
+    Stable across supervisor restarts of one logical run; excluded from
+    equality like the snapshot."""
 
     snapshot: dict = field(default_factory=dict, compare=False)
     processes: int = 1
+    run_id: str = field(default="", compare=False)
+    tenant: str | None = field(default=None, compare=False)
 
 
 class _TurnRange:

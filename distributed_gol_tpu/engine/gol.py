@@ -34,6 +34,7 @@ def run(
     stop=None,
     backend_factory=None,
     frame_plane=None,
+    telemetry_port: Optional[int] = None,
 ) -> None:
     """Drive one whole simulation, blocking until the event stream ends.
 
@@ -53,32 +54,61 @@ def run(
     ``frame_plane`` (a ``serve.frames.FramePlane``, ISSUE 11) attaches a
     spectator fan-out hub: a frame-mode run publishes one coalesced
     viewport fetch per rendered turn to it, serving every subscriber's
-    rect + delta stream off that single device fetch."""
-    if params.restart_limit > 0:
-        from distributed_gol_tpu.engine.supervisor import supervise
+    rect + delta stream off that single device fetch.
 
-        supervise(
-            params,
-            events,
-            key_presses,
-            session,
-            backend,
-            backend_factory=backend_factory,
-            stop=stop,
-            frame_plane=frame_plane,
-        )
-    else:
-        if backend is None and backend_factory is not None:
-            backend = backend_factory(params, 0)
-        Controller(
-            params,
-            events,
-            key_presses,
-            session,
-            backend,
-            stop=stop,
-            frame_plane=frame_plane,
-        ).run()
+    ``telemetry_port`` (ISSUE 12) exposes the continuous telemetry plane
+    for this run: a ``TelemetrySampler`` (cadence
+    ``params.telemetry_sample_seconds``, default 1 s when unset) plus
+    stdlib HTTP ``/metrics`` + ``/healthz`` endpoints on that port
+    (0 = ephemeral) for the run's lifetime.  The sampler is armed HERE —
+    outside the supervisor's restart ladder — so it keeps sampling
+    through backend rebuilds; with ``telemetry_port=None`` a nonzero
+    ``params.telemetry_sample_seconds`` still arms the sampler alone
+    (ring + derived rates, no HTTP surface)."""
+    sampler = server = None
+    if params.metrics and (
+        telemetry_port is not None or params.telemetry_sample_seconds > 0
+    ):
+        from distributed_gol_tpu.obs.timeseries import TelemetrySampler
+
+        sampler = TelemetrySampler(
+            interval=params.telemetry_sample_seconds or 1.0
+        ).start()
+        if telemetry_port is not None:
+            from distributed_gol_tpu.serve.telemetry import run_telemetry
+
+            server = run_telemetry(sampler, port=telemetry_port)
+    try:
+        if params.restart_limit > 0:
+            from distributed_gol_tpu.engine.supervisor import supervise
+
+            supervise(
+                params,
+                events,
+                key_presses,
+                session,
+                backend,
+                backend_factory=backend_factory,
+                stop=stop,
+                frame_plane=frame_plane,
+            )
+        else:
+            if backend is None and backend_factory is not None:
+                backend = backend_factory(params, 0)
+            Controller(
+                params,
+                events,
+                key_presses,
+                session,
+                backend,
+                stop=stop,
+                frame_plane=frame_plane,
+            ).run()
+    finally:
+        if server is not None:
+            server.close()
+        if sampler is not None:
+            sampler.stop()
 
 
 def start(
@@ -90,6 +120,7 @@ def start(
     stop=None,
     backend_factory=None,
     frame_plane=None,
+    telemetry_port: Optional[int] = None,
 ) -> threading.Thread:
     """``go gol.Run(...)``: run in a daemon thread, return it."""
     t = threading.Thread(
@@ -103,6 +134,7 @@ def start(
             stop,
             backend_factory,
             frame_plane,
+            telemetry_port,
         ),
         name="gol-run",
         daemon=True,

@@ -268,6 +268,16 @@ class Params:
     # records, checkpoint sidecars, and flight records.  False swaps in
     # no-op instruments and suppresses the MetricsReport.
     metrics: bool = True
+    # Continuous telemetry sampling (ISSUE 12): a daemon thread snapshots
+    # the registry every N seconds into a bounded ring of timestamped
+    # samples (obs/timeseries.TelemetrySampler) — windowed rates and
+    # latency percentiles derive from consecutive samples, and the
+    # /metrics + /healthz endpoints serve the LATEST sample so a scrape
+    # is bounded-time whatever the device is doing.  0 (default)
+    # disables; ``gol.run(..., telemetry_port=...)`` arms it at a 1 s
+    # default cadence when this is 0.  The sampler outlives supervisor
+    # restarts (it is registry-scoped, armed outside the restart ladder).
+    telemetry_sample_seconds: float = 0.0
     # Crash flight recorder: a bounded in-memory ring of the last N
     # structured records (dispatches with timings, retries, watchdog
     # transitions, checkpoint commits, tier decisions).  Every terminal
@@ -399,6 +409,10 @@ class Params:
                 "sdc_check_every_turns must be <= checkpoint_every_turns "
                 "when both are set: a corruption must be caught before it "
                 "can be checkpointed"
+            )
+        if self.telemetry_sample_seconds < 0:
+            raise ValueError(
+                "telemetry_sample_seconds must be >= 0 (0 disables sampling)"
             )
         if self.flight_recorder_depth < 0:
             raise ValueError(

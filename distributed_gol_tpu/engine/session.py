@@ -60,6 +60,12 @@ class Checkpoint:
     # checkpoint (ISSUE 4): a crashed run's telemetry is readable off its
     # last sidecar.  Never consulted for resume; purely an artifact field.
     metrics: dict | None = None
+    # Correlation stamp (ISSUE 12): the parking run's run_id/tenant,
+    # shared with its MetricsReport and flight dumps so sidecar,
+    # postmortem, and scrape series join offline.  Artifact-only, never
+    # consulted for resume.
+    run_id: str | None = None
+    tenant: str | None = None
 
 
 class Session:
@@ -114,6 +120,8 @@ class Session:
         rule: str | None = None,
         keep: int = 3,
         metrics: dict | None = None,
+        run_id: str | None = None,
+        tenant: str | None = None,
     ):
         """Park a periodic (crash-recovery) checkpoint: the same resumable
         state a 'q' detach leaves, under a rotated ``checkpoint-<turn>``
@@ -125,7 +133,8 @@ class Session:
             prev = (self._paused, self._checkpoint, self._ckpt_name)
             self._paused = True
             self._checkpoint = Checkpoint(
-                np.asarray(world, dtype=np.uint8), turn, rule, metrics
+                np.asarray(world, dtype=np.uint8), turn, rule, metrics,
+                run_id, tenant,
             )
             self._ckpt_name = f"checkpoint-{turn:012d}"
             try:
@@ -321,6 +330,12 @@ class Session:
             # The run's telemetry rides the sidecar (ISSUE 4) — ignored by
             # resume negotiation, read by postmortem tooling.
             meta["metrics"] = self._checkpoint.metrics
+        if self._checkpoint.run_id is not None:
+            # Correlation stamp (ISSUE 12): same id as the run's
+            # MetricsReport and flight dumps; artifact-only.
+            meta["run_id"] = self._checkpoint.run_id
+        if self._checkpoint.tenant is not None:
+            meta["tenant"] = self._checkpoint.tenant
         self._write_json(self._meta_path, meta)
 
     @staticmethod

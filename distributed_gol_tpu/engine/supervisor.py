@@ -184,6 +184,11 @@ class Supervisor:
         self._elastic: Optional[tuple[Params, list]] = None
         self.flight = flight_lib.FlightRecorder(params.flight_recorder_depth)
         self.metrics = metrics_lib.registry_for(params.metrics)
+        # ONE correlation id for the whole supervised run (ISSUE 12):
+        # every restart attempt's controller stamps the same id, so the
+        # recovered run's MetricsReport, any flight dump, and every
+        # checkpoint sidecar across attempts join as one logical run.
+        self.run_id = metrics_lib.new_run_id(params.tenant)
         self._m_restarts = self.metrics.counter("supervisor.restarts")
         self._m_rollback = self.metrics.counter("supervisor.rollback_turns")
         #: One dict per restart: attempt, cause, from_turn, resume_turn,
@@ -389,6 +394,7 @@ class Supervisor:
                     flight=self.flight,
                     stop=self.stop,
                     frame_plane=self.frame_plane,
+                    run_id=self.run_id,
                 )
             except BaseException as e:
                 # A failed REBUILD (attempt >= 1) must still honour the

@@ -14,6 +14,19 @@ Three parts, wired through every hot and failure path of the engine:
   structured records that every terminal path dumps as
   ``flight-<ts>.json`` before the run dies (the postmortem artifact).
 
+Plus the continuous half (ISSUE 12), built on the registry:
+
+- :mod:`~distributed_gol_tpu.obs.timeseries` — the ``TelemetrySampler``
+  daemon: a bounded ring of timestamped registry snapshots with derived
+  windowed rates and histogram-delta percentiles (the time axis the
+  pull-on-demand artifacts lack).
+- :mod:`~distributed_gol_tpu.obs.openmetrics` — render any
+  ``gol-metrics-v1`` snapshot as OpenMetrics exposition text (and parse
+  it back; the ``/metrics`` wire format).
+- :mod:`~distributed_gol_tpu.obs.slo` — per-tenant SLO objectives,
+  multi-window burn-rate alerts, and error budgets evaluated over the
+  sampler ring.
+
 Everything degrades to a no-op: ``Params.metrics=False`` swaps in null
 instruments, ``Params.flight_recorder_depth=0`` disables the ring, and
 spans become ``nullcontext`` on profiler-less builds — exactly like

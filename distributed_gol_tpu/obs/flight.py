@@ -77,10 +77,14 @@ class FlightRecorder:
         error: str = "",
         turn: int = 0,
         metrics: dict | None = None,
+        run_id: str | None = None,
+        tenant: str | None = None,
     ) -> Path | None:
         """Write the postmortem ``flight-<ts>.json`` into ``directory``
         (created if needed).  Appends the terminal ``abort`` record first
-        so the tail always explains the abort.  Best-effort by contract:
+        so the tail always explains the abort.  ``run_id``/``tenant``
+        (ISSUE 12) stamp the correlation id shared with the run's
+        MetricsReport and checkpoint sidecars.  Best-effort by contract:
         a failing dump (ENOSPC, perms) returns None — the postmortem
         artifact must never mask the abort it is documenting."""
         if not self.depth:
@@ -94,6 +98,10 @@ class FlightRecorder:
             "written_at": round(time.time(), 6),
             "records": self.records(),
         }
+        if run_id:
+            doc["run_id"] = run_id
+        if tenant is not None:
+            doc["tenant"] = tenant
         if metrics is not None:
             doc["metrics"] = metrics
         try:

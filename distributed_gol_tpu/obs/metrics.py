@@ -165,6 +165,18 @@ class MetricsSnapshot:
         )
 
 
+def new_run_id(tenant: str | None = None) -> str:
+    """A fresh correlation id (ISSUE 12): stamped once per logical run
+    (stable across supervisor restarts) on the terminal
+    ``MetricsReport``, every flight dump, and every checkpoint sidecar,
+    so a scrape series, a postmortem, and a resumed session join
+    offline.  Tenant-prefixed for human-greppable artifacts."""
+    import uuid
+
+    suffix = uuid.uuid4().hex[:12]
+    return f"{tenant}-{suffix}" if tenant else suffix
+
+
 def labelled(name: str, tenant: str | None = None) -> str:
     """Instrument name carrying a ``tenant=`` label (ISSUE 6): the flat
     registry stays flat — a labelled instrument is just a distinct name,
@@ -393,6 +405,13 @@ class DispatchRecorder:
         self._g_qdepth = registry.gauge(
             labelled("controller.event_queue_depth", tenant)
         )
+        # Failed dispatch ATTEMPTS, tenant-labelled (ISSUE 12): beside
+        # the per-cause ``faults.failures.<Type>`` counters, this is the
+        # per-tenant series the SLO tracker's error-rate objective reads
+        # off the sampler ring.
+        self._c_failures = registry.counter(
+            labelled("controller.dispatch_failures", tenant)
+        )
         self.last_turn = 0  # the abort path's best known turn
 
     def record(self, turn: int, k: int, seconds: float) -> None:
@@ -409,6 +428,11 @@ class DispatchRecorder:
         self.last_turn = turn
         if self._emit_timing:
             self._emit(TurnTiming(turn, k, seconds))
+
+    def record_failure(self) -> None:
+        """One failed dispatch attempt (retried or terminal) — the
+        error-rate half of the per-tenant SLO inputs."""
+        self._c_failures.inc()
 
 
 # -- aggregation (the multihost seam's pure half) ------------------------------

@@ -14,6 +14,10 @@ from distributed_gol_tpu.serve.admission import (
 from distributed_gol_tpu.serve.batcher import CohortBatcher, cohort_key
 from distributed_gol_tpu.serve.frames import FramePlane, FrameSubscriber
 from distributed_gol_tpu.serve.plane import ServePlane, SessionHandle
+from distributed_gol_tpu.serve.telemetry import (
+    TelemetryServer,
+    serve_plane_telemetry,
+)
 
 __all__ = [
     "AdmissionController",
@@ -24,5 +28,7 @@ __all__ = [
     "ServeConfig",
     "ServePlane",
     "SessionHandle",
+    "TelemetryServer",
     "cohort_key",
+    "serve_plane_telemetry",
 ]

@@ -105,6 +105,39 @@ class ServeConfig:
     # >= 2 so a one-off stall (GC pause, first checkpoint fetch) does
     # not cost a healthy tenant its cohort.
     cohort_evict_misses: int = 2
+    # --- continuous telemetry + per-tenant SLOs (ISSUE 12; docs/API.md
+    # "Telemetry export") ---
+    # Sampling cadence of the pod's TelemetrySampler (obs/timeseries.py):
+    # every N seconds one registry snapshot lands in the bounded ring
+    # that backs health(), /metrics, /healthz, and the SLO windows.
+    # Staleness bound of everything served from it = this interval.
+    # 0 disables the sampler; health() then falls back to a direct
+    # (lazy-free) snapshot per call — the pre-ISSUE-12 cost profile.
+    telemetry_sample_seconds: float = 1.0
+    # Ring depth (samples retained) and the lazy-gauge cadence (every
+    # N-th tick also evaluates device-forcing callback gauges — skip
+    # fraction, cache stats; the fast ticks never touch a device).
+    telemetry_ring_depth: int = 600
+    telemetry_lazy_every: int = 10
+    # Per-tenant SLO objectives (obs/slo.py; 0 = that objective off).
+    # Latency: "slo_latency_percentile of dispatches resolve within
+    # slo_latency_seconds"; errors: "at most slo_error_rate of dispatch
+    # attempts fail".  Burn-rate alerts fire when BOTH windows burn
+    # above slo_burn_threshold; budgets track over the budget window.
+    # Arming any objective requires the sampler (the windows live on
+    # its ring), and the ring's span (telemetry_ring_depth ×
+    # telemetry_sample_seconds) must cover the slow window — the
+    # multi-window "a sustained burn can't hide" guarantee is only as
+    # long as the ring's memory.  The budget window is clamped to the
+    # ring span the same way (the defaults agree: 600 samples × 1 s =
+    # the 600 s budget window); size the ring up for longer budgets.
+    slo_latency_seconds: float = 0.0
+    slo_latency_percentile: float = 0.99
+    slo_error_rate: float = 0.0
+    slo_fast_window_seconds: float = 60.0
+    slo_slow_window_seconds: float = 300.0
+    slo_burn_threshold: float = 2.0
+    slo_budget_window_seconds: float = 600.0
 
     def __post_init__(self):
         if self.max_sessions < 1:
@@ -135,6 +168,52 @@ class ServeConfig:
             )
         if self.cohort_evict_misses < 1:
             raise ValueError("cohort_evict_misses must be >= 1")
+        if self.telemetry_sample_seconds < 0:
+            raise ValueError(
+                "telemetry_sample_seconds must be >= 0 (0 disables sampling)"
+            )
+        if self.telemetry_ring_depth < 2:
+            raise ValueError("telemetry_ring_depth must be >= 2")
+        if self.telemetry_lazy_every < 1:
+            raise ValueError("telemetry_lazy_every must be >= 1")
+        # The SLO field set validates as a unit (ranges, window ordering)
+        # and an armed objective REQUIRES the sampler: the burn windows
+        # live on its ring.
+        objectives = self.slo_objectives()
+        if objectives is not None:
+            if not self.telemetry_sample_seconds:
+                raise ValueError(
+                    "SLO objectives need the telemetry sampler: set "
+                    "telemetry_sample_seconds > 0"
+                )
+            span = self.telemetry_ring_depth * self.telemetry_sample_seconds
+            if span < self.slo_slow_window_seconds:
+                # A ring shorter than the slow window would silently
+                # turn the multi-window alert into a fast-window-only
+                # one — permanently, not as warm-up.  Refuse instead.
+                raise ValueError(
+                    f"sampler ring spans {span:g}s (telemetry_ring_depth x "
+                    f"telemetry_sample_seconds) but slo_slow_window_seconds "
+                    f"is {self.slo_slow_window_seconds:g}s: the slow burn "
+                    "window must fit the ring — raise the depth or shrink "
+                    "the window"
+                )
+
+    def slo_objectives(self):
+        """The validated :class:`obs.slo.SLOObjectives` this config arms,
+        or None when both objectives are off."""
+        from distributed_gol_tpu.obs.slo import SLOObjectives
+
+        objectives = SLOObjectives(
+            latency_seconds=self.slo_latency_seconds,
+            latency_percentile=self.slo_latency_percentile,
+            error_rate=self.slo_error_rate,
+            fast_window_seconds=self.slo_fast_window_seconds,
+            slow_window_seconds=self.slo_slow_window_seconds,
+            burn_threshold=self.slo_burn_threshold,
+            budget_window_seconds=self.slo_budget_window_seconds,
+        )
+        return objectives if objectives.enabled else None
 
 
 class AdmissionRejected(RuntimeError):

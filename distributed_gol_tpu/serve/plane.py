@@ -71,7 +71,10 @@ from distributed_gol_tpu.engine.events import (
 from distributed_gol_tpu.engine.params import Params
 from distributed_gol_tpu.engine.session import Session
 from distributed_gol_tpu.engine.supervisor import GracefulStop
+from distributed_gol_tpu.obs import flight as flight_lib
 from distributed_gol_tpu.obs import metrics as metrics_lib
+from distributed_gol_tpu.obs.slo import SLOTracker
+from distributed_gol_tpu.obs.timeseries import TelemetrySampler
 from distributed_gol_tpu.parallel import mesh as mesh_lib
 from distributed_gol_tpu.serve.admission import (
     ADMIT_RUN,
@@ -308,6 +311,25 @@ class ServePlane:
         self._g_resident.set(0)
         self._g_queued.set(0)
         self._g_cells.set(0)
+        # -- continuous telemetry + SLOs (ISSUE 12) --
+        # The plane-level flight ring: SLO alert transitions land here
+        # (``slo_alert``/``slo_resolved``), introspectable via
+        # ``plane.flight.records()`` — distinct from the per-session
+        # rings each controller dumps on ITS terminal path.
+        self.flight = flight_lib.FlightRecorder(256 if metrics else 0)
+        self.slo: SLOTracker | None = None
+        objectives = self.config.slo_objectives()
+        if metrics and objectives is not None:
+            self.slo = SLOTracker(objectives, self.metrics, self.flight)
+        self.sampler: TelemetrySampler | None = None
+        if metrics and self.config.telemetry_sample_seconds > 0:
+            self.sampler = TelemetrySampler(
+                registry=self.metrics,
+                interval=self.config.telemetry_sample_seconds,
+                depth=self.config.telemetry_ring_depth,
+                lazy_every=self.config.telemetry_lazy_every,
+                on_sample=self._on_sample,
+            ).start()
         # -- the asyncio control plane --
         self._loop = asyncio.new_event_loop()
         self._loop_thread = threading.Thread(
@@ -514,6 +536,19 @@ class ServePlane:
                 if nxt is not None:
                     promoted = self._handles.get(nxt[0])
             self._sync_gauges()
+            if self.sampler is not None:
+                # Terminal-event freshness tick, BEFORE waiters wake: a
+                # session just ended, so any health()/scrape issued after
+                # wait_idle returns must see its final counters
+                # (restarts, watchdog fires, outcome) without waiting
+                # out the sampling interval.  Steady-state cost stays
+                # one snapshot per interval — sessions ending is the
+                # cold path.  lazy=False is load-bearing: this runs
+                # under the plane lock health() also takes, so it must
+                # never land on a lazy-cadence tick whose callback
+                # gauges could block on the very wedged device the
+                # session just died of.
+                self.sampler.sample_now(lazy=False)
             self._state.notify_all()
         for t in evicted:
             self.metrics.clear_tenant(t)
@@ -524,6 +559,13 @@ class ServePlane:
         self._g_resident.set(len(self._admission.resident))
         self._g_queued.set(self._admission.queued)
         self._g_cells.set(self._admission.resident_cells)
+
+    def _own_counter(self, counter, name: str):
+        """Exact current value of a plane-owned counter relative to the
+        plane-start baseline (the registry is process-wide; a previous
+        plane's counts must not leak into this one's health)."""
+        base = self._metrics_start.data.get("counters", {}).get(name, 0)
+        return getattr(counter, "value", 0) - base
 
     # -- drain (leg 3) ---------------------------------------------------------
     def begin_drain(self, signum=None, frame=None) -> None:
@@ -621,6 +663,12 @@ class ServePlane:
                     return False
             return True
 
+    def _on_sample(self, sampler) -> None:
+        """The sampler's per-tick hook (sampler thread): evaluate the
+        SLO objectives over the refreshed ring."""
+        if self.slo is not None:
+            self.slo.observe(sampler)
+
     def close(self, timeout: float | None = None) -> None:
         """Drain, then tear the control plane down (idempotent)."""
         with self._lock:
@@ -629,6 +677,8 @@ class ServePlane:
         self.drain(timeout)
         with self._lock:
             self._closed = True
+        if self.sampler is not None:
+            self.sampler.stop()
         self._executor.shutdown(wait=False)
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._loop_thread.join(timeout=10)
@@ -641,7 +691,15 @@ class ServePlane:
         counters via their ``tenant=`` labels).  ``ready`` = this pod
         can admit work now; ``live`` = the control plane itself is
         healthy (a not-live pod should be ejected/restarted; a
-        not-ready-but-live pod is full or draining — route around it)."""
+        not-ready-but-live pod is full or draining — route around it).
+
+        With the sampler on (the default), the metrics half is read
+        from the sampler's LATEST sample — one registry snapshot per
+        sampling interval however often health is polled, values at
+        most ``telemetry_sample_seconds`` stale (the ``telemetry``
+        section publishes the actual age).  The per-call direct
+        snapshot survives only as the sampler-off fallback (the
+        pre-ISSUE-12 cost profile)."""
         devices_lost = mesh_lib.lost_device_count()
         with self._lock:
             self._admission.capacity_factor = mesh_lib.capacity_fraction()
@@ -657,11 +715,25 @@ class ServePlane:
             )
             statuses = {t: h.status for t, h in self._handles.items()}
             closed = self._closed
-        snap = (
-            self.metrics.snapshot(include_lazy=False)
-            .delta(self._metrics_start)
-            .to_dict()
-        )
+        latest = self.sampler.latest() if self.sampler is not None else None
+        if latest is not None:
+            snap = (
+                metrics_lib.MetricsSnapshot(latest.snapshot)
+                .delta(self._metrics_start)
+                .to_dict()
+            )
+            telemetry = {
+                "sampling": True,
+                "sample_age_seconds": round(self.sampler.staleness, 3),
+                "staleness_bound_seconds": self.sampler.interval,
+            }
+        else:
+            snap = (
+                self.metrics.snapshot(include_lazy=False)
+                .delta(self._metrics_start)
+                .to_dict()
+            )
+            telemetry = {"sampling": False}
         counters = snap.get("counters", {})
         tenants = {
             t: {
@@ -696,15 +768,29 @@ class ServePlane:
             },
             "watchdog_fires": counters.get("faults.watchdog_fires", 0),
             "supervisor_restarts": counters.get("supervisor.restarts", 0),
-            "sessions_parked": counters.get("serve.sessions_parked", 0),
-            "sessions_failed": counters.get("serve.sessions_failed", 0),
-            "rejected": counters.get("serve.rejected", 0),
+            # The plane's OWN admission/outcome counters read exactly
+            # (plain attribute reads on pre-bound instruments, minus the
+            # plane-start baseline) — a rejection is visible in the very
+            # next health() even between sampler ticks.
+            "sessions_parked": self._own_counter(
+                self._c_outcome["parked"], "serve.sessions_parked"
+            ),
+            "sessions_failed": self._own_counter(
+                self._c_outcome["failed"], "serve.sessions_failed"
+            ),
+            "rejected": self._own_counter(self._c_rejected, "serve.rejected"),
             # Batched-cohort surface (ISSUE 8): physical launch economics
             # a balancer (or the bench) reads straight off health.
             "batched": self.batcher is not None,
             "batched_launches": counters.get("serve.batched_launches", 0),
             "batched_boards": counters.get("serve.batched_boards", 0),
             "cohort_evictions": counters.get("serve.cohort_evictions", 0),
+            # Continuous-telemetry surface (ISSUE 12): how fresh the
+            # metrics half of this response is, and the per-tenant SLO
+            # table when objectives are armed.
+            "telemetry": telemetry,
+            "slo": self.slo.summary() if self.slo is not None else None,
+            "slo_alerts": counters.get("serve.slo_alerts", 0),
             "tenants": tenants,
         }
 

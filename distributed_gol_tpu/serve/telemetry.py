@@ -1,0 +1,188 @@
+"""Stdlib HTTP telemetry endpoints (ISSUE 12, layer 4).
+
+The reference system's ``Broker.CheckStates`` RPC is an external party
+asking a live pod "how are you doing, per run" over the network
+(PAPER.md §1); this is its rebuilt, scrape-shaped form — three
+endpoints on a tiny ``http.server`` daemon:
+
+- ``GET /metrics`` — the latest telemetry sample rendered as
+  OpenMetrics text (``obs/openmetrics.py``).
+- ``GET /healthz`` — the plane's ready/live JSON (HTTP 200 when ready,
+  503 when not — what a load balancer's health check consumes; the body
+  is the full health dict either way).
+- ``GET /slo`` — the per-tenant SLO table (404 when no objectives are
+  armed).
+
+**Bounded-time contract**: every response is computed from the
+sampler's latest in-memory sample (or, sampler off, a direct
+``include_lazy=False`` registry snapshot — plain dict copies under the
+registry lock).  No handler ever touches a device, takes a session
+lock, or waits on a dispatch, so a wedged device or hung tenant can
+never hang a scrape — the worst case is a stale sample, and the
+staleness itself is published (``telemetry.sample_age_seconds`` on
+``/healthz``).  Served from daemon threads
+(``ThreadingHTTPServer``), one per in-flight scrape.
+
+Entry points: ``TelemetryServer(...)`` directly,
+:func:`serve_plane_telemetry` for a ``ServePlane`` (the serve CLI's
+``--telemetry-port``), and :func:`run_telemetry` for a single
+``gol.run(..., telemetry_port=...)``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from distributed_gol_tpu.obs import metrics as metrics_lib
+from distributed_gol_tpu.obs import openmetrics
+
+
+class TelemetryServer:
+    """One pod's scrape surface.  ``port=0`` binds an ephemeral port
+    (read it back from :attr:`port` — the test spelling); ``host``
+    defaults to loopback, production pods pass ``"0.0.0.0"``."""
+
+    def __init__(
+        self,
+        metrics_fn: Callable[[], dict],
+        health_fn: Callable[[], dict],
+        slo_fn: Callable[[], dict] | None = None,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        registry=None,
+    ):
+        registry = registry if registry is not None else metrics_lib.REGISTRY
+        m_scrapes = registry.counter("telemetry.scrapes")
+
+        class Handler(BaseHTTPRequestHandler):
+            # A scrape surface must never block the pod's logs.
+            def log_message(self, fmt, *args):  # noqa: ARG002
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — http.server contract
+                m_scrapes.inc()
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    if path == "/metrics":
+                        text = openmetrics.render(metrics_fn())
+                        self._send(
+                            200,
+                            text.encode(),
+                            openmetrics.CONTENT_TYPE,
+                        )
+                    elif path == "/healthz":
+                        health = health_fn()
+                        code = 200 if health.get("ready", False) else 503
+                        self._send(
+                            code,
+                            json.dumps(health).encode(),
+                            "application/json",
+                        )
+                    elif path == "/slo" and slo_fn is not None:
+                        self._send(
+                            200,
+                            json.dumps(slo_fn()).encode(),
+                            "application/json",
+                        )
+                    else:
+                        self._send(404, b"not found\n", "text/plain")
+                except BrokenPipeError:
+                    pass  # scraper went away mid-response
+                except Exception as e:  # noqa: BLE001 — a scrape bug is a 500
+                    body = f"{type(e).__name__}: {e}\n".encode()
+                    try:
+                        self._send(500, body, "text/plain")
+                    except OSError:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = self._httpd.server_address[0]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="gol-telemetry-http",
+            daemon=True,
+        )
+        self._thread.start()
+        # Publish the bound address as an info label: with port=0 the
+        # ephemeral port is otherwise only knowable from inside, and a
+        # pod's own scrape address belongs in its telemetry anyway.
+        registry.info("telemetry.endpoint", self.url)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "TelemetryServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def serve_plane_telemetry(plane, port: int = 0, host: str = "127.0.0.1"):
+    """Attach the scrape surface to a ``ServePlane``: ``/metrics`` serves
+    the plane sampler's latest sample (falling back to a direct lazy-free
+    snapshot when the sampler is off), ``/healthz`` serves
+    ``plane.health()`` (itself sampler-backed, see the plane), ``/slo``
+    the SLO tracker's table when objectives are armed."""
+
+    def metrics_fn() -> dict:
+        sampler = plane.sampler
+        if sampler is not None:
+            latest = sampler.latest()
+            if latest is not None:
+                return latest.snapshot
+        return plane.metrics.snapshot(include_lazy=False).to_dict()
+
+    slo_fn = None
+    if plane.slo is not None:
+        slo_fn = plane.slo.summary
+    return TelemetryServer(
+        metrics_fn, plane.health, slo_fn, port=port, host=host,
+        registry=plane.metrics,
+    )
+
+
+def run_telemetry(sampler, port: int = 0, host: str = "127.0.0.1"):
+    """The single-run form (``gol.run(..., telemetry_port=...)``): the
+    run has no admission books, so ``/healthz`` reports liveness plus
+    the sampler-derived windowed rates — enough for a balancer to see
+    "this run is alive and computing"."""
+
+    def metrics_fn() -> dict:
+        latest = sampler.latest()
+        if latest is not None:
+            return latest.snapshot
+        return sampler.registry.snapshot(include_lazy=False).to_dict()
+
+    def health_fn() -> dict:
+        age = sampler.staleness
+        return {
+            "ready": True,
+            "live": True,
+            "sampling": sampler.running,
+            "sample_age_seconds": round(age, 3) if age != float("inf") else None,
+            "staleness_bound_seconds": sampler.interval,
+            "rates": sampler.derived(),
+        }
+
+    return TelemetryServer(
+        metrics_fn, health_fn, port=port, host=host, registry=sampler.registry
+    )

@@ -18,7 +18,7 @@ REPO = Path(__file__).resolve().parent.parent
 from distributed_gol_tpu.utils import measure  # noqa: E402
 
 
-def test_bench_pilot_record_shape():
+def test_bench_pilot_record_shape(tmp_path):
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
@@ -55,6 +55,23 @@ def test_bench_pilot_record_shape():
     snap = record["metrics"]
     assert obs_metrics.check_metrics_snapshot(snap) == []
     assert snap["counters"]["controller.dispatches"] >= 1
+    # Telemetry-overhead arm (ISSUE 12): the interleaved sampler-on/off
+    # A/B ran, carries full stats, and lands within the rep spread —
+    # the tier-1 proof of the sampler-overhead acceptance bar.
+    arm = record["telemetry_overhead"]
+    assert arm["sampler_off"]["median"] > 0 and arm["median"] > 0
+    assert arm["within_rep_spread"] is True, (
+        f"sampler overhead {arm['overhead_rel']:.1%} exceeds the "
+        f"measured rep envelope {arm['tolerance']:.1%} "
+        f"(on {arm['rates']}, off {arm['sampler_off']['rates']})"
+    )
+    # The record survives the bench gate against itself (zero drift),
+    # end to end through the CLI.
+    from tools import bench_gate
+
+    path = tmp_path / "pilot.json"
+    path.write_text(json.dumps(record))
+    assert bench_gate.main([str(path), str(path), "--quiet"]) == 0
 
 
 def test_decompose_pilot_record_shape():
@@ -129,6 +146,91 @@ def test_metrics_overhead_within_rep_spread():
         f"{rel:.1%} apart, tolerance {tol:.1%} "
         f"(off reps {off_rates}, on reps {on_rates})"
     )
+
+
+def _row(metric, median, spread, unit="generations/sec"):
+    return {
+        "metric": metric,
+        "value": median,
+        "unit": unit,
+        "reps": 3,
+        "median": median,
+        "spread": spread,
+        "rates": [median] * 3,
+    }
+
+
+class TestBenchGate:
+    """tools/bench_gate.py mechanics (ISSUE 12 satellite): regressions
+    beyond the recorded rep spread fail, spread-sized drift does not.
+    Cross-rig number comparisons only mean anything on the recording
+    rig, so tier-1 gates the MECHANICS (plus the real pilot record vs
+    itself, above)."""
+
+    def test_regression_beyond_spread_fails(self):
+        from tools import bench_gate
+
+        base = _row("gol_x", 1000.0, 0.05)
+        fresh = _row("gol_x", 800.0, 0.05)  # -20% vs ±15% tolerance
+        regressions, _ = bench_gate.compare(fresh, base)
+        assert len(regressions) == 1
+        assert "gol_x" in regressions[0]
+
+    def test_drift_within_spread_passes(self):
+        from tools import bench_gate
+
+        base = _row("gol_x", 1000.0, 0.10)
+        fresh = _row("gol_x", 930.0, 0.05)  # -7% vs ±20% tolerance
+        regressions, notes = bench_gate.compare(fresh, base)
+        assert regressions == []
+        assert any("ok gol_x" in n for n in notes)
+
+    def test_latency_rows_regress_upward(self):
+        from tools import bench_gate
+
+        base = _row("gol_lat", 0.010, 0.02, unit="seconds")
+        faster = _row("gol_lat", 0.005, 0.02, unit="seconds")
+        slower = _row("gol_lat", 0.020, 0.02, unit="seconds")
+        assert bench_gate.compare(faster, base)[0] == []
+        assert len(bench_gate.compare(slower, base)[0]) == 1
+
+    def test_nested_rows_matched_and_one_sided_rows_noted(self):
+        from tools import bench_gate
+
+        base = {
+            **_row("gol_top", 100.0, 0.05),
+            "controller_path": _row("gol_cp", 50.0, 0.05),
+            "only_in_base": _row("gol_gone", 1.0, 0.0),
+        }
+        fresh = {
+            **_row("gol_top", 99.0, 0.05),
+            "controller_path": _row("gol_cp", 20.0, 0.05),  # regressed
+        }
+        regressions, notes = bench_gate.compare(fresh, base)
+        assert len(regressions) == 1 and "gol_cp" in regressions[0]
+        assert any("gol_gone" in n and "only in baseline" in n
+                   for n in notes)
+
+    def test_cli_rejects_unlinted_fresh_record(self, tmp_path):
+        from tools import bench_gate
+
+        bad = {"metric": "gol_bare", "value": 123.0, "unit": "g/s"}
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps(bad))
+        assert bench_gate.main([str(p), str(p)]) == 2
+
+    def test_committed_baseline_parses_and_self_gates(self):
+        """The committed pilot artifact is gate-compatible: comparing it
+        against itself is clean (the pilot-sized tier-1 invocation runs
+        against the FRESH record in test_bench_pilot_record_shape)."""
+        from tools import bench_gate
+
+        baseline = REPO / "BENCH_PILOT_PR3.json"
+        record = json.loads(baseline.read_text())
+        regressions, _ = bench_gate.compare(record, record)
+        assert regressions == []
+        rows = bench_gate.headline_rows(record)
+        assert rows, "baseline carries no gateable rows?"
 
 
 def test_geometry_cli_spelling():

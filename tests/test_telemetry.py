@@ -1,0 +1,975 @@
+"""The continuous telemetry plane suite (ISSUE 12).
+
+Five contracts, asserted hermetically on CPU:
+
+- **Sampler** (`obs/timeseries.py`): the ring is bounded, rates and
+  histogram-delta percentiles derive from consecutive samples, the fast
+  sampling path never evaluates lazy gauges, and staleness is
+  observable.
+- **OpenMetrics** (`obs/openmetrics.py`): every snapshot the suite
+  produces — synthetic edge cases, a live registry, a real run's
+  MetricsReport delta — renders to exposition text that re-parses into
+  a schema-valid snapshot with identical values (the round-trip
+  property).
+- **SLOs** (`obs/slo.py`): burn-rate math over the ring, multi-window
+  alert gating, edge-triggered flight records, error budgets.
+- **Endpoints** (`serve/telemetry.py` + `tools/pod_top.py`): /metrics,
+  /healthz, /slo answer bounded-time from the latest sample; the
+  chaos row scrapes a pod with one hang-faulted tenant and one
+  mid-supervisor-restart while an injected-latency tenant fires its
+  burn-rate alert and healthy budgets stay intact (the ISSUE-12
+  acceptance bar).
+- **Correlation** (run_id satellite): MetricsReport, flight dumps, and
+  checkpoint sidecars of one logical run share one run_id (+ tenant),
+  stable across supervisor restarts; `tools/check_metric_docs.py`
+  passes on the shipped tree so no metric ships undocumented.
+"""
+
+import json
+import queue
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.engine.backend import Backend
+from distributed_gol_tpu.engine.events import MetricsReport
+from distributed_gol_tpu.engine.params import Params
+from distributed_gol_tpu.engine.session import Session
+from distributed_gol_tpu.obs import flight as flight_lib
+from distributed_gol_tpu.obs import metrics as obs_metrics
+from distributed_gol_tpu.obs import openmetrics
+from distributed_gol_tpu.obs.slo import SLOObjectives, SLOTracker
+from distributed_gol_tpu.obs.timeseries import (
+    TelemetrySampler,
+    fraction_above,
+    histogram_delta_percentiles,
+)
+from distributed_gol_tpu.serve import (
+    ServeConfig,
+    ServePlane,
+    serve_plane_telemetry,
+)
+from distributed_gol_tpu.testing.faults import (
+    Fault,
+    FaultInjectionBackend,
+    FaultPlan,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+W = H = 16
+SUPERSTEP = 4
+TURNS = 24
+
+
+def tenant_params(out_dir, seed, turns=TURNS, **kw):
+    cfg = dict(
+        engine="roll",
+        mesh_shape=(1, 1),
+        image_width=W,
+        image_height=H,
+        superstep=SUPERSTEP,
+        turns=turns,
+        soup_density=0.25,
+        soup_seed=seed,
+        out_dir=out_dir,
+        cycle_check=0,
+        ticker_period=60.0,
+    )
+    cfg.update(kw)
+    return Params(**cfg)
+
+
+def drain(events, timeout=60):
+    """Drain a stream to the sentinel; returns the events seen."""
+    seen = []
+    while True:
+        e = events.get(timeout=timeout)
+        if e is None:
+            return seen
+        seen.append(e)
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+# -- sampler units -------------------------------------------------------------
+
+
+class TestSampler:
+    def _registry_with_counter(self):
+        reg = obs_metrics.MetricsRegistry()
+        return reg, reg.counter("controller.turns")
+
+    def test_ring_is_bounded(self):
+        reg, _ = self._registry_with_counter()
+        s = TelemetrySampler(registry=reg, interval=1.0, depth=4)
+        for _ in range(10):
+            s.sample_now()
+        assert len(s.samples()) == 4
+
+    def test_rates_from_consecutive_samples(self):
+        reg, turns = self._registry_with_counter()
+        s = TelemetrySampler(registry=reg, interval=1.0)
+        s.sample_now()
+        t0 = s.latest().t
+        turns.inc(500)
+        s.sample_now()
+        # Pin the timestamps so the rate math is exact.
+        samples = s.samples()
+        samples[0].t = t0
+        samples[1].t = t0 + 2.0
+        assert s.rate("controller.turns") == pytest.approx(250.0)
+        d = s.derived()
+        assert d["gens_per_s"] == pytest.approx(250.0)
+        assert d["window_seconds"] == pytest.approx(2.0)
+
+    def test_rates_sum_tenant_labels(self):
+        reg = obs_metrics.MetricsRegistry()
+        a = reg.counter(obs_metrics.labelled("controller.turns", "a"))
+        b = reg.counter(obs_metrics.labelled("controller.turns", "b"))
+        s = TelemetrySampler(registry=reg, interval=1.0)
+        s.sample_now()
+        t0 = s.latest().t
+        a.inc(30)
+        b.inc(70)
+        s.sample_now()
+        s.samples()[0].t = t0
+        s.samples()[1].t = t0 + 1.0
+        d = s.derived()
+        assert d["gens_per_s"] == pytest.approx(100.0)
+        assert d["tenants"]["a"]["gens_per_s"] == pytest.approx(30.0)
+        assert d["tenants"]["b"]["gens_per_s"] == pytest.approx(70.0)
+
+    def test_lazy_gauges_only_on_lazy_cadence(self):
+        reg = obs_metrics.MetricsRegistry()
+        calls = []
+        reg.gauge_fn("backend.skip_fraction", lambda: calls.append(1) or 0.5)
+        s = TelemetrySampler(registry=reg, interval=1.0, lazy_every=3)
+        for _ in range(6):
+            s.sample_now()
+        # Ticks 3 and 6 are lazy; 1, 2, 4, 5 never touch the callback.
+        assert len(calls) == 2
+        lazies = [smp.lazy for smp in s.samples()]
+        assert lazies == [False, False, True, False, False, True]
+
+    def test_first_tick_never_lazy_even_at_lazy_every_one(self):
+        """start()'s synchronous sample must not block pod startup on a
+        device-forcing callback — even with lazy_every=1."""
+        reg = obs_metrics.MetricsRegistry()
+        calls = []
+        reg.gauge_fn("backend.skip_fraction", lambda: calls.append(1) or 0.5)
+        s = TelemetrySampler(registry=reg, interval=1.0, lazy_every=1)
+        s.sample_now()
+        assert calls == [] and not s.latest().lazy
+        s.sample_now()
+        assert calls == [1] and s.latest().lazy
+
+    def test_window_clamps_to_ring(self):
+        reg, turns = self._registry_with_counter()
+        s = TelemetrySampler(registry=reg, interval=1.0)
+        assert s.window(10.0) is None  # one sample: no delta yet
+        s.sample_now()
+        assert s.window(10.0) is None
+        turns.inc(1)
+        s.sample_now()
+        old, new = s.window(1e-9)  # tighter than any real gap
+        assert old is not new  # degrades to the adjacent pair
+
+    def test_histogram_delta_percentiles(self):
+        newh = {
+            "buckets": [0.01, 0.1, 1.0],
+            "counts": [10, 10, 0, 0],
+            "sum": 1.0,
+            "count": 20,
+        }
+        oldh = {
+            "buckets": [0.01, 0.1, 1.0],
+            "counts": [10, 0, 0, 0],
+            "sum": 0.05,
+            "count": 10,
+        }
+        # Window delta = 10 observations all in (0.01, 0.1].
+        p = histogram_delta_percentiles(newh, oldh)
+        assert 0.01 < p["p50"] <= 0.1
+        assert 0.01 < p["p99"] <= 0.1
+        # Since-start view: half under 0.01, p99 in the second bucket.
+        p_all = histogram_delta_percentiles(newh, None)
+        assert p_all["p50"] <= 0.01
+        assert histogram_delta_percentiles(None, None) is None
+        empty = dict(newh, counts=[0, 0, 0, 0], count=0)
+        assert histogram_delta_percentiles(empty, None) is None
+
+    def test_fraction_above_is_conservative(self):
+        h = {
+            "buckets": [0.01, 0.1, 1.0],
+            "counts": [5, 5, 0, 0],
+            "sum": 0.3,
+            "count": 10,
+        }
+        assert fraction_above(h, None, 0.01) == pytest.approx(0.5)
+        # A threshold between bounds rounds DOWN: the whole (0.01, 0.1]
+        # bucket counts as violating a 0.05 objective.
+        assert fraction_above(h, None, 0.05) == pytest.approx(0.5)
+        assert fraction_above(h, None, 1.0) == pytest.approx(0.0)
+
+    def test_staleness_and_daemon(self):
+        reg, _ = self._registry_with_counter()
+        s = TelemetrySampler(registry=reg, interval=0.05)
+        assert s.staleness == float("inf")
+        s.start()
+        try:
+            assert s.latest() is not None  # synchronous first sample
+            deadline = time.monotonic() + 5
+            while len(s.samples()) < 3 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert len(s.samples()) >= 3  # the daemon is ticking
+            assert s.staleness < 1.0
+        finally:
+            s.stop()
+        assert not s.running
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            TelemetrySampler(interval=0.0)
+        with pytest.raises(ValueError):
+            TelemetrySampler(depth=1)
+        with pytest.raises(ValueError):
+            TelemetrySampler(lazy_every=0)
+
+
+# -- OpenMetrics round-trip (property over suite-produced snapshots) -----------
+
+
+SYNTHETIC_SNAPSHOTS = [
+    # empty
+    {"schema": "gol-metrics-v1", "counters": {}, "gauges": {},
+     "histograms": {}, "info": {}},
+    # tenant labels with the full tenant charset, engine names with dashes
+    {"schema": "gol-metrics-v1",
+     "counters": {"controller.turns": 7,
+                  "controller.turns{tenant=a.b-c_D9}": 3,
+                  "backend.dispatches.pallas-packed": 2,
+                  "faults.backoff_seconds": 1.25},
+     "gauges": {"controller.superstep": 64,
+                "slo.error_budget_remaining{tenant=x}": 0.875},
+     "histograms": {
+         "controller.dispatch_seconds": {
+             "buckets": [0.001, 0.05, 2.5], "counts": [1, 2, 0, 3],
+             "sum": 9.5, "count": 6},
+         "controller.dispatch_seconds{tenant=x}": {
+             "buckets": [0.5], "counts": [0, 1], "sum": 0.7, "count": 1}},
+     "info": {"backend.engine": "pallas-packed",
+              "mesh.device_blacklist": "",
+              "backend.sharded_tier_policy": 'say "hi"\nnewline\\slash'}},
+]
+
+
+@pytest.mark.parametrize("snap", SYNTHETIC_SNAPSHOTS)
+def test_openmetrics_roundtrip_synthetic(snap):
+    assert openmetrics.check_roundtrip(snap) == []
+
+
+def test_openmetrics_roundtrip_live_registry_and_run(tmp_path):
+    """The property on REAL snapshots: the process registry (every
+    instrument previous tests planted) and a real run's MetricsReport
+    delta both round-trip clean."""
+    events = queue.Queue()
+    gol.run(tenant_params(tmp_path, 3, tenant="alice"), events)
+    report = next(e for e in drain(events) if isinstance(e, MetricsReport))
+    assert openmetrics.check_roundtrip(report.snapshot) == []
+    live = obs_metrics.REGISTRY.snapshot().to_dict()
+    assert openmetrics.check_roundtrip(live) == []
+
+
+def test_openmetrics_renders_tenant_as_real_label():
+    text = openmetrics.render(SYNTHETIC_SNAPSHOTS[1])
+    assert 'gol_controller_turns_total{tenant="a.b-c_D9"} 3' in text
+    assert "gol_controller_turns_total 7" in text
+    assert 'le="+Inf"' in text
+    assert text.rstrip().endswith("# EOF")
+
+
+def test_openmetrics_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        openmetrics.parse("# TYPE gol_x counter\nnot a sample line at all\n")
+    with pytest.raises(ValueError):
+        openmetrics.parse("gol_never_declared 1\n")
+
+
+# -- SLO tracking --------------------------------------------------------------
+
+
+class _SLORig:
+    """A hand-driven sampler + tracker over a private registry."""
+
+    def __init__(self, **kw):
+        defaults = dict(
+            latency_seconds=0.05,
+            fast_window_seconds=10.0,
+            slow_window_seconds=30.0,
+            burn_threshold=2.0,
+            budget_window_seconds=100.0,
+        )
+        defaults.update(kw)
+        self.reg = obs_metrics.MetricsRegistry()
+        self.obj = SLOObjectives(**defaults)
+        self.flight = flight_lib.FlightRecorder(64)
+        self.tracker = SLOTracker(self.obj, self.reg, self.flight)
+        self.sampler = TelemetrySampler(
+            registry=self.reg, interval=1.0, depth=200
+        )
+        self.hist = self.reg.histogram(
+            obs_metrics.labelled("controller.dispatch_seconds", "t1")
+        )
+        self.disp = self.reg.counter(
+            obs_metrics.labelled("controller.dispatches", "t1")
+        )
+        self.t = time.time()
+
+    def tick(self, seconds=1.0):
+        self.sampler.sample_now()
+        self.t += seconds
+        self.sampler.latest().t = self.t
+        return self.tracker.observe(self.sampler)
+
+
+class TestSLO:
+    def test_objectives_validation(self):
+        with pytest.raises(ValueError):
+            SLOObjectives(latency_seconds=-1)
+        with pytest.raises(ValueError):
+            SLOObjectives(latency_percentile=1.5)
+        with pytest.raises(ValueError):
+            SLOObjectives(fast_window_seconds=60, slow_window_seconds=30)
+        assert not SLOObjectives().enabled
+        assert SLOObjectives(latency_seconds=0.1).enabled
+
+    def test_burn_alert_fires_and_resolves_edge_triggered(self):
+        rig = _SLORig()
+        rig.tick()
+        # Sustained violation: every dispatch lands above the 0.05 s
+        # objective -> bad fraction 1.0, burn 1.0/0.01 = 100x.
+        for _ in range(6):
+            rig.hist.observe(0.2)
+            rig.disp.inc()
+            table = rig.tick()
+        row = table["t1"]["latency"]
+        assert row["alerting"]
+        assert row["burn_fast"] > rig.obj.burn_threshold
+        alerts = [
+            r for r in rig.flight.records() if r["kind"] == "slo_alert"
+        ]
+        assert len(alerts) == 1  # edge-triggered, not one per tick
+        assert alerts[0]["tenant"] == "t1"
+        assert alerts[0]["objective"] == "latency"
+        # Recovery: fast dispatches until both windows cool off.
+        for _ in range(40):
+            rig.hist.observe(0.001)
+            rig.disp.inc()
+            table = rig.tick()
+        assert not table["t1"]["latency"]["alerting"]
+        kinds = [r["kind"] for r in rig.flight.records()]
+        assert "slo_resolved" in kinds
+        assert kinds.count("slo_alert") == 1
+
+    def test_one_bad_sample_does_not_page(self):
+        """Multi-window gating: a single violating tick inside an
+        otherwise healthy slow window must not alert."""
+        # p90 objective: the slow-window allowance is 10%, so ONE bad
+        # tick in 20+ is well under sustainable pace while the fast
+        # window (last tick: 100% bad) burns hard.
+        rig = _SLORig(
+            fast_window_seconds=1.5,
+            slow_window_seconds=30.0,
+            latency_percentile=0.9,
+        )
+        rig.tick()
+        for _ in range(20):
+            rig.hist.observe(0.001)
+            rig.disp.inc()
+            rig.tick()
+        rig.hist.observe(0.2)
+        rig.disp.inc()
+        table = rig.tick()
+        row = table["t1"]["latency"]
+        assert row["burn_fast"] > rig.obj.burn_threshold  # fast window burns
+        assert not row["alerting"]  # slow window holds the page back
+        assert not any(
+            r["kind"] == "slo_alert" for r in rig.flight.records()
+        )
+
+    def test_error_budget_remaining(self):
+        rig = _SLORig()
+        rig.tick()
+        # 100-second budget window at a 1% allowance: 4 bad of 8 total
+        # with allowance 0.01 -> budget fully burnt (clamped at 0).
+        for bad in (True, True, False, False, True, True, False, False):
+            rig.hist.observe(0.2 if bad else 0.001)
+            rig.disp.inc()
+            table = rig.tick()
+        assert table["t1"]["latency"]["budget_remaining"] == 0.0
+        snap = rig.reg.snapshot().to_dict()
+        assert (
+            snap["gauges"][
+                obs_metrics.labelled("slo.error_budget_remaining", "t1")
+            ]
+            == 0.0
+        )
+        # A healthy tenant's budget stays intact.
+        rig2 = _SLORig()
+        rig2.tick()
+        for _ in range(8):
+            rig2.hist.observe(0.001)
+            rig2.disp.inc()
+            table = rig2.tick()
+        assert table["t1"]["latency"]["budget_remaining"] == 1.0
+
+    def test_error_rate_objective_reads_failure_counter(self):
+        rig = _SLORig(latency_seconds=0.0, error_rate=0.1)
+        fails = rig.reg.counter(
+            obs_metrics.labelled("controller.dispatch_failures", "t1")
+        )
+        rig.tick()
+        for _ in range(6):
+            rig.disp.inc()
+            fails.inc()  # 50% failure rate >> the 10% objective
+            table = rig.tick()
+        row = table["t1"]["errors"]
+        assert row["alerting"]
+        assert row["burn_fast"] == pytest.approx(5.0)
+
+    def test_evicted_tenant_unlatches_and_reused_name_pages_again(self):
+        """A tenant leaving the snapshot (terminal-handle eviction
+        cleared its labelled instruments) must not haunt the alert set:
+        the latch resolves, and a NEW session under the same name that
+        burns again fires a fresh slo_alert."""
+        rig = _SLORig()
+        rig.tick()
+        for _ in range(6):
+            rig.hist.observe(0.2)
+            rig.disp.inc()
+            rig.tick()
+        assert ("t1", "latency") in rig.tracker._alerting
+        # Eviction: the plane clears the tenant's labelled instruments.
+        rig.reg.clear_tenant("t1")
+        table = rig.tick()
+        assert "t1" not in table
+        assert rig.tracker._alerting == set()
+        resolved = [
+            r for r in rig.flight.records() if r["kind"] == "slo_resolved"
+        ]
+        assert resolved and resolved[-1]["reason"] == "tenant evicted"
+        assert "t1:latency" not in rig.tracker.summary()["alerting"]
+        # Reused name burns again: a SECOND alert fires.
+        rig.hist = rig.reg.histogram(
+            obs_metrics.labelled("controller.dispatch_seconds", "t1")
+        )
+        rig.disp = rig.reg.counter(
+            obs_metrics.labelled("controller.dispatches", "t1")
+        )
+        for _ in range(6):
+            rig.hist.observe(0.2)
+            rig.disp.inc()
+            rig.tick()
+        alerts = [
+            r for r in rig.flight.records() if r["kind"] == "slo_alert"
+        ]
+        assert len(alerts) == 2
+
+    def test_budget_gauge_is_worst_across_objectives(self):
+        """With both objectives armed, the single per-tenant budget
+        gauge publishes the MINIMUM remaining — a burnt latency budget
+        cannot hide behind a clean error budget."""
+        rig = _SLORig(error_rate=0.01)
+        rig.tick()
+        for _ in range(8):
+            rig.hist.observe(0.2)  # latency budget burns...
+            rig.disp.inc()  # ...while no dispatch ever fails
+            table = rig.tick()
+        assert table["t1"]["latency"]["budget_remaining"] == 0.0
+        assert table["t1"]["errors"]["budget_remaining"] == 1.0
+        gauge = rig.reg.snapshot().to_dict()["gauges"][
+            obs_metrics.labelled("slo.error_budget_remaining", "t1")
+        ]
+        assert gauge == 0.0
+
+    def test_serve_config_slo_requires_sampler(self):
+        with pytest.raises(ValueError, match="sampler"):
+            ServeConfig(slo_latency_seconds=0.1, telemetry_sample_seconds=0.0)
+        cfg = ServeConfig(slo_latency_seconds=0.1)
+        assert cfg.slo_objectives() is not None
+        assert ServeConfig().slo_objectives() is None
+
+    def test_serve_config_slow_window_must_fit_the_ring(self):
+        """A ring shorter than the slow window would permanently turn
+        the multi-window alert into fast-window-only — refused at
+        construction, not silently degraded."""
+        with pytest.raises(ValueError, match="slow burn"):
+            ServeConfig(
+                slo_latency_seconds=0.1,
+                telemetry_sample_seconds=0.25,  # span 150 s < slow 300 s
+            )
+        ServeConfig(
+            slo_latency_seconds=0.1,
+            telemetry_sample_seconds=0.25,
+            slo_slow_window_seconds=100.0,
+        )  # shrunk window: fine
+        # Unarmed configs never constrain the ring.
+        ServeConfig(telemetry_sample_seconds=0.25)
+
+
+# -- endpoints + dashboard -----------------------------------------------------
+
+
+class TestEndpoints:
+    def test_plane_endpoints_end_to_end(self, tmp_path):
+        cfg = ServeConfig(
+            max_sessions=2,
+            telemetry_sample_seconds=0.1,
+            slo_latency_seconds=10.0,  # generous: nothing should alert
+            slo_fast_window_seconds=0.5,
+            slo_slow_window_seconds=2.0,
+        )
+        with ServePlane(cfg, checkpoint_root=tmp_path / "ckpt") as plane:
+            with serve_plane_telemetry(plane, port=0) as srv:
+                plane.submit("alice", tenant_params(tmp_path / "a", 1))
+                assert plane.wait_idle(timeout=120)
+                status, body = _get(srv.url + "/metrics")
+                assert status == 200
+                parsed = openmetrics.parse(body.decode())
+                assert obs_metrics.check_metrics_snapshot(parsed) == []
+                assert "gol_controller_turns_total" in body.decode()
+                status, body = _get(srv.url + "/healthz")
+                assert status == 200
+                hz = json.loads(body)
+                assert hz["ready"] and hz["live"]
+                assert hz["telemetry"]["sampling"]
+                assert hz["tenants"]["alice"]["turns"] == TURNS
+                assert hz["slo"] is not None
+                status, body = _get(srv.url + "/slo")
+                assert status == 200
+                slo = json.loads(body)
+                assert slo["alerting"] == []
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _get(srv.url + "/nope")
+                assert ei.value.code == 404
+
+    def test_healthz_503_when_not_ready(self, tmp_path):
+        with ServePlane(
+            ServeConfig(max_sessions=1, telemetry_sample_seconds=0.2),
+        ) as plane:
+            with serve_plane_telemetry(plane, port=0) as srv:
+                plane.begin_drain()
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _get(srv.url + "/healthz")
+                assert ei.value.code == 503
+                body = json.loads(ei.value.read())
+                assert body["draining"] is True  # the body still reports
+
+    def test_slo_404_without_objectives(self, tmp_path):
+        with ServePlane(
+            ServeConfig(telemetry_sample_seconds=0.2)
+        ) as plane:
+            with serve_plane_telemetry(plane, port=0) as srv:
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _get(srv.url + "/slo")
+                assert ei.value.code == 404
+
+    def test_gol_run_telemetry_port(self, tmp_path):
+        """The single-run spelling: gol.run(..., telemetry_port=0) — the
+        endpoints live for the run's duration, discoverable via the
+        ``telemetry.endpoint`` info label."""
+        from distributed_gol_tpu.engine.gol import start
+
+        events = queue.Queue()
+        keys = queue.Queue()
+        before = (
+            obs_metrics.REGISTRY.snapshot()
+            .to_dict()["info"]
+            .get("telemetry.endpoint")
+        )
+        params = tenant_params(
+            tmp_path, 5, turns=100_000, telemetry_sample_seconds=0.05
+        )
+        t = start(params, events, keys, Session(), telemetry_port=0)
+        base = None
+        deadline = time.monotonic() + 60
+        while base is None and time.monotonic() < deadline:
+            info = obs_metrics.REGISTRY.snapshot().to_dict()["info"]
+            url = info.get("telemetry.endpoint")
+            if url and url != before:
+                base = url
+            else:
+                time.sleep(0.05)
+        assert base is not None, "run never published its endpoint"
+        status, body = _get(base + "/healthz", timeout=10)
+        assert status == 200
+        hz = json.loads(body)
+        assert hz["live"] and hz["sampling"]
+        status, body = _get(base + "/metrics", timeout=10)
+        assert status == 200
+        parsed = openmetrics.parse(body.decode())
+        assert obs_metrics.check_metrics_snapshot(parsed) == []
+        keys.put("q")
+        drain(events, timeout=120)
+        t.join(timeout=30)
+        # Run over: the server is down and the sampler stopped.
+        with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+            _get(base + "/healthz", timeout=2)
+
+    def test_pod_top_renders_frames(self):
+        from tools import pod_top
+
+        health = {
+            "ready": True,
+            "live": True,
+            "draining": False,
+            "degraded": False,
+            "resident_sessions": 2,
+            "queued_sessions": 1,
+            "resident_cells": 512,
+            "watchdog_fires": 1,
+            "supervisor_restarts": 2,
+            "rejected": 3,
+            "slo_alerts": 1,
+            "telemetry": {"sampling": True, "sample_age_seconds": 0.4},
+            "tenants": {
+                "alice": {"status": "running", "dispatches": 10, "turns": 40},
+                "bob": {"status": "parked", "dispatches": 5, "turns": 20},
+            },
+        }
+        slo = {
+            "alerting": ["alice:latency"],
+            "tenants": {
+                "alice": {
+                    "resolve_latency": {"p50": 0.01, "p95": 0.2, "p99": 0.4},
+                    "latency": {
+                        "burn_fast": 12.0,
+                        "burn_slow": 5.0,
+                        "alerting": True,
+                        "budget_remaining": 0.25,
+                    },
+                }
+            },
+        }
+        prev = {
+            "t": 100.0,
+            "health": {
+                "tenants": {
+                    "alice": {"status": "running", "dispatches": 5,
+                              "turns": 20},
+                    "bob": {"status": "running", "dispatches": 5,
+                            "turns": 20},
+                }
+            },
+        }
+        cur = {"t": 102.0, "health": health, "slo": slo}
+        frame = pod_top.render_frame(cur, prev)
+        assert "alice" in frame and "bob" in frame
+        assert "ALERTING: alice:latency" in frame
+        assert "10" in frame  # alice gens/s: (40-20)/2
+        assert "400ms" in frame  # alice p99
+        assert "lat:25%@12.0x!" in frame  # budget cell with alert marker
+        assert "restarts 2" in frame
+        # First frame (no prev): rates dash out, nothing crashes.
+        first = pod_top.render_frame(cur, None)
+        assert "-" in first
+
+    def test_pod_top_scrapes_a_real_pod(self, tmp_path):
+        from tools import pod_top
+
+        with ServePlane(
+            ServeConfig(max_sessions=2, telemetry_sample_seconds=0.1)
+        ) as plane:
+            with serve_plane_telemetry(plane, port=0) as srv:
+                plane.submit("alice", tenant_params(tmp_path / "a", 1))
+                assert plane.wait_idle(timeout=120)
+                cur = pod_top.scrape(srv.url)
+                frame = pod_top.render_frame(cur)
+                assert "alice" in frame
+                assert "completed" in frame
+
+
+# -- correlation ids (run_id satellite) ----------------------------------------
+
+
+class TestRunIdCorrelation:
+    def test_clean_run_report_carries_run_id_and_tenant(self, tmp_path):
+        events = queue.Queue()
+        gol.run(tenant_params(tmp_path, 2, tenant="alice"), events)
+        report = next(
+            e for e in drain(events) if isinstance(e, MetricsReport)
+        )
+        assert report.tenant == "alice"
+        assert report.run_id.startswith("alice-")
+        # And a second run mints a distinct id.
+        events = queue.Queue()
+        gol.run(tenant_params(tmp_path / "b", 2, tenant="alice"), events)
+        report2 = next(
+            e for e in drain(events) if isinstance(e, MetricsReport)
+        )
+        assert report2.run_id != report.run_id
+
+    def test_flight_dump_and_sidecar_share_the_run_id(self, tmp_path):
+        """A crashed run's three artifacts — flight record, periodic
+        checkpoint sidecar, (absent) report — join on one id."""
+        params = tenant_params(
+            tmp_path / "out",
+            7,
+            tenant="alice",
+            retry_limit=0,
+            checkpoint_every_turns=SUPERSTEP,
+        )
+        backend = FaultInjectionBackend(
+            Backend(params), FaultPlan([Fault(3, "issue")])
+        )
+        session = Session(tmp_path / "ckpt")
+        events = queue.Queue()
+        with pytest.raises(RuntimeError):
+            gol.run(params, events, session=session, backend=backend)
+        drain(events)
+        flight_path = flight_lib.latest_flight_record(tmp_path / "ckpt")
+        assert flight_path is not None
+        doc = flight_lib.load_flight_record(flight_path)
+        assert doc["tenant"] == "alice"
+        run_id = doc["run_id"]
+        assert run_id.startswith("alice-")
+        sidecars = [
+            json.loads(p.read_text())
+            for p in (tmp_path / "ckpt").glob("checkpoint-*.json")
+        ]
+        assert sidecars, "periodic checkpoint expected before the crash"
+        assert all(m["run_id"] == run_id for m in sidecars)
+        assert all(m["tenant"] == "alice" for m in sidecars)
+        # tools/flight_report.py prints the stamp.
+        from tools import flight_report
+
+        rendered = flight_report.render(doc)
+        assert f"run_id {run_id}" in rendered
+        assert "tenant alice" in rendered
+
+    def test_run_id_stable_across_supervisor_restarts(self, tmp_path):
+        """One logical run = one id: the recovered run's report and the
+        mid-run sidecars written by DIFFERENT attempts all agree."""
+        params = tenant_params(
+            tmp_path / "out",
+            9,
+            tenant="bob",
+            retry_limit=0,
+            checkpoint_every_turns=SUPERSTEP,
+            restart_limit=2,
+        )
+        plan = FaultPlan([Fault(2, "issue")])
+
+        def factory(p, attempt):
+            b = Backend(p)
+            return FaultInjectionBackend(b, plan) if attempt == 0 else b
+
+        session = Session(tmp_path / "ckpt")
+        events = queue.Queue()
+        gol.run(params, events, session=session, backend_factory=factory)
+        report = next(
+            e for e in drain(events) if isinstance(e, MetricsReport)
+        )
+        assert report.snapshot["counters"]["supervisor.restarts"] == 1
+        assert report.run_id.startswith("bob-")
+        # The recovered run completed: no flight record (PR-4 contract),
+        # and the run_id on the report is the supervisor's single id.
+        assert flight_lib.latest_flight_record(tmp_path / "ckpt") is None
+
+
+# -- docs gate (static-analysis satellite) -------------------------------------
+
+
+def test_metric_docs_are_complete():
+    """tools/check_metric_docs.py passes on the shipped tree: every
+    registered instrument has a docs/API.md row and vice versa."""
+    from tools import check_metric_docs
+
+    assert check_metric_docs.check(REPO) == []
+
+
+def test_metric_docs_checker_catches_drift(tmp_path):
+    """The checker is a real gate: an undocumented registration and a
+    stale docs row both fail."""
+    from tools import check_metric_docs
+
+    pkg = tmp_path / "distributed_gol_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'REG.counter("shiny.new_metric")\n'
+        'REG.counter(f"dyn.family.{kind}")\n'
+    )
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "API.md").write_text(
+        "| Metric | Kind | Meaning |\n"
+        "|---|---|---|\n"
+        "| `shiny.new_metric` | counter | Documented. |\n"
+        "| `stale.never_registered` | counter | Gone. |\n"
+    )
+    problems = check_metric_docs.check(tmp_path)
+    assert any("dyn.family." in p for p in problems)
+    assert any("stale.never_registered" in p for p in problems)
+    # Fix both: clean.
+    (docs / "API.md").write_text(
+        "| Metric | Kind | Meaning |\n"
+        "|---|---|---|\n"
+        "| `shiny.new_metric` | counter | Documented. |\n"
+        "| `dyn.family.<kind>` | counter | Documented family. |\n"
+    )
+    assert check_metric_docs.check(tmp_path) == []
+
+
+# -- the chaos row (ISSUE-12 acceptance) ---------------------------------------
+
+
+@pytest.mark.chaos
+class TestScrapeUnderChaos:
+    SCRAPE_BOUND_S = 2.0
+
+    def test_scrape_bounded_and_truthful_under_hang_restart_and_slo_burn(
+        self, tmp_path
+    ):
+        """THE acceptance row: one tenant hang-faulted, one supervisor-
+        restarting, one burning its latency SLO, one healthy.  Every
+        /metrics + /healthz scrape during the storm answers within the
+        bound; the SLO alert fires (flight record + health slo section)
+        for the lagging tenant while the healthy tenant's budget stays
+        intact; final statuses are truthful per tenant."""
+        cfg = ServeConfig(
+            max_sessions=4,
+            telemetry_sample_seconds=0.1,
+            slo_latency_seconds=0.05,
+            slo_fast_window_seconds=0.4,
+            slo_slow_window_seconds=1.2,
+            slo_burn_threshold=2.0,
+            slo_budget_window_seconds=30.0,
+        )
+        # Hang tenant: wedged dispatch, bounded by ITS OWN watchdog.
+        hang_params = tenant_params(
+            tmp_path / "hang", 31, dispatch_deadline_seconds=3.0
+        )
+        hang_backend = FaultInjectionBackend(
+            Backend(hang_params), FaultPlan([Fault(1, "hang", seconds=60.0)])
+        )
+        # Restart tenant: terminal burst at dispatch 2, self-heals via
+        # its own supervisor ladder.
+        restart_params = tenant_params(
+            tmp_path / "restart",
+            32,
+            retry_limit=0,
+            checkpoint_every_turns=SUPERSTEP,
+            restart_limit=2,
+        )
+        restart_plan = FaultPlan([Fault(2, "issue")])
+
+        def restart_factory(p, attempt):
+            b = Backend(p)
+            return (
+                FaultInjectionBackend(b, restart_plan) if attempt == 0 else b
+            )
+
+        # Lag tenant: every dispatch +0.15 s -> p99 far over the 50 ms
+        # objective -> burn ~100x over both windows.
+        lag_params = tenant_params(tmp_path / "lag", 33, turns=120)
+        lag_backend = FaultInjectionBackend(
+            Backend(lag_params),
+            FaultPlan(
+                [Fault(i, "latency", seconds=0.15) for i in range(40)]
+            ),
+        )
+        try:
+            with ServePlane(cfg, checkpoint_root=tmp_path / "ckpt") as plane:
+                with serve_plane_telemetry(plane, port=0) as srv:
+                    healthy = plane.submit(
+                        "healthy", tenant_params(tmp_path / "healthy", 34)
+                    )
+                    hang = plane.submit(
+                        "hang", hang_params, backend=hang_backend
+                    )
+                    restart = plane.submit(
+                        "restart",
+                        restart_params,
+                        backend_factory=restart_factory,
+                    )
+                    lag = plane.submit("lag", lag_params, backend=lag_backend)
+
+                    # Scrape THROUGH the storm: while the hang tenant is
+                    # wedged and the restart tenant recovers, every
+                    # response lands within the bound.
+                    scrape_times = []
+                    deadline = time.monotonic() + 120
+                    while time.monotonic() < deadline:
+                        t0 = time.monotonic()
+                        s1, _ = _get(srv.url + "/metrics", timeout=10)
+                        try:
+                            s2, hz_body = _get(srv.url + "/healthz",
+                                               timeout=10)
+                        except urllib.error.HTTPError as e:
+                            s2, hz_body = e.code, e.read()
+                        scrape_times.append(time.monotonic() - t0)
+                        assert s1 == 200
+                        assert s2 in (200, 503)
+                        if all(
+                            h.done for h in (healthy, hang, restart, lag)
+                        ):
+                            break
+                        time.sleep(0.1)
+                    assert plane.wait_idle(timeout=60)
+                    assert scrape_times, "no scrape completed"
+                    worst = max(scrape_times)
+                    assert worst < self.SCRAPE_BOUND_S, (
+                        f"scrape took {worst:.2f}s with a wedged tenant "
+                        f"resident (bound {self.SCRAPE_BOUND_S}s over "
+                        f"{len(scrape_times)} scrapes)"
+                    )
+
+                    # Truthful per-tenant terminal statuses on /healthz.
+                    _, hz_body = _get(srv.url + "/healthz", timeout=10)
+                    hz = json.loads(hz_body)
+                    statuses = {
+                        t: row["status"] for t, row in hz["tenants"].items()
+                    }
+                    assert statuses["healthy"] == "completed"
+                    assert statuses["restart"] == "completed"
+                    assert statuses["hang"] == "parked"
+                    assert statuses["lag"] == "completed"
+                    assert hz["watchdog_fires"] >= 1
+                    assert hz["supervisor_restarts"] == 1
+                    assert "DispatchTimeout" in hang.error
+
+                    # The SLO row: the lag tenant fired its burn-rate
+                    # alert — flight record + health slo section — and
+                    # the healthy tenant's budget is intact.
+                    alerts = [
+                        r
+                        for r in plane.flight.records()
+                        if r["kind"] == "slo_alert"
+                    ]
+                    assert any(a["tenant"] == "lag" for a in alerts), (
+                        f"lag tenant never alerted; ring="
+                        f"{plane.flight.records()}"
+                    )
+                    assert not any(
+                        a["tenant"] == "healthy" for a in alerts
+                    )
+                    assert hz["slo_alerts"] >= 1
+                    slo = hz["slo"]
+                    lag_row = slo["tenants"]["lag"]["latency"]
+                    assert lag_row["budget_remaining"] < 1.0
+                    healthy_row = slo["tenants"].get("healthy", {}).get(
+                        "latency"
+                    )
+                    if healthy_row is not None:
+                        assert healthy_row["budget_remaining"] == 1.0
+                        assert not healthy_row["alerting"]
+        finally:
+            hang_backend.release_hangs()

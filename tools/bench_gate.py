@@ -1,0 +1,146 @@
+"""Bench regression gate (ISSUE 12 satellite): fresh record vs baseline.
+
+Compares a fresh, lint-checked bench record (one ``bench.py`` JSON line)
+against a committed ``BENCH_*.json`` baseline and fails on regressions
+beyond the *recorded rep spread*: every headline row carries
+``{reps, median, spread}`` (the round-6 quiet protocol), so the gate's
+tolerance is measured, not guessed — a row regresses when its median
+drops below the baseline median by more than both rows' spreads plus a
+fixed margin::
+
+    fresh.median < base.median * (1 - base.spread - fresh.spread - margin)
+
+Rows are matched by their ``metric`` name, recursively (nested records:
+``controller_path``, ``config4_65536``, ``sharded``, serve/frames
+arms...).  Direction comes from the row's ``unit``: rates
+(``*/sec``) regress DOWN, latencies (``seconds``) regress UP.  Rows
+present only on one side are reported informationally, never a failure
+(rigs differ in which arms they record).
+
+A pilot-sized invocation runs inside tier-1 beside
+``tests/test_bench_pilot.py`` — the gate mechanics are test-gated even
+though cross-rig number comparisons only make sense on the recording
+rig.
+
+Usage:
+    python bench.py --pilot > fresh.json
+    python tools/bench_gate.py fresh.json BENCH_PILOT_PR3.json
+    python tools/bench_gate.py fresh.json baseline.json --margin 0.1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from distributed_gol_tpu.utils import measure  # noqa: E402
+
+DEFAULT_MARGIN = 0.05
+
+
+def headline_rows(record, path: str = "$") -> dict[str, dict]:
+    """Every ``{metric, median, ...}`` row in a record, keyed by metric
+    name, found recursively (the same walk the stats lint does)."""
+    rows: dict[str, dict] = {}
+    if isinstance(record, dict):
+        if "metric" in record and isinstance(record.get("median"), (int, float)):
+            rows[record["metric"]] = record
+        for k, v in record.items():
+            if k != "metric":
+                rows.update(headline_rows(v, f"{path}.{k}"))
+    elif isinstance(record, (list, tuple)):
+        for i, v in enumerate(record):
+            rows.update(headline_rows(v, f"{path}[{i}]"))
+    return rows
+
+
+def _lower_is_better(row: dict) -> bool:
+    unit = str(row.get("unit", ""))
+    return unit in ("seconds", "s", "ms", "bytes") or unit.endswith("seconds")
+
+
+def compare(
+    fresh: dict, baseline: dict, margin: float = DEFAULT_MARGIN
+) -> tuple[list[str], list[str]]:
+    """(regressions, notes).  Regressions = rows beyond tolerance; notes
+    = rows only on one side or informational movements."""
+    fresh_rows = headline_rows(fresh)
+    base_rows = headline_rows(baseline)
+    regressions: list[str] = []
+    notes: list[str] = []
+    for metric in sorted(set(fresh_rows) | set(base_rows)):
+        f, b = fresh_rows.get(metric), base_rows.get(metric)
+        if f is None or b is None:
+            side = "baseline" if f is None else "fresh record"
+            notes.append(f"{metric}: only in {side} (not gated)")
+            continue
+        if f.get("unit") != b.get("unit"):
+            notes.append(
+                f"{metric}: unit changed "
+                f"{b.get('unit')!r} -> {f.get('unit')!r} (not gated)"
+            )
+            continue
+        tol = (
+            float(b.get("spread", 0.0))
+            + float(f.get("spread", 0.0))
+            + margin
+        )
+        fm, bm = float(f["median"]), float(b["median"])
+        if bm <= 0:
+            notes.append(f"{metric}: non-positive baseline median (not gated)")
+            continue
+        change = (fm - bm) / bm
+        bad = change > tol if _lower_is_better(f) else change < -tol
+        line = (
+            f"{metric}: {bm:,.6g} -> {fm:,.6g} "
+            f"({change:+.1%}, tolerance ±{tol:.1%})"
+        )
+        if bad:
+            regressions.append("REGRESSION " + line)
+        else:
+            notes.append("ok " + line)
+    return regressions, notes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("fresh", help="fresh bench record (JSON file)")
+    ap.add_argument("baseline", help="committed BENCH_*.json baseline")
+    ap.add_argument("--margin", type=float, default=DEFAULT_MARGIN,
+                    help="extra relative tolerance on top of both rows' "
+                         "recorded spreads (default 0.05)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="print regressions only")
+    args = ap.parse_args(argv)
+
+    fresh = json.loads(Path(args.fresh).read_text())
+    baseline = json.loads(Path(args.baseline).read_text())
+    # The gate only judges lint-clean records: a malformed stats block
+    # would make the spread tolerance meaningless.
+    problems = measure.check_headline_stats(fresh)
+    if problems:
+        print("fresh record fails the stats lint:", file=sys.stderr)
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        return 2
+
+    regressions, notes = compare(fresh, baseline, margin=args.margin)
+    if not args.quiet:
+        for n in notes:
+            print(n)
+    for r in regressions:
+        print(r, file=sys.stderr)
+    if regressions:
+        print(f"{len(regressions)} regression(s) beyond rep spread",
+              file=sys.stderr)
+        return 1
+    print("bench gate clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

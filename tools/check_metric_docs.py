@@ -1,0 +1,151 @@
+"""Metric-name docs lint (ISSUE 12 satellite): no undocumented metrics.
+
+Walks the package source for instrument registrations —
+``counter("…")`` / ``gauge("…")`` / ``histogram("…")`` /
+``gauge_fn("…")`` / ``info("…")``, including names wrapped in
+``labelled("…", tenant)`` — and compares the collected names against
+the metric table in docs/API.md's Observability section.  A metric
+registered in code but missing from the table fails, and so does a
+documented metric no code registers: new instruments cannot ship
+undocumented, and the table cannot rot.  Runs inside tier-1
+(``tests/test_telemetry.py``).
+
+Dynamic names are matched by prefix: an f-string registration like
+``counter(f"faults.failures.{type(e).__name__}")`` is collected as the
+literal prefix ``faults.failures.`` and matches the table row
+``faults.failures.<ExceptionType>`` (docs placeholders are truncated at
+the first ``<``).
+
+Usage:
+    python tools/check_metric_docs.py            # lint the repo, exit 1 on drift
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: Registration sites: the call name, optionally through labelled(...),
+#: with a (possibly f-)string literal first argument.
+_REGISTRATION = re.compile(
+    r"\b(?:counter|gauge|histogram|gauge_fn|info)\(\s*"
+    r"(?:[\w.]+\.)?(?:labelled\(\s*)?"
+    r'(f?)"([^"]+)"'
+)
+def source_metric_names(
+    package_dir: Path | None = None,
+) -> tuple[set[str], set[str]]:
+    """(exact names, dynamic-name prefixes) registered across the
+    package source.  Scans whole files (registrations routinely wrap
+    across lines); the ``\\(\\s*`` in the pattern spans newlines."""
+    package_dir = package_dir or (REPO / "distributed_gol_tpu")
+    exact: set[str] = set()
+    prefixes: set[str] = set()
+    for path in sorted(package_dir.rglob("*.py")):
+        for is_f, name in _REGISTRATION.findall(path.read_text()):
+            if is_f:
+                prefix = name.split("{", 1)[0]
+                if prefix:
+                    prefixes.add(prefix)
+            else:
+                exact.add(name)
+    return exact, prefixes
+
+
+def documented_metric_names(api_md: Path | None = None) -> set[str]:
+    """Names from the Observability metric table (rows ``| `name` | kind
+    | …``).  A cell may list several backticked names; a token starting
+    with ``_`` is suffix shorthand for the previous name
+    (```faults.checkpoint_saves`` / ``_bytes``` → ``faults.
+    checkpoint_bytes``).  Placeholder segments (``<engine>``) are kept
+    verbatim — matching truncates at the ``<``."""
+    api_md = api_md or (REPO / "docs" / "API.md")
+    names: set[str] = set()
+    in_table = False
+    for line in api_md.read_text().splitlines():
+        stripped = line.strip()
+        if stripped.startswith("| Metric | Kind |"):
+            in_table = True
+            continue
+        if in_table:
+            if not stripped.startswith("|"):
+                in_table = False
+                continue
+            first_cell = stripped.split("|")[1]
+            prev = None
+            for token in re.findall(r"`([^`]+)`", first_cell):
+                if token.startswith("_") and prev is not None:
+                    token = prev.rsplit("_", 1)[0] + token
+                names.add(token)
+                prev = token
+    return names
+
+
+def _doc_matches(doc_name: str, exact: set[str], prefixes: set[str]) -> bool:
+    if "<" in doc_name:
+        doc_prefix = doc_name.split("<", 1)[0]
+        return any(p == doc_prefix for p in prefixes) or any(
+            e.startswith(doc_prefix) for e in exact
+        )
+    return doc_name in exact
+
+
+def _source_matches(name: str, documented: set[str]) -> bool:
+    if name in documented:
+        return True
+    return any(
+        "<" in d and name.startswith(d.split("<", 1)[0]) for d in documented
+    )
+
+
+def check(repo: Path | None = None) -> list[str]:
+    """Returns the violations (empty = docs and source agree)."""
+    repo = repo or REPO
+    exact, prefixes = source_metric_names(repo / "distributed_gol_tpu")
+    documented = documented_metric_names(repo / "docs" / "API.md")
+    problems = []
+    for name in sorted(exact):
+        if not _source_matches(name, documented):
+            problems.append(
+                f"registered but undocumented: {name!r} (add a row to the "
+                "docs/API.md Observability metric table)"
+            )
+    for prefix in sorted(prefixes):
+        if not any(
+            ("<" in d and d.split("<", 1)[0] == prefix)
+            or d.startswith(prefix)
+            for d in documented
+        ):
+            problems.append(
+                f"dynamically-named family {prefix!r}* has no "
+                "docs/API.md row (use a <placeholder> name)"
+            )
+    for doc_name in sorted(documented):
+        if not _doc_matches(doc_name, exact, prefixes):
+            problems.append(
+                f"documented but never registered: {doc_name!r} (stale "
+                "docs/API.md row?)"
+            )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(p, file=sys.stderr)
+    if problems:
+        print(f"{len(problems)} metric-docs violation(s)", file=sys.stderr)
+        return 1
+    exact, prefixes = source_metric_names()
+    print(
+        f"metric docs clean: {len(exact)} named + {len(prefixes)} dynamic "
+        "families all documented"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

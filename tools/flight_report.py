@@ -141,6 +141,28 @@ def _d_preempt_save_skipped(r):
     )
 
 
+def _d_slo_alert(r):
+    budget = r.get("budget_remaining")
+    tail = (
+        f", error budget {budget:.1%} remaining"
+        if isinstance(budget, (int, float))
+        else ""
+    )
+    return (
+        f"SLO ALERT tenant {r.get('tenant', '?')} [{r.get('objective', '?')}]"
+        f": burning at {r.get('burn_fast', '?')}x fast / "
+        f"{r.get('burn_slow', '?')}x slow (threshold "
+        f"{r.get('threshold', '?')}x){tail}"
+    )
+
+
+def _d_slo_resolved(r):
+    return (
+        f"SLO alert resolved: tenant {r.get('tenant', '?')} "
+        f"[{r.get('objective', '?')}] burning under threshold again"
+    )
+
+
 _DESCRIBE = {
     "restart": _d_restart,
     "supervisor_exhausted": _d_supervisor_exhausted,
@@ -153,6 +175,8 @@ _DESCRIBE = {
     "preempt": _d_preempt,
     "ckpt_skipped_unverified": _d_ckpt_skipped_unverified,
     "preempt_save_skipped": _d_preempt_save_skipped,
+    "slo_alert": _d_slo_alert,
+    "slo_resolved": _d_slo_resolved,
 }
 
 
@@ -176,6 +200,15 @@ def render(doc: dict, tail: int = 20) -> str:
         "%Y-%m-%d %H:%M:%S", time.gmtime(doc.get("written_at", 0))
     )
     out.append(f"flight record ({doc['schema']}) written {when} UTC")
+    ids = []
+    if doc.get("run_id"):
+        # The correlation stamp (ISSUE 12): grep this id across scrape
+        # series, MetricsReport dumps, and checkpoint sidecars.
+        ids.append(f"run_id {doc['run_id']}")
+    if doc.get("tenant") is not None:
+        ids.append(f"tenant {doc['tenant']}")
+    if ids:
+        out.append("  ".join(ids))
     out.append(
         f"cause: {doc['cause']} at turn {doc['turn']}"
         + (f" — {doc['error']}" if doc.get("error") else "")

@@ -1,0 +1,177 @@
+"""Live pod dashboard over the telemetry endpoints (ISSUE 12).
+
+``top`` for a serving pod: a refresh loop against ``/healthz`` + ``/slo``
+(``serve --telemetry-port``, or ``gol.run(..., telemetry_port=...)``)
+with one row per tenant — status, gens/s (computed client-side from
+consecutive scrapes), p99 resolve latency, restarts, and error-budget
+burn.  Pure stdlib; rendering is a pure function of two scrapes so it is
+unit-testable without a pod.
+
+Usage:
+    python tools/pod_top.py http://127.0.0.1:9090
+    python tools/pod_top.py http://127.0.0.1:9090 --interval 2
+    python tools/pod_top.py http://127.0.0.1:9090 --once   # one frame, no loop
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+CLEAR = "\x1b[2J\x1b[H"
+
+
+def scrape(base_url: str, timeout: float = 5.0) -> dict:
+    """One poll: ``{"health": ..., "slo": ... | None, "t": unix}``.
+    ``/healthz`` deliberately reads the BODY on 503 too (a not-ready pod
+    still reports); a missing ``/slo`` (no objectives armed) is None."""
+    out: dict = {"t": time.time()}
+    req = urllib.request.Request(base_url.rstrip("/") + "/healthz")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            out["health"] = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        out["health"] = json.loads(e.read())
+    try:
+        with urllib.request.urlopen(
+            base_url.rstrip("/") + "/slo", timeout=timeout
+        ) as resp:
+            out["slo"] = json.loads(resp.read())
+    except (urllib.error.HTTPError, urllib.error.URLError, ValueError):
+        out["slo"] = None
+    return out
+
+
+def _fmt_rate(v: float | None) -> str:
+    if v is None:
+        return "-"
+    if v >= 10_000:
+        return f"{v / 1000:,.0f}k"
+    return f"{v:,.0f}"
+
+
+def _fmt_latency(pcts: dict | None) -> str:
+    if not pcts or "p99" not in pcts:
+        return "-"
+    p99 = pcts["p99"]
+    return f"{p99 * 1000:.0f}ms" if p99 < 1 else f"{p99:.2f}s"
+
+
+def _fmt_budget(row: dict | None) -> str:
+    if not row:
+        return "-"
+    parts = []
+    for objective in ("latency", "errors"):
+        o = row.get(objective)
+        if not o:
+            continue
+        rem = o.get("budget_remaining")
+        burn = o.get("burn_fast")
+        cell = f"{rem:.0%}" if rem is not None else "?"
+        if burn is not None:
+            cell += f"@{burn:.1f}x"
+        if o.get("alerting"):
+            cell += "!"
+        parts.append(f"{objective[:3]}:{cell}")
+    return " ".join(parts) or "-"
+
+
+def render_frame(cur: dict, prev: dict | None = None) -> str:
+    """One dashboard frame from the current scrape (and the previous
+    one, for client-side rates).  Pure function — the test surface."""
+    health = cur["health"]
+    slo = cur.get("slo") or {}
+    slo_tenants = slo.get("tenants", {})
+    lines = []
+    flags = []
+    for key in ("draining", "degraded"):
+        if health.get(key):
+            flags.append(key.upper())
+    if not health.get("ready", False):
+        flags.append("NOT-READY")
+    if not health.get("live", True):
+        flags.append("NOT-LIVE")
+    state = " ".join(flags) if flags else "ready"
+    telem = health.get("telemetry", {})
+    age = telem.get("sample_age_seconds")
+    lines.append(
+        f"pod {state} | resident {health.get('resident_sessions', 0)} "
+        f"queued {health.get('queued_sessions', 0)} "
+        f"cells {health.get('resident_cells', 0):,} | "
+        f"watchdog {health.get('watchdog_fires', 0)} "
+        f"restarts {health.get('supervisor_restarts', 0)} "
+        f"rejected {health.get('rejected', 0)} "
+        f"slo-alerts {health.get('slo_alerts', 0)} | "
+        f"sample {age if age is not None else '-'}s old"
+    )
+    alerting = slo.get("alerting") or []
+    if alerting:
+        lines.append("ALERTING: " + ", ".join(alerting))
+
+    # Client-side per-tenant rates from consecutive scrapes.
+    dt = (cur["t"] - prev["t"]) if prev else 0.0
+    prev_tenants = (prev or {}).get("health", {}).get("tenants", {})
+    header = (
+        f"{'TENANT':<16} {'STATUS':<10} {'GENS/S':>8} {'DISP/S':>7} "
+        f"{'P99':>7} {'BUDGET':<18}"
+    )
+    lines.append(header)
+    for tenant in sorted(health.get("tenants", {})):
+        row = health["tenants"][tenant]
+        rate = disp = None
+        if prev and dt > 0 and tenant in prev_tenants:
+            rate = (row["turns"] - prev_tenants[tenant]["turns"]) / dt
+            disp = (
+                row["dispatches"] - prev_tenants[tenant]["dispatches"]
+            ) / dt
+        srow = slo_tenants.get(tenant, {})
+        lines.append(
+            f"{tenant:<16} {row['status']:<10} {_fmt_rate(rate):>8} "
+            f"{_fmt_rate(disp):>7} "
+            f"{_fmt_latency(srow.get('resolve_latency')):>7} "
+            f"{_fmt_budget(srow):<18}"
+        )
+    if not health.get("tenants"):
+        lines.append("(no tenants)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("url", help="pod telemetry base URL, e.g. "
+                                "http://127.0.0.1:9090")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clearing)")
+    args = ap.parse_args(argv)
+
+    prev = None
+    try:
+        while True:
+            try:
+                cur = scrape(args.url)
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                print(f"{args.url}: unreachable ({e})", file=sys.stderr)
+                return 1
+            frame = render_frame(cur, prev)
+            if args.once:
+                print(frame)
+                return 0
+            print(f"{CLEAR}{args.url}  {time.strftime('%H:%M:%S')}")
+            print(frame, flush=True)
+            prev = cur
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
