@@ -223,9 +223,21 @@ def bench_config(
     return gps, gps * size * size
 
 
+def parse_mesh(spec) -> tuple[int, int]:
+    """``--sharded-mesh`` spellings -> (ny, nx): an int or "NY" is the
+    classic row mesh (NY, 1); "NYxNX" is a full 2-D mesh (round 7)."""
+    if isinstance(spec, int):
+        return (spec, 1)
+    s = str(spec).lower().replace(",", "x")
+    if "x" in s:
+        ny, nx = s.split("x", 1)
+        return (int(ny), int(nx))
+    return (int(s), 1)
+
+
 def bench_sharded(
     size: int,
-    mesh_ny: int,
+    mesh_spec,
     reps: int = 5,
     kturns: int = 1024,
     burnin: int = 0,
@@ -233,10 +245,12 @@ def bench_sharded(
     in_kernel: bool | None = None,
     target_seconds: float = 0.7,
 ) -> dict:
-    """The sharded pallas-packed tier on an (ny, 1) mesh: per-rep rates
-    with {reps, median, spread} — the round-6 artifact row for the
-    in-kernel ICI exchange tier (ISSUE 1).  ``spread`` is (max − min) /
-    median over the timed reps.  Returns the record dict (also logs it)."""
+    """The sharded pallas-packed tier on an (ny, nx) mesh (``mesh_spec``:
+    int NY or "NYxNX"): per-rep rates with {reps, median, spread} — the
+    round-6 artifact row for the in-kernel ICI exchange tier (ISSUE 1),
+    grown a mesh-shape dimension + per-direction halo bytes in round 7.
+    ``spread`` is (max − min) / median over the timed reps.  Returns the
+    record dict (also logs it)."""
     import jax
     import jax.numpy as jnp
 
@@ -248,18 +262,19 @@ def bench_sharded(
 
     from distributed_gol_tpu.ops import pallas_packed
 
-    mesh = make_mesh((mesh_ny, 1))
-    strip = (size // mesh_ny, size // 32)
+    mesh_ny, mesh_nx = parse_mesh(mesh_spec)
+    mesh = make_mesh((mesh_ny, mesh_nx))
+    strip = (size // mesh_ny, size // 32 // mesh_nx)
     use_ici, reason = pallas_halo.ici_tier_policy(
         mesh,
         in_kernel=in_kernel,
-        # The strip geometry gates the record too (as Backend does): the
+        # The tile geometry gates the record too (as Backend does): the
         # artifact row must never claim a tier the dispatches didn't run.
         strip=strip,
         tile_cap=pallas_packed.default_skip_cap(strip[0]),
     )
     tier = "ici-megakernel" if use_ici else "ppermute"
-    log(f"  sharded ({mesh_ny},1) tier={tier} ({reason})")
+    log(f"  sharded ({mesh_ny},{mesh_nx}) tier={tier} ({reason})")
     board = jnp.asarray(make_board(size))
     p = packed.pack(board)
     pb = jax.device_put(np.asarray(p), packed_sharding(mesh))
@@ -321,21 +336,109 @@ def bench_sharded(
         reps=reps,
         target_seconds=target_seconds,
     )
+    # The executing plan's ICI traffic, straight from the planner (one
+    # source of truth with dryrun_multichip): row meshes ship y-halos
+    # only; 2-D meshes report both directions (x includes the corner
+    # blocks, which ride the full-height column buffers).
+    plan = pallas_halo.launch_plan((size, size // 32), (mesh_ny, mesh_nx))
+    halo = {
+        "halo_bytes_y": plan.get("halo_bytes_y", plan["halo_bytes"]),
+        "halo_bytes_x": plan.get("halo_bytes_x", 0),
+    }
     record = {
-        "metric": f"gol_sharded_{mesh_ny}x1_{size}x{size}_{tier}",
+        "metric": f"gol_sharded_{mesh_ny}x{mesh_nx}_{size}x{size}_{tier}",
         "unit": "generations/sec",
         "value": round(qstats["median"], 2),
-        "mesh": [mesh_ny, 1],
+        "mesh": [mesh_ny, mesh_nx],
         "size": size,
         "tier": tier,
         "tier_policy": reason,
         "skip_stable": skip_stable,
         "kturns": kturns,
         "burnin": burnin,
+        **halo,
         **qstats,
     }
     log(f"  sharded record: {json.dumps(record)}")
     return record
+
+
+def bench_mesh2d(
+    size: int,
+    meshes: tuple = ((8, 1), (4, 2), (2, 4)),
+    reps: int = 5,
+    kturns: int = 256,
+) -> dict:
+    """INTERLEAVED mesh-shape comparison (round 7): the same board and
+    dispatch depth through the sharded tier on each (ny, nx) mesh, reps
+    taken round-robin (the bench_faults methodology — background-load
+    drift on a shared rig hits every arm alike), each arm a
+    {reps, median, spread} stats block plus its mesh shape, tier, and
+    per-direction halo bytes.  This is the BENCH_MESH2D artifact body:
+    on a CPU rig it measures the interpret-mode tiers (tier columns say
+    so — honest about what ran), on a TPU rig the real ICI tiers."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_gol_tpu.models.life import CONWAY
+    from distributed_gol_tpu.ops import packed, pallas_packed
+    from distributed_gol_tpu.parallel import pallas_halo
+    from distributed_gol_tpu.parallel.mesh import make_mesh
+    from distributed_gol_tpu.parallel.packed_halo import packed_sharding
+    from distributed_gol_tpu.utils import measure
+
+    p = packed.pack(jnp.asarray(make_board(size)))
+    arms = []
+    for ny, nx in meshes:
+        mesh = make_mesh((ny, nx))
+        strip = (size // ny, size // 32 // nx)
+        use_ici, reason = pallas_halo.ici_tier_policy(
+            mesh,
+            strip=strip,
+            tile_cap=pallas_packed.default_skip_cap(strip[0]),
+        )
+        pb = jax.device_put(np.asarray(p), packed_sharding(mesh))
+        run = pallas_halo.make_superstep(mesh, CONWAY, skip_stable=True)
+        pb = run(pb, kturns)  # compile + warm
+        _sync(pb)
+        arms.append(
+            {
+                "mesh": (ny, nx),
+                "tier": "ici-megakernel" if use_ici else "ppermute",
+                "tier_policy": reason,
+                "run": run,
+                "board": pb,
+                "rates": [],
+            }
+        )
+        log(f"  mesh2d arm ({ny},{nx}): tier={arms[-1]['tier']}")
+    for rep in range(reps):
+        for arm in arms:  # round-robin: one rep per arm per pass
+            t0 = time.perf_counter()
+            arm["board"] = arm["run"](arm["board"], kturns)
+            _sync(arm["board"])
+            arm["rates"].append(kturns / (time.perf_counter() - t0))
+    rows = []
+    for arm in arms:
+        ny, nx = arm["mesh"]
+        plan = pallas_halo.launch_plan((size, size // 32), (ny, nx))
+        rows.append(
+            {
+                "metric": f"gol_mesh2d_{ny}x{nx}_{size}x{size}_{arm['tier']}",
+                "unit": "generations/sec",
+                "value": round(measure.median(arm["rates"]), 2),
+                "mesh": [ny, nx],
+                "size": size,
+                "tier": arm["tier"],
+                "tier_policy": arm["tier_policy"],
+                "kturns": kturns,
+                "halo_bytes_y": plan.get("halo_bytes_y", plan["halo_bytes"]),
+                "halo_bytes_x": plan.get("halo_bytes_x", 0),
+                **measure.summarize(arm["rates"]),
+            }
+        )
+        log(f"  mesh2d row: {json.dumps(rows[-1])}")
+    return {"interleaved": True, "reps_per_arm": reps, "rows": rows}
 
 
 def budget_for(size: int) -> float:
@@ -1477,12 +1580,23 @@ def main():
     )
     ap.add_argument(
         "--sharded-mesh",
-        type=int,
-        default=0,
-        metavar="NY",
-        help="also record the sharded pallas-packed tier on an (NY, 1) "
-        "mesh ({reps, median, spread}; the round-6 in-kernel ICI tier "
-        "when policy selects it, ppermute otherwise)",
+        type=str,
+        default="",
+        metavar="NY[xNX]",
+        help="also record the sharded pallas-packed tier on an (NY, NX) "
+        "mesh — an int NY is the classic row mesh (NY, 1); 'NYxNX' "
+        "(round 7) a full 2-D mesh ({reps, median, spread} + mesh shape "
+        "+ per-direction halo bytes; the in-kernel ICI tier when policy "
+        "selects it, ppermute otherwise).  '0' disables (the pre-round-7 "
+        "default spelling)",
+    )
+    ap.add_argument(
+        "--mesh2d",
+        action="store_true",
+        help="interleaved mesh-shape comparison at --size: the sharded "
+        "tier on (8,1) vs (4,2) vs (2,4), reps round-robin so rig drift "
+        "hits every arm alike; prints one lint-checked JSON line and "
+        "exits (BENCH_MESH2D artifact)",
     )
     ap.add_argument(
         "--force-ppermute",
@@ -1599,6 +1713,27 @@ def main():
         print(json.dumps(record))
         return
 
+    if args.mesh2d:
+        # Interleaved mesh-shape record (round 7): one JSON line, lint
+        # checked per row.  kturns stays shallow on CPU rigs (interpret
+        # tiers measure per-launch machinery, not TPU silicon — the tier
+        # column says exactly what ran); a TPU rig measures the real
+        # thing at the calibrated default.
+        dev0 = __import__("jax").devices()[0]
+        # CPU rigs dial the depth down to a few launches per rep: the
+        # interpret tiers are minutes-per-dispatch at the calibrated TPU
+        # depth, and the arm comparison needs identical depths anyway.
+        kt = args.kturns if dev0.platform != "cpu" else min(args.kturns, 54)
+        record = {
+            "metric": f"gol_mesh2d_interleaved_{args.size}x{args.size}",
+            "platform": dev0.platform,
+            **bench_mesh2d(args.size, reps=max(args.reps, 5), kturns=kt),
+        }
+        for row in record["rows"]:
+            measure.require_headline_stats(row)
+        print(json.dumps(record))
+        return
+
     if args.frames:
         # args.size deliberately uncapped: the frame-fetch paths never
         # run the engine, so the headline 16384^2 board records on any
@@ -1683,7 +1818,7 @@ def main():
         # settled number is machine-captured every round, not only via
         # tools/bench_65536.py.
         record["config4_65536"] = measure_65536(dev)
-    if args.sharded_mesh:
+    if args.sharded_mesh and parse_mesh(args.sharded_mesh)[0] > 0:
         record["sharded"] = bench_sharded(
             size,
             args.sharded_mesh,

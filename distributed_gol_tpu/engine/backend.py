@@ -34,6 +34,7 @@ def _megakernel_cache_stats() -> tuple[int, int]:
         from distributed_gol_tpu.parallel import pallas_halo
 
         infos.append(pallas_halo._build_dispatch_frontier_strip.cache_info())
+        infos.append(pallas_halo._build_dispatch_frontier_2d.cache_info())
     except ImportError:  # stripped jax build: the strip tier never loads
         pass
     for info in infos:
@@ -202,7 +203,7 @@ class Backend:
                         self.mesh,
                         strip=(
                             params.image_height // ny,
-                            params.image_width // 32,
+                            params.image_width // 32 // nx,
                         ),
                         tile_cap=self._skip_cap,
                         in_kernel=in_kernel,
@@ -452,6 +453,13 @@ class Backend:
         act = np.asarray(stats[-3][2])
         if act.size == 0:
             return None
+        if act.ndim == 2:
+            # 2-D meshes emit the (ny·grid, nx) stripe × x-device grid;
+            # the board-global per-stripe bitmap is its any-over-x — a
+            # stripe is active iff ANY of its column tiles saw activity
+            # (exactly the solo stripe semantics, which measure the full
+            # width at once).
+            return (act > 0).any(axis=1)
         return act > 0
 
     def _active_tiles(self) -> float | None:
@@ -508,15 +516,31 @@ class Backend:
                 # (1, 4) -> 1032/device) still warns below: a different
                 # mesh would run the fast tier, and that is worth a line.
                 return
-            # On a 2-D mesh (nx > 1) 'packed' IS auto's by-design choice:
-            # the flagship kernel is row-mesh-only (pallas_halo.supports
-            # requires nx == 1; halo_bytes_2d_model pins why), so running
-            # it there isn't a downgrade and must not warn (advisor r4).
-            preferred = (
-                "pallas-packed"
-                if jax.default_backend() == "tpu" and mesh_shape[1] == 1
-                else "packed"
-            )
+            if mesh_shape[1] == 1:
+                preferred = (
+                    "pallas-packed"
+                    if jax.default_backend() == "tpu"
+                    else "packed"
+                )
+            else:
+                # 2-D meshes (round 7): 'auto' aims for the 2-D tile
+                # tier exactly where its capability gate passes
+                # (word-aligned columns, 128-lane-quantum per-device
+                # widths on hardware); shapes outside the gate run
+                # 'packed' BY DESIGN — the lane-quantum physics
+                # (halo_bytes_2d_model), not a downgrade to warn about
+                # (advisor r4's rule, updated for the round-7 gate).
+                preferred = "packed"
+                if jax.default_backend() == "tpu":
+                    try:
+                        from distributed_gol_tpu.parallel import pallas_halo
+
+                        if pallas_halo.supports(
+                            (shape[0], shape[1] // 32), mesh_shape
+                        ):
+                            preferred = "pallas-packed"
+                    except ImportError:
+                        pass  # stripped jax build: packed is the ceiling
             if self._ENGINE_RANK[self.engine_used] >= self._ENGINE_RANK[preferred]:
                 return
             requested = f"auto (prefers '{preferred}' here)"

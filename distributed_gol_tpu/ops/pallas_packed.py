@@ -669,6 +669,14 @@ def _route_active(tile, aux, merge, tile_h: int, pad: int, turns: int, rule):
     return route, stable.astype(jnp.int32)
 
 
+def _off(base, v):
+    """``base + v`` that leaves ``v`` untouched when ``base`` is the
+    literal 0 — the classic (base-free) kernels' dynamic slice offsets
+    are multiplication forms whose 8-/128-divisibility Mosaic proves
+    syntactically, and wrapping them in an add would break the proof."""
+    return v if isinstance(base, int) and base == 0 else base + v
+
+
 def _dma_window_in(x_hbm, tile, i, left, right, tile_h, pad, sems):
     """Load stripe ``i``'s halo-extended window (centre + both pad-row
     halos, overlapped DMAs) into the ``tile`` scratch — one home for the
@@ -698,18 +706,45 @@ def _dma_window_in(x_hbm, tile, i, left, right, tile_h, pad, sems):
     c2.wait()
 
 
-def _dma_route_out(route, tile, merge, aux, o_hbm, i, tile_h, pad, sem):
+def _dma_route_out(
+    route, tile, merge, aux, o_hbm, i, tile_h, pad, sem,
+    xpad=0, row_base=0, col_base=0, wp_out=None,
+):
     """Write the centre rows from whichever scratch :func:`_route_active`
     said holds them (0: tile, 1: merge, 2: aux) straight to the output —
     no staging copy.  One home for the single-device and sharded adaptive
-    kernels, like the tier body itself."""
+    kernels, like the tier body itself.
+
+    ``xpad`` (the 2-D mesh forms): the scratch windows carry an
+    ``xpad``-word column halo on each side (the x-direction analog of the
+    pad rows), so the centre is the column slice [xpad, xpad + wp_out).
+    ``row_base``/``col_base``/``wp_out`` place that centre inside a
+    larger output board (the virtual-mesh emulation, where one ref holds
+    every tile); the classic callers' defaults keep the literal
+    full-width slice forms Mosaic already proves."""
+    if wp_out is None:
+        wp_out = o_hbm.shape[1]
+    full_cols = (
+        isinstance(col_base, int) and col_base == 0
+        and wp_out == o_hbm.shape[1]
+    )
     for code, src in ((0, tile), (1, merge), (2, aux)):
 
         @pl.when(route == code)
         def _(src=src):
+            dst = (
+                o_hbm.at[pl.ds(_off(row_base, i * tile_h), tile_h), :]
+                if full_cols
+                else o_hbm.at[
+                    pl.ds(_off(row_base, i * tile_h), tile_h),
+                    pl.ds(col_base, wp_out),
+                ]
+            )
             out = pltpu.make_async_copy(
-                src.at[pl.ds(pad, tile_h), :],
-                o_hbm.at[pl.ds(i * tile_h, tile_h), :],
+                src.at[pl.ds(pad, tile_h), pl.ds(xpad, wp_out)]
+                if xpad
+                else src.at[pl.ds(pad, tile_h), :],
+                dst,
                 sem,
             )
             out.start()
@@ -882,6 +917,7 @@ def set_plan_geometry(geometry: PlanGeometry | None) -> PlanGeometry:
     if ph is not None:
         ph._build_dispatch_frontier_strip.cache_clear()
         ph._build_ext_launch_frontier.cache_clear()
+        ph._build_dispatch_frontier_2d.cache_clear()
     return prev
 
 
@@ -1099,6 +1135,7 @@ def _frontier_body(
     tile, aux, merge, colwin, sems,
     u_lo, u_hi, u_clo, u_chi,
     i, tile_h, pad, turns, rule, sub_rows, col_window,
+    xpad=0,
 ):
     """The compute branch of the frontier kernels — everything between
     the window DMA-in and the routed DMA-out, factored out so the
@@ -1135,13 +1172,28 @@ def _frontier_body(
     from the board edge (no torus x-wrap can matter).  The measure
     region [d − t6, d + t6] ∩ centre covers every row/column whose
     state can differ between gens T and T+6 (such a cell is within 6 of
-    a gen-T active cell, itself within T of a gen-0 one)."""
+    a gen-T active cell, itself within T of a gen-0 one).
+
+    ``xpad`` (the 2-D mesh forms): the window carries an ``xpad``-word
+    column halo per side whose outer gen-T/T+6 content is in-window
+    lane-wrap garbage (penetrating ≤ 1 cell/generation — the SAME
+    validity argument as the column tier, on the tile seam instead of
+    the board edge), so the measure is restricted to the TILE-LOCAL
+    centre columns [xpad, wp − xpad) and published in the local word
+    frame (``col_off = −xpad``).  Cross-seam activity is the
+    neighbouring tile's to measure — each active cell sits in exactly
+    one tile's centre, so the per-tile measures tile the board with no
+    gap and no double count.  ``xpad == 0`` is byte-for-byte the
+    classic full-width form."""
     t6 = turns + _SKIP_PERIOD
     w_lo = i * tile_h - pad  # window top, stripe-frame rows
     win_lo, m_lo, m_hi, windowed_ok = _frontier_placement(
         u_lo, u_hi, i, tile_h, pad, turns, sub_rows
     )
     wp = tile.shape[1]
+    seam = (
+        dict(col_off=-xpad, col_valid=(xpad, wp - xpad)) if xpad else {}
+    )
 
     def measure_args():
         return (win_lo, m_lo, m_hi, w_lo)
@@ -1155,13 +1207,13 @@ def _frontier_body(
         merge[:] = tile[:]
         merge[pl.ds(win_lo, sub_rows), :] = fixed
         g6 = jax.lax.fori_loop(0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), gT)
-        return (jnp.int32(1),) + _measure2(gT, g6, *measure_args())
+        return (jnp.int32(1),) + _measure2(gT, g6, *measure_args(), **seam)
 
     def full():
         gT = jax.lax.fori_loop(0, turns, lambda _, a: _gen(a, rule), tile[:])
         aux[:] = gT
         g6 = jax.lax.fori_loop(0, _SKIP_PERIOD, lambda _, a: _gen(a, rule), gT)
-        return (jnp.int32(2),) + _measure2(gT, g6, 0, m_lo, m_hi, w_lo)
+        return (jnp.int32(2),) + _measure2(gT, g6, 0, m_lo, m_hi, w_lo, **seam)
 
     def row_tiers():
         return jax.lax.cond(windowed_ok, windowed, full)
@@ -1202,7 +1254,7 @@ def _frontier_body(
 
 def _copy_rect(
     src, dst, tile, sem, r8, n8, c128, n128,
-    *, tile_h, wp, sub_rows, col_window,
+    *, tile_h, wp, sub_rows, col_window, row_base=0, col_base=0,
 ):
     """read→write copy of a chunked change-rect, staged through the
     ``tile`` scratch — one home for the single-device megakernel and the
@@ -1222,13 +1274,22 @@ def _copy_rect(
     (impossible by construction) degrades to full-width row chunks —
     sound because the read buffer holds S_l everywhere, so copying any
     superset of the published rect is correct — instead of being
-    silently dropped."""
+    silently dropped.
+
+    ``row_base``/``col_base`` place the (tile-local) rect inside a larger
+    board ref — the virtual-mesh emulation of the 2-D tier; the classic
+    callers' 0 defaults leave every slice expression byte-identical
+    (``_off`` never wraps a proof-carrying multiplication form in an add
+    when the base is the literal 0)."""
     row0 = r8 * 8
     col0 = c128 * 128
 
     def pair(shape_rows, shape_cols, s_row, d_row, c0):
         c_in = pltpu.make_async_copy(
-            src.at[pl.ds(s_row, shape_rows), pl.ds(c0, shape_cols)],
+            src.at[
+                pl.ds(_off(row_base, s_row), shape_rows),
+                pl.ds(_off(col_base, c0), shape_cols),
+            ],
             tile.at[pl.ds(0, shape_rows), pl.ds(0, shape_cols)],
             sem,
         )
@@ -1236,7 +1297,10 @@ def _copy_rect(
         c_in.wait()
         c_out = pltpu.make_async_copy(
             tile.at[pl.ds(0, shape_rows), pl.ds(0, shape_cols)],
-            dst.at[pl.ds(d_row, shape_rows), pl.ds(c0, shape_cols)],
+            dst.at[
+                pl.ds(_off(row_base, d_row), shape_rows),
+                pl.ds(_off(col_base, c0), shape_cols),
+            ],
             sem,
         )
         c_out.start()

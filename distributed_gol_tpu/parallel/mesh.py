@@ -212,11 +212,15 @@ def largest_mesh_shape(
     a ``height``×``width`` board — the elastic supervisor's reshard
     target after device loss.  ``word_aligned`` first prefers shapes the
     packed engine family can run ((width // nx) % 32 == 0, the
-    ``packed_halo.supports`` word-granularity gate), so a shrink keeps
-    the fast tier whenever any healthy factorisation allows it; with no
-    such shape it falls back to any dividing factorisation (the roll
-    engine supports every shape — bit-identical, slower).  Always
-    succeeds for ``n_devices >= 1``: (1, 1) divides everything."""
+    word-granularity gate shared by ``packed_halo.supports`` and the
+    round-7 2-D ``pallas_halo`` tier), so a shrink keeps the fast tiers
+    whenever any healthy factorisation allows it — including 2-D → 2-D
+    shrinks like (2, 4) → (2, 2), where the squarest-factorisation
+    preference lands on another word-aligned 2-D mesh rather than a
+    degenerate strip; with no such shape it falls back to any dividing
+    factorisation (the roll engine supports every shape — bit-identical,
+    slower).  Always succeeds for ``n_devices >= 1``: (1, 1) divides
+    everything."""
     if n_devices < 1:
         raise ValueError("largest_mesh_shape needs >= 1 device")
     word_gate = lambda ny, nx: (width // nx) % 32 == 0  # noqa: E731

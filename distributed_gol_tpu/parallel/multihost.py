@@ -374,7 +374,13 @@ def make_backend(params):
     if params.mesh_shape not in ((1, 1), (ny, 1)):
         raise ValueError(
             f"multi-host runs shard rows over all {ny} global devices; "
-            f"mesh_shape must be ({ny}, 1) (or left at (1, 1) to default)"
+            f"mesh_shape must be ({ny}, 1) (or left at (1, 1) to default). "
+            "2-D (ny, nx) meshes are a SINGLE-host capability: the 2-D "
+            "tier's halo exchange (in-kernel remote DMA or ppermute "
+            "x-halos) rides ICI, while this tier's host boundary crosses "
+            "DCN, where the row-banded layout keeps each host's halo one "
+            "contiguous ppermute per direction — run 2-D meshes "
+            "in-process (Params.mesh_shape) on one host's devices"
         )
     from dataclasses import replace
 

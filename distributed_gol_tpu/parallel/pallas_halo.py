@@ -57,6 +57,7 @@ from distributed_gol_tpu.models.life import CONWAY, LifeRule
 from distributed_gol_tpu.ops.pallas_packed import (
     _EMPTY_LO,
     _LANES,
+    _MAX_T,
     _SKIP_PERIOD,
     _adaptive_eligible,
     adaptive_launch_depth,
@@ -71,6 +72,7 @@ from distributed_gol_tpu.ops.pallas_packed import (
     _frontier_placement,
     _frontier_plan,
     _hit_union,
+    _off,
     _measure2,
     _nlaunch_chunks,
     _require_adaptive_eligible,
@@ -84,29 +86,82 @@ from distributed_gol_tpu.parallel.halo import BOARD_SPEC, _shift_perm
 from distributed_gol_tpu.utils.compat import axis_size, shard_map
 
 
+def _xpad_words(wpl: int, interpret: bool) -> int:
+    """x-direction halo width in packed words per side for the 2-D mesh
+    kernels (the column analog of the ``pad`` rows), for a per-device
+    tile ``wpl`` words wide.  Real hardware ships one full 128-lane
+    quantum — Mosaic lane slices are 128-quantized (the measured
+    column-blocking physics recorded in ``halo_bytes_2d_model``), so the
+    quantum is the floor regardless of T, and the supports() gate already
+    guarantees ``wpl ≥ 128``.  Interpret mode has no lane constraint:
+    the halo is just wide enough for the deepest launch this tile can
+    host (``T + 6`` cells, see :func:`_x_depth_cap`), clamped to the
+    tile width so the exchange stays neighbour-only — which is what lets
+    hermetic CPU tests run tiles a handful of words wide.  Pure function
+    of (wpl, interpret), so every planner call site lands on the same
+    halo for the same tile."""
+    if not interpret:
+        return _LANES
+    cap = min(_MAX_T, 32 * wpl - _SKIP_PERIOD)
+    return min(wpl, -(-(cap + _SKIP_PERIOD) // 32))
+
+
+def _x_depth_cap(xpad: int) -> int:
+    """Deepest launch an ``xpad``-word x-halo absorbs: the horizontal
+    light cone of T generations plus the 6-generation measure must stay
+    inside ``xpad · 32`` cells (the exact x-analog of ``pad ≥ T``).  On
+    hardware (xpad = 128) this is 4090 — never binding for T ≤ 128; it
+    only bites interpret-mode tiles a few words wide."""
+    return 32 * xpad - _SKIP_PERIOD
+
+
 def supports(pshape: tuple[int, int], mesh_shape: tuple[int, int]) -> bool:
     """Whether the packed (H, wp) board runs the sharded temporally-blocked
-    kernel on an (ny, nx) mesh: row-sharded only (nx == 1), strips tall
-    enough to tile, lane-aligned width on real hardware (interpret mode has
-    no lane constraint, so hermetic CPU tests can exercise every shape)."""
+    kernel family on an (ny, nx) mesh.  Row meshes (nx == 1) run the strip
+    kernels (full-width lane rotate = the exact torus x-wrap); 2-D meshes
+    (round 7) run the x-extended tile kernels — each device owns an
+    (h/ny, wp/nx) word-aligned tile ((W//nx) % 32 == 0, the same gate as
+    ``parallel/mesh.py``) whose windows carry an ``_xpad_words`` column
+    halo per side.  Strips/tiles must tile in VMEM at the minimum pad;
+    widths must sit on the 128-lane quantum on real hardware (interpret
+    mode has no lane constraint, so hermetic CPU tests can exercise every
+    shape)."""
     h, wp = pshape
     ny, nx = mesh_shape
-    if wp <= 0 or nx != 1 or h % ny:
+    if wp <= 0 or h % ny:
         return False
     h_loc = h // ny
     if h_loc % 8 or h_loc < 8:
         return False
-    if not _use_interpret() and wp % _LANES:
+    ip = _use_interpret()
+    if nx == 1:
+        if not ip and wp % _LANES:
+            return False
+        return _tile_for_pad(h_loc, wp, 8) is not None
+    if wp % nx:
         return False
-    return _tile_for_pad(h_loc, wp, 8) is not None
+    wpl = wp // nx
+    if not ip and wpl % _LANES:
+        return False
+    return _tile_for_pad(h_loc, wpl + 2 * _xpad_words(wpl, ip), 8) is not None
 
 
 def _ext_kernel(
-    x_hbm, o_ref, tile, sem, *, tile_h, pad, turns, rule, skip_stable
+    x_hbm, o_ref, tile, sem, *, tile_h, pad, turns, rule, skip_stable, xpad=0
 ):
     """T generations of one (tile_h + 2·pad)-row window of the halo-extended
     strip.  The window is contiguous in the extended input — tile i's halo
-    rows ARE its neighbours' boundary rows — so a single DMA loads it."""
+    rows ARE its neighbours' boundary rows — so a single DMA loads it.
+
+    ``xpad`` (2-D meshes, round 7): the extended input also carries an
+    ``xpad``-word column halo per side, so the in-window lane rotate's
+    wrap error lands in the halo and penetrates ≤ 1 cell/generation —
+    absorbed by ``xpad·32 ≥ T`` cells exactly as the pad rows absorb the
+    vertical dependency; only the centre columns are written back.  The
+    skip proof survives unchanged: the probe compares the FULL extended
+    window (halo columns included), which is the conservative direction —
+    and when it passes, the same shrinking-interior induction pins the
+    centre on both axes (both margins ≥ T)."""
     i = pl.program_id(0)
     copy = pltpu.make_async_copy(
         x_hbm.at[pl.ds(i * tile_h, tile_h + 2 * pad), :], tile.at[:], sem
@@ -117,7 +172,10 @@ def _ext_kernel(
     # form is identical because the extended window already carries the
     # neighbour strips' boundary rows (ops/pallas_packed.py).
     out = _advance_window(tile[:], tile_h, pad, turns, rule, skip_stable)
-    o_ref[:] = out[pad : pad + tile_h, :]
+    if xpad:
+        o_ref[:] = out[pad : pad + tile_h, xpad : out.shape[1] - xpad]
+    else:
+        o_ref[:] = out[pad : pad + tile_h, :]
 
 
 def _ext_kernel_adaptive(
@@ -874,6 +932,1012 @@ def _build_dispatch_frontier_strip(
     )
 
 
+# -- 2-D mesh tier (round 7) --------------------------------------------------
+#
+# The strip tier above ends at (ny, 1): row strips get needle-thin long
+# before the device count runs out, which caps scale-out at ny devices and
+# keeps the 262144²-class board out of reach (ROADMAP item 3).  The 2-D
+# tier shards the packed board over a full (ny, nx) mesh — each device
+# owns an (h/ny, wp/nx) word-aligned tile — and generalises the SAME
+# kernel family:
+#
+# - Windows grow an ``xpad``-word column halo per side (one 128-lane
+#   quantum on hardware — Mosaic lane slices are 128-quantized, the
+#   ``halo_bytes_2d_model`` physics): the in-window lane rotate's wrap
+#   error lands in the halo and penetrates ≤ 1 cell/generation, absorbed
+#   by ``xpad·32 ≥ T + 6`` cells exactly as the pad rows absorb the
+#   vertical dependency.  The shared window bodies (``_advance_window``,
+#   ``_route_active``, ``_frontier_body``) are width-agnostic and run
+#   unchanged; measures are restricted to the tile-local centre columns
+#   (``_frontier_body(xpad=...)``).
+# - The ppermute fallback tier pre-extends the tile in 2-D
+#   (:func:`_extend_tile_2d` — corners ride the second exchange, the
+#   ``parallel/halo.py`` trick at word granularity) and runs the plain or
+#   probing-adaptive kernels; the probe-elision decision arrives
+#   precomputed (three ppermutes of flag arithmetic), so corner flags
+#   come along for free.
+# - The IN-KERNEL exchange tier (``_kernel_frontier_mega_2d``) runs whole
+#   launch chunks as ONE pallas_call per device: per launch it ships
+#   north/south edge rows, east/west edge word-columns, the FOUR corner
+#   blocks, and the per-stripe interval-state slabs of both x-neighbours
+#   via ``pltpu.make_async_remote_copy`` with 2-D MESH addressing — ten
+#   channels, send/recv semaphore pairs, launch-parity slot buffers, and
+#   an 8-direction entry barrier (6 on (1, nx): the N/S self-wrap is a
+#   local copy).  Edge stripes (i == 0, grid−1) always take the full
+#   route, so N/S interval state never crosses the wire (only the
+#   x-neighbour vectors do — every stripe's window spans the full local
+#   width + x-halo, so E/W activity gates every stripe's skip).
+# - Hermetic gating: the megakernel also builds in VIRTUAL mode — one
+#   device owns the whole board, the grid grows a virtual-device axis,
+#   and the exchange pulls each tile's halo blocks and neighbour slabs
+#   from the shared ping-pong board through the same slot buffers,
+#   parity discipline, and translation arithmetic.  The (1, 1) build is
+#   the loopback torus; (2, 2)/(2, 4)/(4, 2) virtual builds run
+#   hermetically in interpret mode, so everything except the literal
+#   remote-DMA lowering is identity-gated on CPU before a TPU rig ever
+#   sees the tier (the lowering is ``tools/hw_compile_gate.py``'s job,
+#   as for the strip tier).
+
+
+def _extend_tile_2d(local: jax.Array, pad: int, xpad: int) -> jax.Array:
+    """(h_loc, wpl) tile -> (h_loc + 2·pad, wpl + 2·xpad) with pad
+    boundary rows and xpad boundary word-columns from the torus
+    neighbours; the four corner blocks ride along by exchanging columns
+    OF the row-extended tile (the ``parallel/halo.py`` corner trick at
+    word granularity; a 1-sized axis self-sends = the torus wrap)."""
+    ny = axis_size("y")
+    nx = axis_size("x")
+    from_north = lax.ppermute(local[-pad:, :], "y", _shift_perm(ny, forward=True))
+    from_south = lax.ppermute(local[:pad, :], "y", _shift_perm(ny, forward=False))
+    ext = jnp.concatenate([from_north, local, from_south], axis=0)
+    from_west = lax.ppermute(ext[:, -xpad:], "x", _shift_perm(nx, forward=True))
+    from_east = lax.ppermute(ext[:, :xpad], "x", _shift_perm(nx, forward=False))
+    return jnp.concatenate([from_west, ext, from_east], axis=1)
+
+
+def _plan_tile_2d(
+    strip: tuple[int, int], turns: int, tile_cap: int | None, xpad: int
+) -> int:
+    """The tile height a 2-D adaptive launch will use — the one plan call
+    shared by the 2-D builders and ``make_superstep``'s grid arithmetic
+    (the x-extended form of ``_strip_plan_tile``)."""
+    tile_h = _tile_for_pad(
+        strip[0], strip[1] + 2 * xpad, _round8(turns), tile_cap
+    )
+    if tile_h is None:
+        raise ValueError(f"no VMEM tiling for {turns} turns on 2-D tile {strip}")
+    return tile_h
+
+
+def _exchange_scratch_bytes(
+    h_loc: int, wpl: int, xpad: int, pad: int, grid: int
+) -> int:
+    """VMEM bytes of the 2-D megakernel's exchange scratch beyond the
+    window working set ``_tile_for_pad`` already budgeted: the N/S row
+    slots, the two FULL-HEIGHT (h_loc + 2·pad) × xpad column-halo slot
+    pairs (the dominant term on tall tiles), and the three per-stripe
+    interval-state slab buffers — kept in sync with
+    ``_build_dispatch_frontier_2d``'s ``scratch_shapes``."""
+    h2 = h_loc + 2 * pad
+    return 4 * (
+        2 * (2 * pad) * wpl  # nhalo + shalo
+        + 2 * (2 * h2) * xpad  # whalo + ehalo
+        + 3 * (2 * grid * _STATE_SLAB) * _LANES  # mystate + wstate + estate
+    )
+
+
+def _plan_2d(
+    strip: tuple[int, int],
+    turns: int,
+    tile_cap: int | None,
+    interpret: bool,
+) -> tuple[int, int, int, int | None, int] | None:
+    """(xpad, pad, sub_rows, col_window, tile_h) for the 2-D frontier
+    megakernel on a per-device LOCAL (h_loc, wpl) tile, or None when the
+    geometry can't host it.  Rides ``_frontier_plan`` at the x-EXTENDED
+    width (the VMEM truth) but gates the column tier on the LOCAL width:
+    the rectangle route reads the un-extended HBM tile directly, so its
+    window must fit — and ``_col_placement``'s validity band keeps it t6
+    cells clear of the tile seam (the same argument that kept it clear
+    of the board edge, now per tile).  Also gates TOTAL VMEM: the
+    exchange scratch (full-height column-halo slots dominate on tall
+    tiles) rides on top of the window working set, and the plan declines
+    — a policy fallback to the ppermute tiers — any geometry whose
+    kernel could only fail at Mosaic allocation time on hardware (e.g.
+    65536-row tiles — 262144² on (4, 8) — carry ~134 MB of column-halo
+    slots alone; the 32768-row (8, 8) headline tile fits only at the
+    default 512-row cap).  The policy records the reason either way, and
+    the ppermute 2-D tiers carry the rest."""
+    h_loc, wpl = strip
+    xpad = _xpad_words(wpl, interpret)
+    if turns + _SKIP_PERIOD > 32 * xpad:
+        return None  # x-halo can't absorb the T+6 horizontal light cone
+    ext = (h_loc, wpl + 2 * xpad)
+    from distributed_gol_tpu.ops.pallas_packed import (
+        _PLANES,
+        _frontier_plan,
+        _vmem_physical,
+        plan_geometry,
+    )
+
+    fplan = _frontier_plan(ext, turns, tile_cap)
+    if fplan is None:
+        return None
+    pad, sub_rows, _cw_ext = fplan
+    cw = plan_geometry().col_window
+    col_window = cw if cw and wpl >= 2 * cw else None
+    tile_h = _tile_for_pad(h_loc, wpl + 2 * xpad, _round8(turns), tile_cap)
+    if tile_h is None:
+        return None
+    # The limit _build_dispatch_frontier_2d will request (the adaptive
+    # window factor of _compiler_params plus the exchange scratch) must
+    # fit under the compiler ceiling _compiler_params caps at.
+    ws = _PLANES * (tile_h + 2 * pad) * (wpl + 2 * xpad) * 4
+    exch = _exchange_scratch_bytes(h_loc, wpl, xpad, pad, h_loc // tile_h)
+    ceiling = _vmem_physical() - (8 << 20)
+    if int(ws * 2.5) + (8 << 20) + exch > ceiling:
+        return None
+    return xpad, pad, sub_rows, col_window, tile_h
+
+
+def _adaptive_plan_2d(
+    strip: tuple[int, int],
+    turns: int,
+    raw_cap: int | None,
+    interpret: bool,
+) -> tuple[int, int, bool, tuple | None]:
+    """(cap, t, adaptive, plan_2d) for a skip_stable dispatch on a 2-D
+    tile — the 2-D analog of ``_adaptive_strip_plan``, with the depth
+    decision made at the x-EXTENDED width (and clamped to the x-halo's
+    depth capacity) so the plan the depth policy assumed is the plan
+    that executes."""
+    cap = raw_cap if raw_cap is not None else default_skip_cap(strip[0])
+    xpad = _xpad_words(strip[1], interpret)
+    ext = (strip[0], strip[1] + 2 * xpad)
+    t, adaptive = adaptive_launch_depth(
+        ext, min(turns, _x_depth_cap(xpad)), cap
+    )
+    plan2 = _plan_2d(strip, t, cap, interpret) if adaptive else None
+    return cap, t, adaptive, plan2
+
+
+def _ext_kernel_adaptive_2d(
+    elig_ref, x_ext, dst_prev, o_hbm, st_ref, tile, aux, merge, sems,
+    *, tile_h, pad, xpad, turns, rule
+):
+    """The probing adaptive launch on a 2-D mesh tile: the x-extended
+    analog of ``_ext_kernel_adaptive`` whose probe-elision decision
+    arrives PRECOMPUTED (``elig_ref``, SMEM int32[grid, 1]).  The 3×3
+    tile-neighbourhood flag conjunction — own strip's extended flags AND
+    both x-neighbours' (whose own N/S edge flags bring the corners) — is
+    three ppermutes of host-side flag arithmetic in ``make_superstep``,
+    so the kernel stays mesh-shape-agnostic.  The input is the
+    pre-extended (h_loc + 2·pad, wpl + 2·xpad) tile: one contiguous
+    window DMA per stripe (the 2-D fallback tier trades the round-4
+    no-pre-extension optimisation for one exchange that covers rows,
+    columns AND corners).  The probe window carries the x-halo columns;
+    the stability compare therefore includes their in-window wrap
+    garbage — exactly the conservative direction: a stripe only skips
+    when its whole extended window, halos included, is period-6 stable,
+    and the skip proof's shrinking-interior induction then pins the
+    centre on both axes (both margins ≥ T).  Ping-pong write elision
+    (``dst_prev`` aliased onto the output) is the strip kernel's
+    contract unchanged."""
+    del dst_prev  # same memory as o_hbm (aliased); contents ARE the output
+    i = pl.program_id(0)
+    elide = elig_ref[i, 0] == 1
+
+    @pl.when(elide)
+    def _():
+        st_ref[i, 0] = 1
+
+    @pl.when(jnp.logical_not(elide))
+    def _():
+        c = pltpu.make_async_copy(
+            x_ext.at[pl.ds(i * tile_h, tile_h + 2 * pad), :],
+            tile.at[:],
+            sems.at[0],
+        )
+        c.start()
+        c.wait()
+        route, stable = _route_active(tile, aux, merge, tile_h, pad, turns, rule)
+        st_ref[i, 0] = stable
+        _dma_route_out(
+            route, tile, merge, aux, o_hbm, i, tile_h, pad, sems.at[0],
+            xpad=xpad,
+        )
+
+
+@functools.lru_cache(maxsize=None)
+def _build_ext_launch_adaptive_2d(
+    strip: tuple[int, int],
+    rule: LifeRule,
+    turns: int,
+    interpret: bool,
+    tile_cap: int | None,
+    xpad: int,
+):
+    """The probing adaptive 2-D launch as ``(elig, ext_tile, dst_prev) ->
+    (tile, bitmap)`` with ``elig`` int32[grid, 1] (the precomputed 3×3
+    elision conjunction), ``ext_tile`` the 2-D pre-extended tile, and
+    ``dst_prev`` ALIASED onto the tile output — the strip form's
+    ping-pong write-elision contract.  Bitmap entries are (grid, 1) so
+    the shard_map out-spec can concatenate them over BOTH mesh axes."""
+    h_loc, wpl = strip
+    _require_adaptive_eligible(turns)
+    pad = _round8(turns)
+    wpe = wpl + 2 * xpad
+    tile_h = _plan_tile_2d(strip, turns, tile_cap, xpad)
+    grid = h_loc // tile_h
+    kernel = partial(
+        _ext_kernel_adaptive_2d,
+        tile_h=tile_h,
+        pad=pad,
+        xpad=xpad,
+        turns=turns,
+        rule=rule,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h_loc, wpl), jnp.uint32),
+            jax.ShapeDtypeStruct((grid, 1), jnp.int32),
+        ],
+        input_output_aliases={2: 0},
+        scratch_shapes=[
+            pltpu.VMEM((tile_h + 2 * pad, wpe), jnp.uint32),
+            pltpu.VMEM((tile_h + 2 * pad, wpe), jnp.uint32),  # probe buffer
+            pltpu.VMEM((tile_h + 2 * pad, wpe), jnp.uint32),  # merge buffer
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        compiler_params=_compiler_params(tile_h, pad, wpe, True),
+        interpret=interpret,
+    )
+
+
+def _kernel_frontier_mega_2d(
+    *refs,
+    tile_h, pad, xpad, grid, nlaunch, turns, rule, sub_rows, col_window,
+    mesh_shape, remote,
+):
+    """The 2-D mesh dispatch as ONE kernel — the (ny, nx) form of
+    ``_kernel_frontier_mega_strip`` (protocol in the section notes
+    above).  Two builds share this body:
+
+    - ``remote=True``: one instance per device over its LOCAL (h_loc,
+      wpl) ping-pong boards; the launch prologue runs the ten-channel
+      remote exchange (N/S rows, E/W columns, 4 corner blocks, 2
+      interval-state slabs), with the N/S channels degenerating to local
+      self-copies on a (1, nx) mesh.
+    - ``remote=False`` (VIRTUAL): one instance owns the FULL board; the
+      grid grows a virtual-device axis v (sequential, so launch l−1's
+      writes — every tile's — complete before any launch-l read), and
+      the prologue pulls the same ten transfers from the neighbour tile
+      regions of the shared read board and the per-device state slabs,
+      through the same slot buffers.  (1, 1) is the loopback torus; the
+      hermetic interpret builds are how the whole 2-D protocol is
+      identity-gated on CPU.
+
+    Slot/parity discipline, forced launch-0 full unions, the rectangle/
+    classic/skip routing, and the change-rect write protocol are the
+    strip megakernel's verbatim; what is new is the x-halo window
+    assembly (five DMAs: centre, N, S, and full-height W/E column
+    blocks whose top/bottom pad rows ARE the corner blocks), the
+    x-neighbour state fold (every stripe's skip decision consumes both
+    x-neighbours' stripe intervals at i−1, i, i+1 — their row frames
+    coincide, and their column entries translate by ∓wpl into the local
+    word frame), and forced-full edge stripes (i == 0, grid−1), which
+    keep every cross-row dependency exact without shipping N/S state."""
+    ny, nx = mesh_shape
+    nv = 1 if remote else ny * nx
+    if remote:
+        (ids_ref, xa, xb, oa, ob, sk_ref, act_ref,
+         tile, aux, merge, colwin,
+         nhalo, shalo, whalo, ehalo, mystate, wstate, estate,
+         ilo0, ihi0, ilo1, ihi1, iclo, ichi,
+         rr8, rn8, rc128, rn128,
+         acc, sems, xsems) = refs
+        l = pl.program_id(0)
+        i = pl.program_id(1)
+        v = 0
+    else:
+        (xa, xb, oa, ob, sk_ref, act_ref,
+         tile, aux, merge, colwin,
+         nhalo, shalo, whalo, ehalo, mystate, wstate, estate,
+         ilo0, ihi0, ilo1, ihi1, iclo, ichi,
+         rr8, rn8, rc128, rn128,
+         acc, sems, xsems) = refs
+        l = pl.program_id(0)
+        v = pl.program_id(1)
+        i = pl.program_id(2)
+    del xa, xb  # same memory as oa/ob (aliased); contents ARE the boards
+    t6 = turns + _SKIP_PERIOD
+    h_loc = grid * tile_h
+    wpe = tile.shape[1]
+    wpl = wpe - 2 * xpad
+    H2 = h_loc + 2 * pad  # E/W halo buffer rows per slot (corners included)
+    nsb = grid * _STATE_SLAB  # state-slab rows per (parity, device) block
+    w_lo = i * tile_h - pad
+    w_hi = (i + 1) * tile_h + pad - 1
+    c_lo = i * tile_h
+    c_hi = (i + 1) * tile_h - 1
+    wr = jax.lax.rem(l, 2)
+    rd = 1 - wr
+    even = wr == 0
+    first = l == 0
+    slot = wr
+    if remote:
+        dy = dx = 0
+        row0 = 0
+        col0 = 0
+        gi = i
+        my_sbase = 0  # mystate block index base (× nsb rows)
+    else:
+        dy = v // nx
+        dx = jax.lax.rem(v, nx)
+        row0 = dy * h_loc
+        col0 = dx * wpl
+        gi = v * grid + i
+        my_sbase = v
+
+    def bsl(ref, r, nr, c, nc):
+        # Full-width column slices keep the literal `:` form the strip
+        # kernels lower; offset forms only where the 2-D geometry needs
+        # them (virtual mode is interpret-only, so dynamic column bases
+        # never meet Mosaic).
+        if isinstance(c, int) and c == 0 and nc == ref.shape[1]:
+            return ref.at[pl.ds(r, nr), :]
+        return ref.at[pl.ds(r, nr), pl.ds(c, nc)]
+
+    first_step = first & (i == 0)
+    if not remote:
+        first_step = first_step & (v == 0)
+
+    @pl.when(first_step)
+    def _():
+        acc[0] = 0
+
+    @pl.when(first)
+    def _():
+        # Per-stripe activity accumulator (ISSUE 11), zeroed at launch 0.
+        if remote:
+            act_ref[i, 0] = 0
+        else:
+            act_ref[gi] = 0
+
+    # -- launch prologue: the ten-channel exchange ----------------------------
+    if remote:
+        def dev(k):
+            # Channel k's (y, x) MESH target; ids_ref = [y_n, y_s, x_w,
+            # x_e, my_y, my_x].
+            table = (
+                (0, 5), (1, 5), (4, 2), (4, 3),
+                (0, 2), (0, 3), (1, 2), (1, 3),
+                (4, 2), (4, 3),
+            )
+            a, b = table[k]
+            return (ids_ref[a], ids_ref[b])
+
+        # (1, nx): the N/S "neighbour" is this device — the torus
+        # self-wrap is a local copy through the same slot buffers.
+        local_ch = (0, 1) if ny == 1 else ()
+        remote_ch = tuple(k for k in range(10) if k not in local_ch)
+        bar_dirs = tuple(k for k in range(8) if k not in local_ch)
+
+        def mk_exchange(rd_board, k):
+            state_src = mystate.at[pl.ds(rd * nsb, nsb), :]
+            srcs = (
+                rd_board.at[pl.ds(0, pad), :],
+                rd_board.at[pl.ds(h_loc - pad, pad), :],
+                rd_board.at[pl.ds(0, h_loc), pl.ds(0, xpad)],
+                rd_board.at[pl.ds(0, h_loc), pl.ds(wpl - xpad, xpad)],
+                rd_board.at[pl.ds(0, pad), pl.ds(0, xpad)],
+                rd_board.at[pl.ds(0, pad), pl.ds(wpl - xpad, xpad)],
+                rd_board.at[pl.ds(h_loc - pad, pad), pl.ds(0, xpad)],
+                rd_board.at[pl.ds(h_loc - pad, pad), pl.ds(wpl - xpad, xpad)],
+                state_src,
+                state_src,
+            )
+            dsts = (
+                shalo.at[pl.ds(slot * pad, pad), :],
+                nhalo.at[pl.ds(slot * pad, pad), :],
+                ehalo.at[pl.ds(slot * H2 + pad, h_loc), :],
+                whalo.at[pl.ds(slot * H2 + pad, h_loc), :],
+                ehalo.at[pl.ds(slot * H2 + pad + h_loc, pad), :],
+                whalo.at[pl.ds(slot * H2 + pad + h_loc, pad), :],
+                ehalo.at[pl.ds(slot * H2, pad), :],
+                whalo.at[pl.ds(slot * H2, pad), :],
+                estate.at[pl.ds(slot * nsb, nsb), :],
+                wstate.at[pl.ds(slot * nsb, nsb), :],
+            )
+            if k in local_ch:
+                return pltpu.make_async_copy(srcs[k], dsts[k], xsems.at[k])
+            return pltpu.make_async_remote_copy(
+                src_ref=srcs[k],
+                dst_ref=dsts[k],
+                send_sem=xsems.at[k],
+                recv_sem=xsems.at[10 + k],
+                device_id=dev(k),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+
+        def prologue(rd_board):
+            @pl.when(first)
+            def _():
+                # Rendezvous with every exchange partner before the first
+                # remote write lands in their scratch (8 directions; 6 on
+                # a (1, nx) mesh — the N/S self-slots neither signal nor
+                # count).  Coincident neighbours on small meshes receive
+                # one signal per DIRECTION, so the in-degree is always
+                # len(bar_dirs) by torus symmetry.
+                bar = pltpu.get_barrier_semaphore()
+                for k in bar_dirs:
+                    pltpu.semaphore_signal(
+                        bar,
+                        inc=1,
+                        device_id=dev(k),
+                        device_id_type=pltpu.DeviceIdType.MESH,
+                    )
+                pltpu.semaphore_wait(bar, len(bar_dirs))
+
+            @pl.when(jnp.logical_not(first))
+            def _():
+                # Launch l overwrites the buffer launch l−1's sends read.
+                for k in remote_ch:
+                    mk_exchange(rd_board, k).wait_send()
+
+            for k in remote_ch:
+                mk_exchange(rd_board, k).start()
+            for k in local_ch:
+                op = mk_exchange(rd_board, k)
+                op.start()
+                op.wait()
+            for k in remote_ch:
+                mk_exchange(rd_board, k).wait_recv()
+    else:
+        yn = jax.lax.rem(dy + ny - 1, ny)
+        ys = jax.lax.rem(dy + 1, ny)
+        xw = jax.lax.rem(dx + nx - 1, nx)
+        xe = jax.lax.rem(dx + 1, nx)
+
+        def prologue(rd_board):
+            # Virtual exchange: pull the ten transfers from the neighbour
+            # tile regions of the shared read board (S_l everywhere — the
+            # sequential grid finished launch l−1 for every tile) and the
+            # per-device state slabs, into the same slot buffers the
+            # remote build's messages land in.
+            wv = dy * nx + xw
+            ev = dy * nx + xe
+            pulls = (
+                # nhalo <- N tile's bottom rows; shalo <- S tile's top.
+                (bsl(rd_board, yn * h_loc + (h_loc - pad), pad, col0, wpl),
+                 nhalo.at[pl.ds(slot * pad, pad), :]),
+                (bsl(rd_board, ys * h_loc, pad, col0, wpl),
+                 shalo.at[pl.ds(slot * pad, pad), :]),
+                # W/E mid columns.
+                (bsl(rd_board, row0, h_loc, xw * wpl + (wpl - xpad), xpad),
+                 whalo.at[pl.ds(slot * H2 + pad, h_loc), :]),
+                (bsl(rd_board, row0, h_loc, xe * wpl, xpad),
+                 ehalo.at[pl.ds(slot * H2 + pad, h_loc), :]),
+                # Corner blocks: whalo top <- NW bottom-right, ehalo top
+                # <- NE bottom-left, whalo bottom <- SW top-right, ehalo
+                # bottom <- SE top-left.
+                (bsl(rd_board, yn * h_loc + (h_loc - pad), pad,
+                     xw * wpl + (wpl - xpad), xpad),
+                 whalo.at[pl.ds(slot * H2, pad), :]),
+                (bsl(rd_board, yn * h_loc + (h_loc - pad), pad,
+                     xe * wpl, xpad),
+                 ehalo.at[pl.ds(slot * H2, pad), :]),
+                (bsl(rd_board, ys * h_loc, pad,
+                     xw * wpl + (wpl - xpad), xpad),
+                 whalo.at[pl.ds(slot * H2 + pad + h_loc, pad), :]),
+                (bsl(rd_board, ys * h_loc, pad, xe * wpl, xpad),
+                 ehalo.at[pl.ds(slot * H2 + pad + h_loc, pad), :]),
+                # Both x-neighbours' interval-state vectors (published at
+                # launch l−1, parity rd).
+                (mystate.at[pl.ds((rd * nv + wv) * nsb, nsb), :],
+                 wstate.at[pl.ds(slot * nsb, nsb), :]),
+                (mystate.at[pl.ds((rd * nv + ev) * nsb, nsb), :],
+                 estate.at[pl.ds(slot * nsb, nsb), :]),
+            )
+            ops = [
+                pltpu.make_async_copy(src, dst, xsems.at[k])
+                for k, (src, dst) in enumerate(pulls)
+            ]
+            for op in ops:
+                op.start()
+            for op in ops:
+                op.wait()
+
+    @pl.when(i == 0)
+    def _():
+        @pl.when(even)
+        def _():
+            prologue(oa)
+
+        @pl.when(jnp.logical_not(even))
+        def _():
+            prologue(ob)
+
+    # -- the skip decision: own + both x-neighbours' tracked intervals --------
+    edge_n = i == 0
+    edge_s = i == grid - 1
+    iprev = jnp.maximum(i - 1, 0)
+    inext = jnp.minimum(i + 1, grid - 1)
+    gbase = 0 if remote else v * grid
+
+    ivals = []
+    cvals = []
+    for j in (iprev, i, inext):
+        gj = _off(gbase, j)
+        ivals.append((ilo0[rd, gj], ihi0[rd, gj]))
+        ivals.append((ilo1[rd, gj], ihi1[rd, gj]))
+        cvals.append((iclo[rd, gj], ichi[rd, gj]))
+    for buf, coff in ((wstate, -wpl), (estate, wpl)):
+        for j in (iprev, i, inext):
+            d = _decode_state6(
+                buf[pl.ds(slot * nsb + j * _STATE_SLAB, _STATE_SLAB), :]
+            )
+            # Same row frame (same y); column entries translate into the
+            # local word frame (empty intervals survive: lo > hi is
+            # offset-invariant).
+            ivals.append((d[0], d[1]))
+            ivals.append((d[2], d[3]))
+            cvals.append((d[4] + coff, d[5] + coff))
+    hit, u_lo, u_hi, u_clo, u_chi = _hit_union(
+        ivals, cvals, w_lo, w_hi, c_lo, c_hi, t6
+    )
+    # Forced-full stripes: launch 0 of a chunk (no tracked state yet) and
+    # the N/S edge stripes of every launch — their windows reach into the
+    # y-neighbours' tiles, whose interval state deliberately never
+    # crosses the wire (the full route is exact regardless).
+    forced = first | edge_n | edge_s
+    hit = hit | forced
+    u_lo = jnp.where(forced, c_lo - t6, u_lo)
+    u_hi = jnp.where(forced, c_hi + t6, u_hi)
+    p_r8 = rr8[rd, _off(gbase, i)]
+    p_n8 = rn8[rd, _off(gbase, i)]
+    p_c128 = rc128[rd, _off(gbase, i)]
+    p_n128 = rn128[rd, _off(gbase, i)]
+
+    def put_state(lo0, hi0, lo1, hi1, clo, chi, r8, n8, c128, n128):
+        gI = _off(gbase, i)
+        ilo0[wr, gI] = lo0
+        ihi0[wr, gI] = hi0
+        ilo1[wr, gI] = lo1
+        ihi1[wr, gI] = hi1
+        iclo[wr, gI] = clo
+        ichi[wr, gI] = chi
+        rr8[wr, gI] = r8
+        rn8[wr, gI] = n8
+        rc128[wr, gI] = c128
+        rn128[wr, gI] = n128
+        bump = (jnp.asarray(lo0) <= jnp.asarray(hi0)).astype(jnp.int32)
+        if remote:
+            act_ref[i, 0] = act_ref[i, 0] + bump
+        else:
+            act_ref[gi] = act_ref[gi] + bump
+        # EVERY stripe publishes its slab: both x-neighbours consume the
+        # full vector (unlike the strip form's edge-only tstate/bstate).
+        vec = _encode_state6((lo0, hi0, lo1, hi1, clo, chi))
+        sb = wr * nsb if remote else (wr * nv + my_sbase) * nsb
+        mystate[pl.ds(sb + i * _STATE_SLAB, _STATE_SLAB), :] = vec
+
+    def copy_rect(src, dst, r8, n8, c128, n128):
+        _copy_rect(
+            src, dst, tile, sems.at[0], r8, n8, c128, n128,
+            tile_h=tile_h, wp=wpl, sub_rows=sub_rows, col_window=col_window,
+            row_base=row0, col_base=col0,
+        )
+
+    @pl.when(jnp.logical_not(hit))
+    def _():
+        put_state(_EMPTY_LO, -1, _EMPTY_LO, -1, _EMPTY_LO, -1, 0, 0, 0, 0)
+        acc[0] = acc[0] + 1
+
+        @pl.when(p_n8 > 0)
+        def _():
+            @pl.when(even)
+            def _():
+                copy_rect(oa, ob, p_r8, p_n8, p_c128, p_n128)
+
+            @pl.when(jnp.logical_not(even))
+            def _():
+                copy_rect(ob, oa, p_r8, p_n8, p_c128, p_n128)
+
+    win_lo, m_lo, m_hi, windowed_ok = _frontier_placement(
+        u_lo, u_hi, i, tile_h, pad, turns, sub_rows
+    )
+    # Window top in LOCAL tile rows, carried in 8-row chunk units so
+    # Mosaic's divisibility proof survives (the recorded round-4 rule).
+    g8 = i * (tile_h // 8) - pad // 8 + win_lo // 8
+    g_lo = g8 * 8
+    if col_window is not None:
+        win_c, c_ok, cw = _col_placement(u_clo, u_chi, turns, col_window, wpl)
+        # Tile-local seam bounds: the rectangle route reads the
+        # UN-extended HBM tile directly, so the window must stay inside
+        # it on BOTH axes (rows here; columns via _col_placement's
+        # validity band, which keeps the reach t6 cells clear of the
+        # tile seam exactly as it kept clear of the board edge).
+        rect_ok = (
+            hit
+            & windowed_ok
+            & c_ok
+            & (g_lo >= 0)
+            & (g_lo + sub_rows <= h_loc)
+        )
+    else:
+        rect_ok = jnp.bool_(False)
+
+    if col_window is not None:
+        @pl.when(rect_ok)
+        def _():
+            def rect_in(board):
+                c = pltpu.make_async_copy(
+                    bsl(board, _off(row0, g_lo), sub_rows,
+                        _off(col0, win_c), col_window),
+                    colwin.at[:],
+                    sems.at[0],
+                )
+                c.start()
+                c.wait()
+
+            @pl.when(even)
+            def _():
+                rect_in(oa)
+
+            @pl.when(jnp.logical_not(even))
+            def _():
+                rect_in(ob)
+
+            gT, g6, merged = _col_compute(
+                colwin[:], turns, rule, cw, col_window, sub_rows
+            )
+            colwin[:] = merged
+            lo0, hi0, lo1, hi1, clo, chi = _measure2(
+                gT, g6, win_lo, m_lo, m_hi, w_lo,
+                col_off=win_c, col_valid=(cw, col_window - cw),
+            )
+            r8 = jnp.maximum(g_lo, c_lo) // 8
+            n8 = jnp.minimum(g_lo + sub_rows, c_lo + tile_h) // 8 - r8
+            put_state(
+                lo0, hi0, lo1, hi1, clo, chi,
+                r8, n8, win_c // 128, col_window // 128,
+            )
+
+            def write_out(src_board, dst):
+                @pl.when(p_n8 > 0)
+                def _():
+                    copy_rect(src_board, dst, p_r8, p_n8, p_c128, p_n128)
+
+                full_span = n8 == sub_rows // 8
+
+                @pl.when(full_span)
+                def _():
+                    c = pltpu.make_async_copy(
+                        colwin.at[:],
+                        bsl(dst, _off(row0, g_lo), sub_rows,
+                            _off(col0, win_c), col_window),
+                        sems.at[0],
+                    )
+                    c.start()
+                    c.wait()
+
+                @pl.when(jnp.logical_not(full_span))
+                def _():
+                    def chunk(kk, _):
+                        c = pltpu.make_async_copy(
+                            colwin.at[pl.ds((r8 + kk - g8) * 8, 8), :],
+                            bsl(dst, _off(row0, (r8 + kk) * 8), 8,
+                                _off(col0, win_c), col_window),
+                            sems.at[0],
+                        )
+                        c.start()
+                        c.wait()
+                        return 0
+
+                    jax.lax.fori_loop(0, n8, chunk, 0)
+
+            @pl.when(even)
+            def _():
+                write_out(oa, ob)
+
+            @pl.when(jnp.logical_not(even))
+            def _():
+                write_out(ob, oa)
+
+    @pl.when(hit & jnp.logical_not(rect_ok))
+    def _():
+        def window_in(rd_board):
+            # The five-DMA x-extended window assembly: centre, N/S rows
+            # of the centre columns, and the full-height W/E column
+            # blocks (whose outer pad rows ARE the corner blocks).
+            center = pltpu.make_async_copy(
+                bsl(rd_board, _off(row0, i * tile_h), tile_h, col0, wpl),
+                tile.at[pl.ds(pad, tile_h), pl.ds(xpad, wpl)],
+                sems.at[0],
+            )
+            center.start()
+
+            n_dst = tile.at[pl.ds(0, pad), pl.ds(xpad, wpl)]
+            s_dst = tile.at[pl.ds(pad + tile_h, pad), pl.ds(xpad, wpl)]
+
+            @pl.when(edge_n)
+            def _():
+                pltpu.make_async_copy(
+                    nhalo.at[pl.ds(slot * pad, pad), :], n_dst, sems.at[1]
+                ).start()
+
+            @pl.when(jnp.logical_not(edge_n))
+            def _():
+                pltpu.make_async_copy(
+                    bsl(rd_board,
+                        _off(row0, (i - 1) * tile_h + (tile_h - pad)),
+                        pad, col0, wpl),
+                    n_dst,
+                    sems.at[1],
+                ).start()
+
+            @pl.when(edge_s)
+            def _():
+                pltpu.make_async_copy(
+                    shalo.at[pl.ds(slot * pad, pad), :], s_dst, sems.at[2]
+                ).start()
+
+            @pl.when(jnp.logical_not(edge_s))
+            def _():
+                pltpu.make_async_copy(
+                    bsl(rd_board, _off(row0, (i + 1) * tile_h), pad,
+                        col0, wpl),
+                    s_dst,
+                    sems.at[2],
+                ).start()
+
+            wst = pltpu.make_async_copy(
+                whalo.at[pl.ds(slot * H2 + i * tile_h, tile_h + 2 * pad), :],
+                tile.at[:, pl.ds(0, xpad)],
+                sems.at[3],
+            )
+            wst.start()
+            est = pltpu.make_async_copy(
+                ehalo.at[pl.ds(slot * H2 + i * tile_h, tile_h + 2 * pad), :],
+                tile.at[:, pl.ds(xpad + wpl, xpad)],
+                sems.at[4],
+            )
+            est.start()
+
+            pltpu.make_async_copy(
+                nhalo.at[pl.ds(slot * pad, pad), :], n_dst, sems.at[1]
+            ).wait()
+            pltpu.make_async_copy(
+                shalo.at[pl.ds(slot * pad, pad), :], s_dst, sems.at[2]
+            ).wait()
+            wst.wait()
+            est.wait()
+            center.wait()
+
+        @pl.when(even)
+        def _():
+            window_in(oa)
+
+        @pl.when(jnp.logical_not(even))
+        def _():
+            window_in(ob)
+
+        route, lo0, hi0, lo1, hi1, clo, chi = _frontier_body(
+            tile, aux, merge, colwin, sems,
+            u_lo, u_hi, u_clo, u_chi,
+            i, tile_h, pad, turns, rule, sub_rows, None,
+            xpad=xpad,
+        )
+        put_state(
+            lo0, hi0, lo1, hi1, clo, chi,
+            c_lo // 8, tile_h // 8, 0, wpl // 128,
+        )
+
+        @pl.when(even)
+        def _():
+            _dma_route_out(
+                route, tile, merge, aux, ob, i, tile_h, pad, sems.at[0],
+                xpad=xpad, row_base=row0, col_base=col0, wp_out=wpl,
+            )
+
+        @pl.when(jnp.logical_not(even))
+        def _():
+            _dma_route_out(
+                route, tile, merge, aux, oa, i, tile_h, pad, sems.at[0],
+                xpad=xpad, row_base=row0, col_base=col0, wp_out=wpl,
+            )
+
+    last = (l == nlaunch - 1) & (i == grid - 1)
+    if not remote:
+        last = last & (v == nv - 1)
+
+    @pl.when(last)
+    def _():
+        if remote:
+            sk_ref[0, 0] = acc[0]
+            # The final launch's sends source the read buffer; they must
+            # clear before the kernel (and the buffer's lifetime) ends.
+            @pl.when(even)
+            def _():
+                for k in remote_ch:
+                    mk_exchange(oa, k).wait_send()
+
+            @pl.when(jnp.logical_not(even))
+            def _():
+                for k in remote_ch:
+                    mk_exchange(ob, k).wait_send()
+        else:
+            sk_ref[0] = acc[0]
+
+
+@functools.lru_cache(maxsize=12)
+def _build_dispatch_frontier_2d(
+    strip: tuple[int, int],
+    mesh_shape: tuple[int, int],
+    rule: LifeRule,
+    turns: int,
+    nlaunch: int,
+    interpret: bool,
+    tile_cap: int | None,
+    remote: bool,
+):
+    """The 2-D in-kernel-exchange megakernel.  ``remote=True`` builds the
+    per-device form: ``(ids, board, scratch_board) -> (board_a, board_b,
+    skipped[1,1], activity[grid,1])`` over LOCAL (h_loc, wpl) tiles, with
+    ``ids`` int32[6] = (north y, south y, west x, east x, own y, own x)
+    mesh coordinates — an SMEM input so the hardware compile gate can AOT
+    the remote lowering standalone.  ``remote=False`` builds the VIRTUAL
+    form over the FULL (ny·h_loc, nx·wpl) board on one device:
+    ``(board, scratch_board) -> (board_a, board_b, skipped[1],
+    activity[ny·nx·grid])`` with activity in virtual-device-major order
+    (the driver reshapes to the board-global (ny·grid, nx) bitmap).
+    Board args alias the ping-pong outputs; the final state is output
+    ``nlaunch % 2``.  Callers pass only ``_NLAUNCH_CANON`` values for
+    ``nlaunch`` (the bounded-compile-cache contract)."""
+    h_loc, wpl = strip
+    ny, nx = mesh_shape
+    _require_adaptive_eligible(turns)
+    plan2 = _plan_2d(strip, turns, tile_cap, interpret)
+    if plan2 is None:
+        raise ValueError(
+            f"no 2-D frontier plan for {turns} turns on tile {strip}"
+        )
+    xpad, pad, sub_rows, col_window, tile_h = plan2
+    grid = h_loc // tile_h
+    nv = 1 if remote else ny * nx
+    wpe = wpl + 2 * xpad
+    H2 = h_loc + 2 * pad
+    kernel = partial(
+        _kernel_frontier_mega_2d,
+        tile_h=tile_h,
+        pad=pad,
+        xpad=xpad,
+        grid=grid,
+        nlaunch=nlaunch,
+        turns=turns,
+        rule=rule,
+        sub_rows=sub_rows,
+        col_window=col_window,
+        mesh_shape=mesh_shape,
+        remote=remote,
+    )
+    smem_i32 = lambda shp: pltpu.SMEM(shp, jnp.int32)  # noqa: E731
+    scratch = [
+        pltpu.VMEM((tile_h + 2 * pad, wpe), jnp.uint32),
+        pltpu.VMEM((tile_h + 2 * pad, wpe), jnp.uint32),  # full buffer
+        pltpu.VMEM((tile_h + 2 * pad, wpe), jnp.uint32),  # merge buffer
+        pltpu.VMEM(
+            (sub_rows, col_window if col_window else _LANES), jnp.uint32
+        ),  # column-tier window (minimal dummy when the tier is off)
+        # Exchange slots (launch parity): N/S rows, full-height W/E
+        # column blocks (corner rows included), published + received
+        # interval-state slab vectors.
+        pltpu.VMEM((2 * pad, wpl), jnp.uint32),  # nhalo
+        pltpu.VMEM((2 * pad, wpl), jnp.uint32),  # shalo
+        pltpu.VMEM((2 * H2, xpad), jnp.uint32),  # whalo
+        pltpu.VMEM((2 * H2, xpad), jnp.uint32),  # ehalo
+        pltpu.VMEM(
+            (2 * nv * grid * _STATE_SLAB, _LANES), jnp.int32
+        ),  # mystate
+        pltpu.VMEM((2 * grid * _STATE_SLAB, _LANES), jnp.int32),  # wstate
+        pltpu.VMEM((2 * grid * _STATE_SLAB, _LANES), jnp.int32),  # estate
+        # Interval state (6) + change-rect state (4), (parity, stripe).
+        smem_i32((2, nv * grid)), smem_i32((2, nv * grid)),
+        smem_i32((2, nv * grid)), smem_i32((2, nv * grid)),
+        smem_i32((2, nv * grid)), smem_i32((2, nv * grid)),
+        smem_i32((2, nv * grid)), smem_i32((2, nv * grid)),
+        smem_i32((2, nv * grid)), smem_i32((2, nv * grid)),
+        smem_i32((1,)),  # skip accumulator
+        pltpu.SemaphoreType.DMA((5,)),
+        pltpu.SemaphoreType.DMA((20,)),  # exchange: 10 send + 10 recv
+    ]
+    # The exchange scratch rides on top of the window working set the
+    # shared helper budgets; raise the requested limit to match (capped
+    # at the same physical-VMEM ceiling — _plan_2d already declined any
+    # geometry that would overflow it).
+    from distributed_gol_tpu.ops.pallas_packed import _vmem_physical
+
+    exch = _exchange_scratch_bytes(h_loc, wpl, xpad, pad, grid)
+    ceiling = _vmem_physical() - (8 << 20)
+
+    def with_exchange(params):
+        return dataclasses.replace(
+            params,
+            vmem_limit_bytes=min(ceiling, params.vmem_limit_bytes + exch),
+        )
+
+    if remote:
+        params = with_exchange(
+            _compiler_params(tile_h, pad, wpe, True, sequential_grid=True)
+        )
+        params = dataclasses.replace(params, collective_id=9)
+        return pl.pallas_call(
+            kernel,
+            grid=(nlaunch, grid),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((h_loc, wpl), jnp.uint32),
+                jax.ShapeDtypeStruct((h_loc, wpl), jnp.uint32),
+                jax.ShapeDtypeStruct((1, 1), jnp.int32),
+                jax.ShapeDtypeStruct((grid, 1), jnp.int32),
+            ],
+            input_output_aliases={1: 0, 2: 1},
+            scratch_shapes=scratch,
+            compiler_params=params,
+            interpret=interpret,
+        )
+    H, WP = ny * h_loc, nx * wpl
+    params = with_exchange(
+        _compiler_params(tile_h, pad, wpe, True, sequential_grid=True, grid_rank=3)
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(nlaunch, nv, grid),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((H, WP), jnp.uint32),
+            jax.ShapeDtypeStruct((H, WP), jnp.uint32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((nv * grid,), jnp.int32),
+        ],
+        input_output_aliases={0: 0, 1: 1},
+        scratch_shapes=scratch,
+        compiler_params=params,
+        interpret=interpret,
+    )
+
+
 def ici_tier_policy(
     mesh: Mesh,
     interpret: bool | None = None,
@@ -893,37 +1957,43 @@ def ici_tier_policy(
     ``True`` overrides the env switch but never capability — a mesh the
     tier cannot serve still falls back, with the reason recorded.
 
-    ``strip`` (the per-device (h_loc, wp) packed strip, with
+    ``strip`` (the per-device LOCAL (h_loc, wp_loc) packed tile, with
     ``tile_cap``): also checks the GEOMETRY can host the tier — the
-    megakernel rides the frontier plan, probed here at the deep-dispatch
-    depth (the hw-gate convention), so a Backend's recorded tier cannot
-    claim in-kernel on a strip that has no plan.  A True verdict still
-    describes deep dispatches only: a dispatch too shallow for even one
-    adaptive launch runs the ppermute remainder forms regardless of
-    tier."""
+    megakernel rides the frontier plan (the 2-D plan on nx > 1 meshes,
+    which adds the x-halo VMEM and word-alignment requirements), probed
+    here at the deep-dispatch depth (the hw-gate convention), so a
+    Backend's recorded tier cannot claim in-kernel on a tile that has no
+    plan.  A True verdict still describes deep dispatches only: a
+    dispatch too shallow for even one adaptive launch runs the ppermute
+    remainder forms regardless of tier."""
     ip = _use_interpret() if interpret is None else interpret
     ny = mesh.shape["y"]
+    nx = mesh.shape["x"]
     if in_kernel is False:
         return False, "forced-ppermute (in_kernel=False)"
     if strip is not None:
-        _, _, adaptive, fplan = _adaptive_strip_plan(strip, 10**6, tile_cap)
+        if nx == 1:
+            _, _, adaptive, fplan = _adaptive_strip_plan(strip, 10**6, tile_cap)
+        else:
+            _, _, adaptive, fplan = _adaptive_plan_2d(strip, 10**6, tile_cap, ip)
         if not adaptive or fplan is None:
             return False, (
-                f"no frontier plan for strip {strip}: the in-kernel tier "
-                "rides the frontier megakernel (ppermute probing/plain "
-                "forms run instead)"
+                f"no frontier plan for tile {strip} on ({ny}, {nx}): the "
+                "in-kernel tier rides the frontier megakernel (ppermute "
+                "probing/plain forms run instead)"
             )
     if in_kernel is not True and os.environ.get("DGOL_ICI", "").lower() in (
         "0", "off", "false",
     ):
         return False, "forced-ppermute (DGOL_ICI=0)"
-    if ip and ny > 1:
+    if ip and ny * nx > 1:
         return False, (
             "interpret-mode multi-device: no remote-DMA emulation "
-            "(hermetic coverage runs the ny==1 loopback build; hardware "
-            "lowering is gated by tools/hw_compile_gate.py)"
+            "(hermetic coverage runs the loopback/virtual builds — "
+            "make_superstep_virtual_2d emulates (ny, nx) on one device; "
+            "hardware lowering is gated by tools/hw_compile_gate.py)"
         )
-    if ny > 1 and len({d.process_index for d in mesh.devices.flat}) > 1:
+    if ny * nx > 1 and len({d.process_index for d in mesh.devices.flat}) > 1:
         return False, (
             "multi-host mesh: the exchange crosses DCN, remote DMA is "
             "ICI-only (parallel/multihost.py keeps the ppermute form)"
@@ -1085,17 +2155,23 @@ def _build_ext_launch(
     interpret: bool,
     skip_stable: bool = False,
     tile_cap: int | None = None,
+    xpad: int = 0,
 ):
-    """pallas_call advancing a halo-extended (h_loc + 2·pad, wp) strip by
-    ``turns`` ≤ pad generations, returning the (h_loc, wp) centre.
-    ``tile_cap`` must be passed whenever the caller's skip_stable request
-    is active — even for non-adaptive-eligible launches — so planning and
-    execution use the same tile set (round-2 advisor finding)."""
+    """pallas_call advancing a halo-extended (h_loc + 2·pad, wp + 2·xpad)
+    strip by ``turns`` ≤ pad generations, returning the (h_loc, wp)
+    centre.  ``xpad == 0`` is the classic full-board-width strip form;
+    ``xpad > 0`` is the 2-D-mesh tile form (``strip`` is then the
+    per-device LOCAL (h_loc, wp/nx) shape and the caller pre-extends with
+    :func:`_extend_tile_2d`).  ``tile_cap`` must be passed whenever the
+    caller's skip_stable request is active — even for
+    non-adaptive-eligible launches — so planning and execution use the
+    same tile set (round-2 advisor finding)."""
     h_loc, wp = strip
+    wpe = wp + 2 * xpad
     if skip_stable:
         _require_adaptive_eligible(turns)
     pad = _round8(turns)
-    tile_h = _tile_for_pad(h_loc, wp, pad, tile_cap)
+    tile_h = _tile_for_pad(h_loc, wpe, pad, tile_cap)
     if tile_h is None:
         raise ValueError(f"no VMEM tiling for {turns} turns on strip {strip}")
     grid = h_loc // tile_h
@@ -1106,6 +2182,7 @@ def _build_ext_launch(
         turns=turns,
         rule=rule,
         skip_stable=skip_stable,
+        xpad=xpad,
     )
     return pl.pallas_call(
         kernel,
@@ -1114,10 +2191,10 @@ def _build_ext_launch(
         out_specs=pl.BlockSpec((tile_h, wp), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((h_loc, wp), jnp.uint32),
         scratch_shapes=[
-            pltpu.VMEM((tile_h + 2 * pad, wp), jnp.uint32),
+            pltpu.VMEM((tile_h + 2 * pad, wpe), jnp.uint32),
             pltpu.SemaphoreType.DMA,
         ],
-        compiler_params=_compiler_params(tile_h, pad, wp, skip_stable),
+        compiler_params=_compiler_params(tile_h, pad, wpe, skip_stable),
         interpret=interpret,
     )
 
@@ -1139,6 +2216,8 @@ def launch_plan(
     ny, nx = mesh_shape
     if not supports(pshape, mesh_shape):
         raise ValueError(f"pallas_halo does not support {pshape} on {mesh_shape}")
+    if nx > 1:
+        return _launch_plan_2d(pshape, mesh_shape, turns, skip_tile_cap)
     strip = (h // ny, wp)
     t = launch_turns(strip, turns, skip_tile_cap)
     pad = _round8(t)
@@ -1167,24 +2246,81 @@ def launch_plan(
     }
 
 
+def _launch_plan_2d(
+    pshape: tuple[int, int],
+    mesh_shape: tuple[int, int],
+    turns: int,
+    skip_tile_cap: int | None,
+) -> dict:
+    """The 2-D-mesh launch plan as data (round 7): per-device tile,
+    depth, and PER-DIRECTION halo traffic — ``halo_bytes_y`` (N + S edge
+    rows), ``halo_bytes_x`` (W + E edge word-columns INCLUDING the four
+    corner blocks, which ride the full-height column buffers), and their
+    total.  Same one-source-of-truth contract as the row plan: this is
+    what ``bench.py --sharded-mesh NYxNX`` records and what the
+    multi-chip scaling model reads."""
+    h, wp = pshape
+    ny, nx = mesh_shape
+    ip = _use_interpret()
+    strip = (h // ny, wp // nx)
+    xpad = _xpad_words(strip[1], ip)
+    ext = (strip[0], strip[1] + 2 * xpad)
+    t = launch_turns(ext, min(turns, _x_depth_cap(xpad)), skip_tile_cap)
+    pad = _round8(t)
+    tile_h = _tile_for_pad(strip[0], ext[1], pad, skip_tile_cap)
+    cap, t_a, adaptive, plan2 = _adaptive_plan_2d(strip, turns, skip_tile_cap, ip)
+
+    def bytes_2d(p):
+        return {
+            "halo_bytes_y": 2 * p * strip[1] * 4,
+            "halo_bytes_x": 2 * (strip[0] + 2 * p) * xpad * 4,
+            "halo_bytes": 2 * p * strip[1] * 4
+            + 2 * (strip[0] + 2 * p) * xpad * 4,
+        }
+
+    return {
+        "t": t,
+        "pad": pad,
+        "xpad": xpad,
+        "tile_h": tile_h,
+        "grid": None if tile_h is None else strip[0] // tile_h,
+        **bytes_2d(pad),
+        "adaptive_t": t_a if adaptive else None,
+        "frontier": None
+        if plan2 is None
+        else {
+            "pad": plan2[1],
+            "sub_rows": plan2[2],
+            "col_window": plan2[3],
+            **bytes_2d(plan2[1]),
+        },
+    }
+
+
 def halo_bytes_2d_model(
     pshape: tuple[int, int], mesh_shape: tuple[int, int], turns: int = 128
 ) -> dict:
-    """ICI bytes per device per launch a HYPOTHETICAL 2-D-mesh version of
-    this kernel would ship, vs the row mesh with the same device count —
-    the machine-checked form of the round-4 design decision to keep the
-    flagship tier row-only (``supports`` requires nx == 1).
+    """ICI bytes per device per launch the 2-D-mesh tier ships vs the row
+    mesh with the same device count — the machine-checked byte model
+    behind the tier policy's perf guidance.  Round 4 used this record to
+    keep the flagship tier row-only; round 7 SHIPPED the 2-D tier (this
+    ``two_d`` record now describes real traffic, see ``_launch_plan_2d``
+    for the executing plan) because the row ceiling caps scale-out at ny
+    devices — strips go needle-thin long before a pod runs out of chips,
+    and the 262144²-class board needs the full (ny, nx) mesh.  The byte
+    physics still holds and still matters:
 
     The y-halo is ``pad`` rows of the device's width.  The x-halo cannot
     be ``pad`` columns: the kernel's packed words live on the LANE axis,
     and Mosaic lane slices are 128-lane quantized (the measured
     column-blocking dead end in BASELINE.md is the same physics), so each
     x-halo ships ≥ 128 words = 4096 cells per side regardless of T ≤ 128.
-    At 65536² on 8 devices that makes the (2, 4) mesh ship ~40× the
-    (8, 1) mesh's bytes; SURVEY §2's "2-D halves halo bytes at scale"
-    holds only for byte-granular engines (roll/packed support 2-D meshes
-    for exactly that reason).  Row strips also keep the full-width lane
-    rotate = the exact torus x-wrap; a 2-D mesh loses that too."""
+    At 65536² on 8 devices the (2, 4) mesh ships ~40× the (8, 1) mesh's
+    bytes — so the tier policy and ``mesh_shape_for`` still PREFER row
+    meshes while strips stay tall enough, and the 2-D tier is the
+    scale-out lever past that point, not a free lunch.  Row strips also
+    keep the full-width lane rotate = the exact torus x-wrap; 2-D tiles
+    pay the x-halo instead."""
     h, wp = pshape
     ny, nx = mesh_shape
     pad = _round8(min(turns, 128))
@@ -1225,10 +2361,22 @@ def adaptive_strip_launches(
     generations performs across ALL devices — the denominator for the
     skip fraction, from the same plan ``make_superstep`` executes (the
     remainder launch is excluded there and here; mirrors
-    ``pallas_packed.adaptive_tile_launches``)."""
+    ``pallas_packed.adaptive_tile_launches``).  On 2-D meshes the
+    denominator spans every (stripe, x-device) cell of the board-global
+    activity grid — both the in-kernel 2-D tier and the probing 2-D
+    fallback count in those units."""
     if not supports(pshape, mesh_shape):
         return 0
-    ny = mesh_shape[0]
+    ny, nx = mesh_shape
+    if nx > 1:
+        ip = _use_interpret()
+        strip = (pshape[0] // ny, pshape[1] // nx)
+        cap, t, adaptive, _plan = _adaptive_plan_2d(strip, turns, tile_cap, ip)
+        full, _ = divmod(turns, t)
+        if not adaptive or not full:
+            return 0
+        tile_h = _plan_tile_2d(strip, t, cap, _xpad_words(strip[1], ip))
+        return full * ny * nx * (strip[0] // tile_h)
     strip = (pshape[0] // ny, pshape[1])
     # Resolve None exactly as make_superstep(skip_stable=True) does (from
     # the per-device STRIP height), so the "same plan" contract holds for
@@ -1277,9 +2425,191 @@ def make_superstep(
     ``activity`` (int32[ny·grid], ISSUE 11) is the board-global
     per-stripe activity vector in top-to-bottom board order (empty when
     the dispatch carries no adaptive telemetry) — same live-telemetry
-    contract as the single-device kernel."""
+    contract as the single-device kernel.
+
+    2-D meshes (round 7): ``nx > 1`` runs the x-extended tile family —
+    the in-kernel 2-D megakernel when ``ici_tier_policy`` selects it,
+    else the probing adaptive 2-D form (precomputed 3×3 elision flags),
+    else the plain 2-D form; ``activity`` is then the (ny·grid, nx)
+    board-global GRID (stripe × x-device) and ``skipped`` counts
+    (stripe, x-device) cells, matching ``adaptive_strip_launches``'s 2-D
+    denominator."""
     ny = mesh.shape["y"]
+    nx = mesh.shape["x"]
     raw_cap = skip_tile_cap
+
+    def _run_2d(board, turns, ip):
+        h, wp = board.shape
+        strip = (h // ny, wp // nx)
+        xpad = _xpad_words(strip[1], ip)
+        if skip_stable:
+            cap, t, t_adaptive, plan2 = _adaptive_plan_2d(
+                strip, turns, raw_cap, ip
+            )
+        else:
+            cap = None
+            t = launch_turns(
+                (strip[0], strip[1] + 2 * xpad),
+                min(turns, _x_depth_cap(xpad)),
+                None,
+            )
+            t_adaptive = False
+            plan2 = None
+        full, rem = divmod(turns, t)
+
+        def make_step(tt: int, adaptive_ok: bool = False):
+            adaptive = skip_stable and adaptive_ok and _adaptive_eligible(tt)
+            pad = _round8(tt)
+            if not adaptive:
+                call = _build_ext_launch(
+                    strip,
+                    rule,
+                    tt,
+                    ip,
+                    skip_stable and _adaptive_eligible(tt),
+                    cap if skip_stable else None,
+                    xpad,
+                )
+
+                @partial(
+                    shard_map,
+                    mesh=mesh,
+                    in_specs=BOARD_SPEC,
+                    out_specs=BOARD_SPEC,
+                    check_vma=False,
+                )
+                def step(local):
+                    return call(_extend_tile_2d(local, pad, xpad))
+
+                return step
+
+            call = _build_ext_launch_adaptive_2d(strip, rule, tt, ip, cap, xpad)
+
+            @partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=(BOARD_SPEC, BOARD_SPEC, BOARD_SPEC),
+                out_specs=(BOARD_SPEC, BOARD_SPEC),
+                check_vma=False,
+            )
+            def step(st, local, prev):
+                # The 3×3 elision conjunction, computed in XLA so the
+                # kernel stays mesh-shape-agnostic: extend own flags with
+                # the y-neighbours' edge flags, conjoin vertically, then
+                # conjoin with both x-neighbours' conjunctions — whose
+                # own edge flags bring the corner tiles along (the same
+                # two-phase trick as the halo exchange itself).
+                nf = lax.ppermute(st[-1:, :], "y", _shift_perm(ny, forward=True))
+                sf = lax.ppermute(st[:1, :], "y", _shift_perm(ny, forward=False))
+                extf = jnp.concatenate([nf, st, sf])
+                v3 = extf[:-2] * extf[1:-1] * extf[2:]
+                vw = lax.ppermute(v3, "x", _shift_perm(nx, forward=True))
+                ve = lax.ppermute(v3, "x", _shift_perm(nx, forward=False))
+                elig = v3 * vw * ve
+                return call(elig, _extend_tile_2d(local, pad, xpad), prev)
+
+            return step
+
+        def make_dispatch_ici(tt: int, nl: int):
+            call = _build_dispatch_frontier_2d(
+                strip, (ny, nx), rule, tt, nl, ip, cap, True
+            )
+
+            @partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=(BOARD_SPEC, BOARD_SPEC),
+                out_specs=(BOARD_SPEC, BOARD_SPEC, BOARD_SPEC, BOARD_SPEC),
+                check_vma=False,
+            )
+            def step(local, prev):
+                my = lax.axis_index("y")
+                mx = lax.axis_index("x")
+                ids = jnp.stack(
+                    [
+                        lax.rem(my + ny - 1, ny),
+                        lax.rem(my + 1, ny),
+                        lax.rem(mx + nx - 1, nx),
+                        lax.rem(mx + 1, nx),
+                        my,
+                        mx,
+                    ]
+                ).astype(jnp.int32)
+                return call(ids, local, prev)
+
+            return step
+
+        adaptive_t = skip_stable and t_adaptive
+        skipped = jnp.int32(0)
+        act = jnp.zeros((0,), jnp.int32)
+        use_ici = (
+            adaptive_t
+            and plan2 is not None
+            and ici_tier_policy(mesh, ip, in_kernel)[0]
+        )
+        if full and use_ici:
+            tile_h = plan2[4]
+            grid = strip[0] // tile_h
+            chunks, loose = _nlaunch_chunks(full)
+            a = jnp.zeros_like(board)
+            act = jnp.zeros((ny * grid, nx), jnp.int32)
+            for c in chunks:
+                step_c = make_dispatch_ici(t, c)
+                na, nb, sk, act_c = step_c(board, a)
+                board, a = (nb, na) if c % 2 else (na, nb)
+                skipped = skipped + jnp.sum(sk)
+                act = act + act_c
+            if loose:
+                step_l = make_step(t, adaptive_ok=True)
+                st = jnp.zeros((ny * grid, nx), jnp.int32)
+                prev = a
+                for _ in range(loose):
+                    nb, nst = step_l(st, board, prev)
+                    board, prev, st = nb, board, nst
+                    skipped = skipped + jnp.sum(nst)
+                    act = act + (1 - nst)
+        elif adaptive_t and full:
+            tile_h = _plan_tile_2d(strip, t, cap, xpad)
+            grid = strip[0] // tile_h
+            step_t = make_step(t, adaptive_ok=True)
+            st0 = jnp.zeros((ny * grid, nx), jnp.int32)
+            act = jnp.zeros((ny * grid, nx), jnp.int32)
+
+            def body(_, carry):
+                a, b, st, sk, ac = carry
+                nb1, nst1 = step_t(st, b, a)
+                nb2, nst2 = step_t(nst1, nb1, b)
+                return (
+                    nb1,
+                    nb2,
+                    nst2,
+                    sk + jnp.sum(nst1) + jnp.sum(nst2),
+                    ac + (1 - nst1) + (1 - nst2),
+                )
+
+            a, board, st, skipped, act = jax.lax.fori_loop(
+                0,
+                full // 2,
+                body,
+                (jnp.zeros_like(board), board, st0, skipped, act),
+            )
+            if full % 2:
+                board, nst = step_t(st, board, a)
+                skipped = skipped + jnp.sum(nst)
+                act = act + (1 - nst)
+        elif full:
+            step_t = make_step(t)
+            board = jax.lax.fori_loop(0, full, lambda _, b: step_t(b), board)
+        if rem and skip_stable:
+            rem6 = rem - rem % _SKIP_PERIOD
+            if rem6:
+                board = make_step(rem6)(board)
+                rem -= rem6
+        if rem:
+            board = make_step(rem)(board)
+        if with_stats:
+            return board, skipped, act
+        return board
 
     @partial(jax.jit, static_argnames=("turns",))
     def run(board: jax.Array, turns: int):
@@ -1288,6 +2618,8 @@ def make_superstep(
                 return board, jnp.int32(0), jnp.zeros((0,), jnp.int32)
             return board
         ip = _use_interpret() if interpret is None else interpret
+        if nx > 1:
+            return _run_2d(board, turns, ip)
         h, wp = board.shape
         strip = (h // ny, wp)
         if skip_stable:
@@ -1625,5 +2957,78 @@ def make_superstep_bytes(
             out, skipped, act = inner(p, turns)
             return unpack(out), skipped, act
         return unpack(inner(p, turns))
+
+    return run
+
+
+def make_superstep_virtual_2d(
+    mesh_shape: tuple[int, int],
+    rule: LifeRule = CONWAY,
+    interpret: bool | None = None,
+    skip_tile_cap: int | None = None,
+    with_stats: bool = False,
+):
+    """Single-device EMULATION of the 2-D in-kernel exchange tier — the
+    hermetic gating harness: ``(packed_board, turns) -> packed_board``
+    (or ``(board, skipped, activity)``) where the FULL packed board
+    advances through the SAME megakernel body as the hardware tier
+    (``_kernel_frontier_mega_2d``), built in VIRTUAL mode: the grid
+    grows a virtual-device axis and the launch prologue pulls each
+    tile's halo blocks (rows, columns, corners) and both x-neighbours'
+    interval-state slabs from the shared ping-pong board and state
+    scratch, through the same slot buffers, launch-parity discipline,
+    and frame-translation arithmetic the remote build ships over ICI.
+    ``(1, 1)`` is the production loopback torus; ``(2, 2)``-class builds
+    are how the whole 2-D protocol is identity-gated on CPU before a TPU
+    rig ever lowers the remote form.
+
+    Chunks follow the same ``_nlaunch_chunks`` decomposition as the
+    sharded tier; the sub-chunk tail and remainder run the XLA packed
+    engine (bit-identical, itself oracle-gated), so ``skipped`` /
+    ``activity`` cover the chunk launches exactly as the sharded
+    dispatch's megakernel portion does.  ``activity`` is reshaped to the
+    board-global (ny·grid, nx) bitmap the sharded tier emits."""
+    ny, nx = mesh_shape
+    raw_cap = skip_tile_cap
+
+    @partial(jax.jit, static_argnames=("turns",))
+    def run(pb: jax.Array, turns: int):
+        from distributed_gol_tpu.ops.packed import superstep as xla_superstep
+
+        ip = _use_interpret() if interpret is None else interpret
+        h, wp = pb.shape
+        if h % ny or wp % nx:
+            raise ValueError(f"board {pb.shape} does not divide {mesh_shape}")
+        strip = (h // ny, wp // nx)
+        cap, t, adaptive, plan2 = _adaptive_plan_2d(strip, turns, raw_cap, ip)
+        if not adaptive or plan2 is None:
+            raise ValueError(
+                f"no 2-D frontier plan for {pb.shape} on mesh {mesh_shape}"
+            )
+        tile_h = plan2[4]
+        grid = strip[0] // tile_h
+        full, rem = divmod(turns, t)
+        chunks, loose = _nlaunch_chunks(full)
+        skipped = jnp.int32(0)
+        act = jnp.zeros((ny * grid, nx), jnp.int32)
+        board = pb
+        a = jnp.zeros_like(board)
+        for c in chunks:
+            call = _build_dispatch_frontier_2d(
+                strip, mesh_shape, rule, t, c, ip, cap, False
+            )
+            na, nb, sk, act_c = call(board, a)
+            board, a = (nb, na) if c % 2 else (na, nb)
+            skipped = skipped + sk[0]
+            # Virtual-device-major activity -> board-global (stripe, x).
+            act = act + act_c.reshape(ny, nx, grid).transpose(0, 2, 1).reshape(
+                ny * grid, nx
+            )
+        tail = loose * t + rem
+        if tail:
+            board = xla_superstep(board, rule, tail)
+        if with_stats:
+            return board, skipped, act
+        return board
 
     return run

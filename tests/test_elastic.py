@@ -304,6 +304,57 @@ def test_device_down_unsupervised_is_pr2_sentinel_abort(tmp_path, sharded_oracle
 
 
 @pytest.mark.chaos
+def test_device_down_on_2d_mesh_recovers_on_shrunk_2d_mesh(tmp_path):
+    """Round-7 elastic row (ISSUE 13): a persistent device_down on a
+    (2, 4) 2-D mesh running the pallas-packed 2-D tile tier.  The
+    elastic rung condemns the dead chip and ``largest_mesh_shape(7, 64,
+    128)`` lands on (2, 2) — a 2-D → 2-D shrink that keeps the
+    word-aligned fast tier (128/2 = 64 cells/device, % 32 == 0) — and
+    the resharded run completes bit-identical to a fault-free (2, 4)
+    oracle."""
+    cfg = dict(
+        engine="pallas-packed", mesh_shape=(2, 4),
+        image_width=128, image_height=64, superstep=5, turns=30,
+        soup_density=0.25, soup_seed=11, cycle_check=0, ticker_period=60.0,
+    )
+    oracle_dir = tmp_path / "oracle"
+    oracle_dir.mkdir()
+    p0 = gol.Params(**cfg, out_dir=oracle_dir)
+    events0: queue.Queue = queue.Queue()
+    gol.run(p0, events0)
+    want_final = [
+        e for e in drain(events0) if isinstance(e, gol.FinalTurnComplete)
+    ][0]
+    want_board = (oracle_dir / f"{p0.final_output_name}.pgm").read_bytes()
+
+    params = gol.Params(
+        **cfg, out_dir=tmp_path, checkpoint_every_turns=5, restart_limit=3
+    )
+    plan = FaultPlan([Fault(2, "device_down", device=7)])
+    harness, factory = persistent_harness(params, plan)
+    events: queue.Queue = queue.Queue()
+    session = Session()
+    sup = supervise(
+        params,
+        events,
+        session=session,
+        backend_factory=factory,
+        device_probe=harness.device_probe,
+    )
+    stream = drain(events)
+    final = [e for e in stream if isinstance(e, gol.FinalTurnComplete)][0]
+    assert final.completed_turns == params.turns
+    assert sorted(final.alive) == sorted(want_final.alive)
+    got = (tmp_path / f"{params.final_output_name}.pgm").read_bytes()
+    assert got == want_board, "2-D recovered run differs from 2-D oracle"
+    assert sup.history[-1]["tier"] == "elastic"
+    assert sup.history[-1]["mesh_shape"] == [2, 2]
+    assert sup.history[-1]["excluded_devices"] == [7]
+    shrink = [r for r in sup.flight.records() if r["kind"] == "mesh_shrink"][0]
+    assert shrink["from_shape"] == [2, 4] and shrink["to_shape"] == [2, 2]
+
+
+@pytest.mark.chaos
 def test_all_devices_condemned_degrades_to_clean_abort(tmp_path):
     """The unsalvageable topology: devices die one per dispatch (distinct
     fault indices — a plan schedules one fault per dispatch) until every

@@ -72,9 +72,12 @@ def test_row_mesh_column():
 def test_2d_mesh_column():
     assert used("roll", mesh=(2, 4)) == "roll"
     assert used("packed", mesh=(2, 4)) == "packed"
-    # The T-deep kernel is row-mesh-only by design: documented fallback.
-    assert used("pallas-packed", mesh=(2, 2)) == "packed"
-    assert used("auto", mesh=(2, 4)) == "packed"
+    # Round 7: the T-deep kernel family covers word-aligned 2-D tiles —
+    # explicit pallas-packed runs the x-extended tile tier (interpret
+    # hermetically here; the in-kernel exchange on TPU pods).
+    assert used("pallas-packed", mesh=(2, 2)) == "pallas-packed"
+    assert used("pallas-packed", mesh=(2, 4)) == "pallas-packed"
+    assert used("auto", mesh=(2, 4)) == "packed"  # CPU auto: no upgrade
     with pytest.raises(NotImplementedError):
         used("pallas", mesh=(2, 2))
     # Per-device width not word-aligned: packed falls back to roll.
@@ -108,10 +111,12 @@ def test_auto_downgrade_warns_on_packable_widths():
 
 
 def test_auto_2d_mesh_on_tpu_is_policy_not_downgrade(monkeypatch, recwarn):
-    """Advisor r4: auto on a 2-D mesh resolves to 'packed' BY DESIGN (the
-    flagship kernel is row-mesh-only), so a TPU backend must not warn.
-    The backend is faked to 'tpu' for the resolution only — the (2, 2)
-    mesh never reaches a Pallas build (supports() rejects nx > 1 first)."""
+    """Advisor r4 (updated round 7): auto on a 2-D mesh whose per-device
+    width misses the 128-lane quantum resolves to 'packed' BY DESIGN
+    (the hardware gate of the 2-D tile tier), so a TPU backend must not
+    warn.  The backend is faked to 'tpu' for the resolution only — the
+    4096-wide board gives 64-word tiles on (2, 2), under the quantum, so
+    the mesh never reaches a Pallas build (supports() gates it first)."""
     import jax
 
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")

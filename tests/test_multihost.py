@@ -27,10 +27,44 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch_workers(tmp_path, mode, extra=()):
+# The known multihost flake class under full-suite rig load (CHANGES.md
+# PR-10 note): the gloo DCN stand-in's transport tears down mid-collective
+# in a worker subprocess, or a collective wedges until the watchdog —
+# plus the TOCTOU between ``free_port()`` closing its probe socket and the
+# coordinator binding it (another suite process can grab the port in
+# between).  ``_launch_workers`` therefore isolates the coordination port
+# PER ATTEMPT (a fresh ``free_port()`` each time, ok-files suffixed so a
+# half-failed attempt can't satisfy the next) and retries ONCE when the
+# failure carries the transport-crash signature or timed out; a second
+# failure — or any failure without the signature — is a real regression
+# and fails the test.
+# Deliberately NARROW: gloo/socket/port strings plus the two gRPC status
+# codes the distributed runtime surfaces for transport loss.  The
+# wedged-collective half of the flake class rarely prints anything — it
+# manifests as the 240 s communicate() timeout, which retries via the
+# separate ``timed_out`` flag.  A deterministic failure (wrong board,
+# assertion, crash in the code under test) matches neither and fails on
+# the first attempt.
+_TRANSPORT_FLAKE_SIGNS = (
+    "gloo",
+    "Gloo",
+    "transport",
+    "Connection reset",
+    "Connection closed",
+    "Socket closed",
+    "connection refused",
+    "Address already in use",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+)
+
+
+def _launch_workers_once(tmp_path, mode, extra, attempt):
+    """One cohort launch on a fresh coordinator port; returns
+    (outs, returncodes, okfiles, timed_out)."""
     nprocs = 2
     coordinator = f"127.0.0.1:{free_port()}"
-    okfiles = [tmp_path / f"ok{i}" for i in range(nprocs)]
+    okfiles = [tmp_path / f"ok{attempt}_{i}" for i in range(nprocs)]
     procs = [
         subprocess.Popen(
             [sys.executable, str(WORKER), coordinator, str(nprocs), str(i),
@@ -42,17 +76,47 @@ def _launch_workers(tmp_path, mode, extra=()):
         for i in range(nprocs)
     ]
     outs = []
+    timed_out = False
     for p in procs:
         try:
             out, _ = p.communicate(timeout=240)
         except subprocess.TimeoutExpired:
+            timed_out = True
             for q in procs:
                 q.kill()
-            pytest.fail("multihost worker timed out (collectives wedged?)")
-        outs.append(out)
-    for i, p in enumerate(procs):
-        assert p.returncode == 0, f"worker {i} failed:\n{outs[i][-3000:]}"
-        assert okfiles[i].exists(), f"worker {i} produced no ok-file"
+            out, _ = p.communicate()
+        outs.append(out or "")
+    return outs, [p.returncode for p in procs], okfiles, timed_out
+
+
+def _launch_workers(tmp_path, mode, extra=(), retries=1):
+    for attempt in range(retries + 1):
+        outs, rcs, okfiles, timed_out = _launch_workers_once(
+            tmp_path, mode, extra, attempt
+        )
+        if all(rc == 0 for rc in rcs) and all(f.exists() for f in okfiles):
+            return
+        blob = "\n".join(outs)
+        flaky = timed_out or any(s in blob for s in _TRANSPORT_FLAKE_SIGNS)
+        if attempt < retries and flaky:
+            # Bounded retry on the known-flake signature only — and leave
+            # the first attempt's tail on stdout so a recurring flake
+            # records what it actually printed (pytest -rA / CI logs).
+            print(
+                f"[multihost {mode}] attempt {attempt} hit the transport-"
+                f"flake signature (timed_out={timed_out}); retrying on a "
+                f"fresh port. Tail:\n{blob[-2000:]}"
+            )
+            continue
+        if timed_out:
+            pytest.fail(
+                "multihost worker timed out (collectives wedged?):\n"
+                + blob[-3000:]
+            )
+        for i, rc in enumerate(rcs):
+            assert rc == 0, f"worker {i} failed:\n{outs[i][-3000:]}"
+            assert okfiles[i].exists(), f"worker {i} produced no ok-file"
+        return
 
 
 def test_two_process_mesh_bit_identical(tmp_path):

@@ -154,8 +154,22 @@ class TestEngineResolution:
             assert self._resolve(engine="pallas-packed") == "packed"
 
     def test_pallas_packed_mesh_degrades_to_packed_halo(self):
+        # Round 7: word-aligned (2, 2) tiles RUN the 2-D tile tier now —
+        # the degrade survives only where the kernel family can't host
+        # the tile (here: 4-row strips, below the 8-row tiling floor),
+        # and an explicit request still warns on the way down.
+        assert (
+            self._resolve(engine="pallas-packed", mesh_shape=(2, 2))
+            == "pallas-packed"
+        )
         with pytest.warns(RuntimeWarning, match="falling back to 'packed'"):
-            assert self._resolve(engine="pallas-packed", mesh_shape=(2, 2)) == "packed"
+            assert (
+                self._resolve(
+                    engine="pallas-packed", mesh_shape=(2, 2),
+                    image_width=64, image_height=8,
+                )
+                == "packed"
+            )
 
     def test_packed_unsupported_width_falls_back(self):
         with pytest.warns(RuntimeWarning, match="falling back to 'roll'"):

@@ -81,16 +81,22 @@ _CONFIGS = [
     ("roll", (2, 1)),
     ("packed", (2, 1)),
     ("pallas-packed", (2, 1)),
+    # Column-sharded rows (round 7): the 2-D tile tier behind the same
+    # fetch seam — rects below cross the column seam at W/2 too.
+    ("packed", (2, 2)),
+    ("pallas-packed", (2, 2)),
 ]
 
 
 class TestBackendFetchViewport:
-    # Rect kinds: interior, toroidal-wrap (both axes), and one that
-    # straddles the (2,1)-mesh shard boundary at H/2.
+    # Rect kinds: interior, toroidal-wrap (both axes), one that
+    # straddles the (2,1)-mesh shard boundary at H/2, and one that
+    # straddles BOTH shard seams of a (2,2) mesh of 256².
     _RECTS = [
         (10, 40, 48, 64),
         (230, 230, 48, 64),
         (104, 0, 48, 64),  # straddles row 128 on a (2,1) mesh of 256 rows
+        (104, 100, 48, 64),  # straddles row 128 AND column 128 on (2,2)
     ]
 
     @pytest.mark.parametrize("engine,mesh", _CONFIGS)
@@ -209,6 +215,42 @@ class TestActivityBitmap:
         snap = obs_metrics.REGISTRY.snapshot().to_dict()
         assert snap["gauges"].get("backend.active_tiles") == 1.0
         assert "backend.skip_fraction" in snap["gauges"]
+
+    def test_sharded_2d_bitmap_and_viewport_are_exact(self):
+        """Round-7 row (ISSUE 13): on a column-sharded (2, 2) board the
+        activity bitmap assembles board-global over BOTH mesh axes (a
+        stripe is active iff any of its column tiles is) and
+        ``stencil.viewport`` through the Backend seam stays exact on
+        rects crossing the column seam."""
+        H, W = 256, 8192
+        b = np.zeros((H, W), np.uint8)
+        for dy, dx in [(0, 1), (1, 2), (2, 0), (2, 1), (2, 2)]:
+            b[200 + dy, 600 + dx] = 255  # glider: stripe 3, x-tile 0
+        p = Params(
+            image_width=W,
+            image_height=H,
+            turns=10**6,
+            engine="pallas-packed",
+            mesh_shape=(2, 2),
+            skip_stable=True,
+            skip_tile_cap=64,
+            metrics=False,
+        )
+        be = Backend(p)
+        dev = be.put(b)
+        for _ in range(3):
+            dev, _ = be.run_turns(dev, 36)
+        bm = be.activity_bitmap()
+        assert bm is not None and bm.ndim == 1 and bm.shape == (4,)
+        rows = be.activity_tile_rows()
+        assert rows == 64
+        assert bm[200 // rows]
+        assert not bm[0]
+        # Viewport exactness across the column seam at W/2.
+        full = be.fetch(dev)
+        for rect in [(190, 580, 32, 64), (100, 4080, 48, 64), (250, 8180, 32, 32)]:
+            got = be.fetch_viewport(dev, rect)
+            assert np.array_equal(got, crop(full, rect)), rect
 
     def test_sharded_bitmap_is_board_global(self):
         H, W = 4096, 4096

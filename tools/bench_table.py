@@ -66,6 +66,12 @@ def main():
                     "latency) plus the FramePlane fan-out row")
     ap.add_argument("--frames-viewport", type=int, default=1024,
                     metavar="V", help="viewport side for --frames")
+    ap.add_argument("--sharded-meshes", metavar="LIST", default=None,
+                    help="also run bench.bench_sharded per mesh (comma "
+                    "list of NY[xNX] specs, e.g. '8,4x2,2x4') at the "
+                    "largest --sizes entry and render the sharded-tier "
+                    "rows with their mesh-shape and per-direction "
+                    "halo-byte columns (round 7)")
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -109,6 +115,21 @@ def main():
             f"| {size}² | `{engine}` | {gps:,.0f} | {spread} | {reps} | "
             f"{cups:.3e} | {'n/a' if ok is None else ok} |"
         )
+
+    if args.sharded_meshes:
+        from bench import bench_sharded
+
+        # CPU rigs dial the dispatch depth down (the interpret tiers
+        # are slow at the TPU-calibrated depth; the tier column records
+        # what ran) — same policy as bench.py --mesh2d.
+        kt = args.kturns or (1024 if dev.platform != "cpu" else 54)
+        recs = [
+            bench_sharded(
+                sizes[-1], spec, reps=max(args.reps, 5), kturns=kt
+            )
+            for spec in args.sharded_meshes.split(",")
+        ]
+        print_sharded_table(recs)
 
     if args.faults is not None:
         from bench import bench_faults
@@ -177,6 +198,33 @@ def main():
                 f"| {size}² | {label} | {gps:,.0f} | {spread} | {reps} | "
                 f"{ratio} | {cache} | {retries} | {skip} |"
             )
+
+
+def print_sharded_table(recs: list) -> None:
+    """Render ``bench.bench_sharded`` records as markdown with the
+    round-7 mesh-shape column: one row per (ny, nx) mesh, carrying the
+    executing tier, the quiet-protocol stats block, and the planner's
+    per-direction ICI halo bytes (y = edge rows; x = edge word-columns
+    + the four corner blocks — 0 on row meshes)."""
+    from distributed_gol_tpu.utils import measure
+
+    print()
+    print(
+        "| Board | Mesh | Tier | gens/s (median) | spread | reps | "
+        "halo bytes/launch (y + x) |"
+    )
+    print("|---|---|---|---|---|---|---|")
+    for r in recs:
+        measure.require_headline_stats(r)
+        ny, nx = r["mesh"]
+        halo = (
+            f"{r.get('halo_bytes_y', 0):,} + {r.get('halo_bytes_x', 0):,}"
+        )
+        print(
+            f"| {r['size']}² | {ny}x{nx} | `{r['tier']}` | "
+            f"{r['median']:,.1f} | {r['spread']:.1%} | {r['reps']} | "
+            f"{halo} |"
+        )
 
 
 def print_frames_table(rec: dict) -> None:

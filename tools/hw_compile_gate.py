@@ -115,6 +115,37 @@ def _configs():
             args = [ext]
         jax.jit(call).lower(*args).compile()
 
+    def strip2d(local, mesh_shape, turns, geometry=None, virtual=False):
+        """The round-7 2-D mesh megakernel.  ``virtual=False`` AOT-
+        compiles the REMOTE build for the attached chip — ten-channel
+        remote DMA (N/S rows, E/W columns, four corner blocks, two
+        state-slab vectors), the 8-direction barrier, and the x-extended
+        window/rect offset arithmetic: the lowering class interpret mode
+        can never gate.  ``virtual=True`` compiles the interpret/virtual
+        emulation build (plain-XLA lowering) so the hermetic harness
+        stays buildable in the bench environment."""
+        def lower():
+            ctx = (
+                pp.plan_geometry_override(geometry)
+                if geometry is not None
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                call = ph._build_dispatch_frontier_2d(
+                    local, mesh_shape, CONWAY, turns, 8,
+                    virtual, pp.default_skip_cap(local[0]), not virtual,
+                )
+                if virtual:
+                    h = mesh_shape[0] * local[0]
+                    wp2 = mesh_shape[1] * local[1]
+                    b = jax.ShapeDtypeStruct((h, wp2), jnp.uint32)
+                    jax.jit(call).lower(b, b).compile()
+                else:
+                    i32 = jax.ShapeDtypeStruct((6,), jnp.int32)
+                    b = jax.ShapeDtypeStruct(local, jnp.uint32)
+                    jax.jit(call).lower(i32, b, b).compile()
+        return lower
+
     def batched_mega(nboards, shape, turns):
         """The leading-axis batched frontier megakernel (ISSUE 8): AOT-
         compile one canonical chunk at batch ``nboards`` — the lowering
@@ -241,6 +272,27 @@ def _configs():
                     )
             if adaptive:
                 cfgs.append((f"strip {s} probing T=18", strip("adaptive", s, 18)))
+        # 2-D mesh megakernel rows (round 7): the in-kernel exchange on
+        # full (ny, nx) meshes at both headline sizes × the candidate
+        # plan geometries — the 2-D tier consumes the same process-wide
+        # PlanGeometry knob, so every installable geometry must lower in
+        # the 2-D form too.
+        for ny2, nx2 in ((4, 2), (2, 4)):
+            local = (size // ny2, wp // nx2)
+            _, t2, a2, plan2 = ph._adaptive_plan_2d(local, 10**6, None, False)
+            if not a2 or plan2 is None:
+                continue
+            cfgs.append(
+                (f"mesh2d {ny2}x{nx2} {local} ici T={t2}",
+                 strip2d(local, (ny2, nx2), t2))
+            )
+            for geom in pp.geometry_candidates():
+                if geom == pp.plan_geometry():
+                    continue
+                cfgs.append(
+                    (f"mesh2d {ny2}x{nx2} {local} ici {geom.label} T={t2}",
+                     strip2d(local, (ny2, nx2), t2, geometry=geom))
+                )
         # The (1,1)-mesh loopback build of the in-kernel tier at the full
         # board shape (the sharded-flagship headline config of round 6).
         t_l, adaptive_l = pp.adaptive_launch_depth(
@@ -265,6 +317,14 @@ def _configs():
     # The serving plane's cohort workhorse: a 16-board batch of 512²
     # VMEM-resident boards in one launch (ISSUE 8).
     cfgs.append(("batched B=16 512^2 vmem-resident T=50", batched_vmem(16, 512, 50)))
+    # The (2, 2) interpret/virtual form of the 2-D megakernel (round 7):
+    # the hermetic emulation harness must stay BUILDABLE in the bench
+    # environment (the remote mesh2d rows above gate the Mosaic
+    # lowering; this one gates the plain-XLA virtual build).
+    cfgs.append(
+        ("mesh2d 2x2 virtual-interpret",
+         strip2d((2048, 64), (2, 2), 18, virtual=True))
+    )
     return cfgs
 
 
@@ -291,7 +351,14 @@ def run_gate(log=print, core: bool = False) -> dict:
                 # a Mosaic regression in the narrower window/rect offsets
                 # is driver-visible (the full candidate matrix is the
                 # CLI run).
-                "16384^2 adaptive m64c128")
+                "16384^2 adaptive m64c128",
+                # Round-7 2-D tier: one remote row per headline size
+                # (ten-channel exchange + corner blocks + x-extended
+                # offsets) plus the virtual-interpret build the hermetic
+                # harness rides.
+                "mesh2d 4x2 (4096, 256) ici T=",
+                "mesh2d 4x2 (16384, 1024) ici T=",
+                "mesh2d 2x2 virtual-interpret")
         cfgs = [(l, f) for l, f in cfgs if l.startswith(keep)]
         if len(cfgs) != len(keep):
             # The filter failing to find its configs IS a gate failure —
