@@ -1105,6 +1105,251 @@ def bench_frames(
     return record
 
 
+def bench_gateway(
+    size: int = 512,
+    spectators: int = 8,
+    turns: int = 24,
+    reps: int = 5,
+    superstep: int = 4,
+    viewport: int = 256,
+) -> dict:
+    """ISSUE 14: the in-process vs over-the-wire A/B for the network
+    gateway, interleaved per the ``utils/measure.py`` discipline (the
+    two arms of every rep run seconds apart, so a rig phase change
+    cannot masquerade as wire overhead).
+
+    Three questions, one record:
+
+    - **Control RTT**: ``GET /v1/sessions/<t>/state`` over a real
+      loopback socket (connect + request + JSON) vs the in-process
+      ``plane.handle()`` read it maps onto.
+    - **Frame wire economics**: one spectate session, N spectators —
+      the in-process FramePlane arm's shipped bytes/frame
+      (keyframe-then-delta, the PR-9 numbers) vs the wire arm's
+      streamed bytes/frame (same codec + the ws/header overhead).
+    - **Fan-out**: the wire arm's device fetches per published frame —
+      1.00 whatever N is (the FramePlane superset fetch preserved over
+      the wire; the acceptance pin).
+    """
+    import tempfile
+    import threading
+    import zlib
+    from pathlib import Path
+
+    from distributed_gol_tpu.engine.params import Params
+    from distributed_gol_tpu.obs import metrics as obs_metrics
+    from distributed_gol_tpu.serve import (
+        FramePlane,
+        GatewayServer,
+        ServeConfig,
+        ServePlane,
+    )
+    from distributed_gol_tpu.utils import measure
+    from tools.gol_client import GolClient
+
+    viewport = min(viewport, size)
+    out_root = Path(tempfile.mkdtemp(prefix="gol_bench_gateway_"))
+    reg = obs_metrics.REGISTRY
+
+    def spectate_params(tenant: str, n_turns: int) -> Params:
+        return Params(
+            turns=n_turns,
+            image_width=size,
+            image_height=size,
+            engine="roll",
+            soup_density=0.3,
+            soup_seed=zlib.crc32(tenant.encode()) & 0x7FFFFFFF,
+            out_dir=out_root / tenant,
+            no_vis=False,
+            view_mode="frame",
+            viewport=(0, 0, viewport, viewport),
+            frame_stride=1,
+            turn_events="batch",
+            cycle_check=0,
+            ticker_period=60.0,
+        )
+
+    plane = ServePlane(
+        ServeConfig(max_sessions=2, max_cells_per_session=size * size),
+        checkpoint_root=out_root / "ckpt",
+    )
+    gateway = GatewayServer(plane, port=0)
+    client = GolClient(gateway.url)
+    rng = np.random.default_rng(0)
+    sub_side = min(128, viewport)
+    rects = [
+        (
+            int(rng.integers(0, size)),
+            int(rng.integers(0, size)),
+            sub_side,
+            sub_side,
+        )
+        for _ in range(spectators)
+    ]
+
+    def run_inproc(tenant: str) -> dict:
+        hub = FramePlane(board_shape=(size, size))
+        subs = [hub.subscribe(r, maxsize=turns + 2) for r in rects]
+        before = reg.snapshot(include_lazy=False)
+        t0 = time.perf_counter()
+        handle = plane.submit(tenant, spectate_params(tenant, turns),
+                              frame_plane=hub)
+        assert handle.wait(timeout=600) and handle.status == "completed"
+        wall = time.perf_counter() - t0
+        delta = reg.snapshot(include_lazy=False).delta(before).to_dict()
+        counters = delta.get("counters", {})
+        for sub in subs:
+            hub.unsubscribe(sub)
+        return {
+            "wall_s": wall,
+            "frames": counters.get("frames.frames_served", 0),
+            "bytes": counters.get("frames.bytes_shipped", 0),
+            "publishes": counters.get("frames.publishes", 0),
+            "fetches": counters.get("frames.fetches", 0),
+        }
+
+    def run_wire(tenant: str) -> dict:
+        before = reg.snapshot(include_lazy=False)
+        t0 = time.perf_counter()
+        client.submit(
+            tenant,
+            width=size,
+            height=size,
+            turns=turns,
+            soup=0.3,
+            seed=zlib.crc32(tenant.encode()) & 0x7FFFFFFF,
+            spectate=True,
+            viewport=(0, 0, viewport, viewport),
+            params={"engine": "roll", "cycle_check": 0,
+                    "ticker_period": 60.0},
+        )
+
+        def watch(rect):
+            with client.spectate(
+                tenant, rect=rect, queue_depth=turns + 2
+            ) as stream:
+                while not stream.ended:
+                    ev = stream.recv(timeout=600)
+                    if not isinstance(ev, dict):
+                        stream.feed(ev)
+
+        threads = [
+            threading.Thread(target=watch, args=(r,), daemon=True)
+            for r in rects
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.perf_counter() - t0
+        delta = reg.snapshot(include_lazy=False).delta(before).to_dict()
+        counters = delta.get("counters", {})
+        return {
+            "wall_s": wall,
+            "frames": counters.get("gateway.frames_streamed", 0),
+            "bytes": counters.get("gateway.bytes_streamed", 0),
+            "publishes": counters.get("frames.publishes", 0),
+            "fetches": counters.get("frames.fetches", 0),
+        }
+
+    # -- control RTT (long-lived session, interleaved arms per rep) ----------
+    ctl = "gw-ctl"
+    client.submit(
+        ctl,
+        width=256,
+        height=256,
+        turns=10**9,
+        soup=0.3,
+        seed=1,
+        params={"engine": "roll", "superstep": superstep,
+                "cycle_check": 0, "ticker_period": 60.0},
+    )
+    ops = 20
+    inproc_rates, wire_rates = [], []
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        for _ in range(ops):
+            h = plane.handle(ctl)
+            _ = (h.status, h.last_turn, h.resumable)
+        inproc_rates.append(ops / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        for _ in range(ops):
+            client.state(ctl)
+        wire_rates.append(ops / (time.perf_counter() - t0))
+    client.quit(ctl)
+    plane.handle(ctl).wait(timeout=60)
+
+    # -- frame economics (interleaved in-process vs wire arms) ---------------
+    inproc_runs, wire_runs = [], []
+    fetch_ratio = []
+    for rep in range(max(1, reps)):
+        inproc_runs.append(run_inproc(f"gw-inproc-{rep}"))
+        wire = run_wire(f"gw-wire-{rep}")
+        wire_runs.append(wire)
+        if wire["publishes"]:
+            fetch_ratio.append(wire["fetches"] / wire["publishes"])
+
+    def frame_stats(runs, metric):
+        per_frame = [
+            r["bytes"] / r["frames"] for r in runs if r["frames"]
+        ]
+        rates = [r["frames"] / r["wall_s"] for r in runs]
+        return {
+            "metric": metric,
+            "unit": "frames/s",
+            **measure.summarize(rates),
+            "bytes_per_frame": measure.median(per_frame),
+            "frames_per_run": runs[0]["frames"],
+        }
+
+    inproc_frames = frame_stats(
+        inproc_runs, f"gol_gateway_{size}_inproc_frames"
+    )
+    wire_frames = frame_stats(wire_runs, f"gol_gateway_{size}_wire_frames")
+    record = {
+        "bench": "gateway",
+        "size": size,
+        "viewport": viewport,
+        "spectators": spectators,
+        "turns": turns,
+        "endpoint": gateway.url,
+        "control_rtt": {
+            "in_process": {
+                "metric": "gol_gateway_control_inproc",
+                "unit": "ops/s",
+                **measure.summarize(inproc_rates),
+            },
+            "wire": {
+                "metric": "gol_gateway_control_wire",
+                "unit": "ops/s",
+                **measure.summarize(wire_rates),
+            },
+            "wire_rtt_ms": 1e3 / measure.median(wire_rates),
+        },
+        "frames": {
+            "in_process": inproc_frames,
+            "wire": wire_frames,
+            "wire_overhead_ratio": (
+                wire_frames["bytes_per_frame"]
+                / inproc_frames["bytes_per_frame"]
+            ),
+            "fetches_per_frame": measure.median(fetch_ratio),
+        },
+        "metrics": reg.snapshot(include_lazy=False).to_dict(),
+    }
+    gateway.close()
+    plane.close()
+    log(
+        f"  gateway: control {record['control_rtt']['wire_rtt_ms']:.2f} "
+        f"ms/op on the wire; frames {wire_frames['bytes_per_frame']:,.0f} "
+        f"B/frame wire vs {inproc_frames['bytes_per_frame']:,.0f} "
+        f"in-process (x{record['frames']['wire_overhead_ratio']:.2f}); "
+        f"{spectators} spectators @ "
+        f"{record['frames']['fetches_per_frame']:.2f} fetches/frame"
+    )
+    return record
+
+
 def _bench_serve_impl(
     n_max: int,
     size: int,
@@ -1663,6 +1908,23 @@ def main():
         help="viewport side for --frames (a VxV rect centred on the board)",
     )
     ap.add_argument(
+        "--gateway",
+        action="store_true",
+        help="network-gateway mode (ISSUE 14): interleaved in-process "
+        "vs over-the-wire A/B on a live loopback pod — control RTT "
+        "(GET state vs plane.handle), frame-delta wire bytes/frame vs "
+        "the in-process FramePlane numbers, and the N-spectator "
+        "fan-out's fetches/frame == 1 pin.  Prints one lint-checked "
+        "JSON line and exits (BENCH_GATEWAY artifact).",
+    )
+    ap.add_argument(
+        "--gateway-spectators",
+        type=int,
+        default=8,
+        metavar="N",
+        help="wire spectator count for --gateway",
+    )
+    ap.add_argument(
         "--faults",
         metavar="PLAN",
         default=None,
@@ -1759,6 +2021,20 @@ def main():
             record = bench_serve_batched(args.serve, size=serve_size)
         else:
             record = bench_serve(args.serve, size=serve_size)
+        measure.require_headline_stats(record)
+        obs_metrics.require_embedded_metrics(record)
+        print(json.dumps(record))
+        return
+
+    if args.gateway:
+        # Small boards by design, like --serve: the gateway's cost is
+        # sockets and codecs, not cells; an explicit --size <= 1024 is
+        # honoured for experiments.
+        record = bench_gateway(
+            size if size <= 1024 else 512,
+            spectators=args.gateway_spectators,
+            reps=max(args.reps, 5),
+        )
         measure.require_headline_stats(record)
         obs_metrics.require_embedded_metrics(record)
         print(json.dumps(record))

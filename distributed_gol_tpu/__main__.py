@@ -343,6 +343,22 @@ def build_serve_parser() -> argparse.ArgumentParser:
                     "device launch per superstep advances every cohort "
                     "member — pair with an explicit --superstep so "
                     "tenants share a dispatch schedule")
+    # Network gateway (ISSUE 14; docs/API.md "Network gateway").
+    ap.add_argument("--gateway-port", type=int, default=None,
+                    metavar="PORT",
+                    help="expose the HTTP/WebSocket gateway on PORT "
+                    "(0 = ephemeral; the bound URL is printed to stderr "
+                    "and published as the gateway.endpoint info label): "
+                    "POST /v1/sessions submissions through the admission "
+                    "ladder, pause/resume/quit control, controller event "
+                    "streams and spectator frame streams over WebSocket, "
+                    "drain-over-the-wire (drive with tools/gol_client.py). "
+                    "The pod then serves until drained (SIGTERM, Ctrl-C, "
+                    "or POST /v1/drain) instead of exiting when scripted "
+                    "tenants finish")
+    ap.add_argument("--gateway-host", default="127.0.0.1",
+                    help="gateway bind address (0.0.0.0 for off-host "
+                    "controllers/spectators)")
     # Continuous telemetry + SLOs (ISSUE 12; docs/API.md "Telemetry
     # export").
     ap.add_argument("--telemetry-port", type=int, default=None,
@@ -390,6 +406,7 @@ def _parse_tenant_spec(spec: str) -> tuple[str, int, int, int]:
 
 def serve_main(argv) -> int:
     import json
+    import time
     import zlib
     from pathlib import Path
 
@@ -406,8 +423,11 @@ def serve_main(argv) -> int:
         specs = [_parse_tenant_spec(s) for s in args.tenant]
     except ValueError as e:
         ap.error(str(e))
-    if not specs and not args.readopt:
-        ap.error("nothing to serve: pass --tenant and/or --readopt")
+    if not specs and not args.readopt and args.gateway_port is None:
+        ap.error(
+            "nothing to serve: pass --tenant, --readopt, and/or "
+            "--gateway-port"
+        )
     if args.readopt and not args.checkpoint_root:
         ap.error("--readopt needs --checkpoint-root")
 
@@ -448,7 +468,14 @@ def serve_main(argv) -> int:
         )
 
     plane = ServePlane(config, checkpoint_root=args.checkpoint_root)
-    restore = plane.install()  # SIGTERM -> graceful drain
+    try:
+        restore = plane.install()  # SIGTERM -> gateway close + drain
+    except ValueError:
+        # Embedded use (serve_main on a non-main thread — tests, a
+        # supervising harness): no signal routing; drain arrives over
+        # the wire or programmatically instead.
+        def restore() -> None:
+            pass
     telemetry = None
     if args.telemetry_port is not None:
         from distributed_gol_tpu.serve.telemetry import serve_plane_telemetry
@@ -456,6 +483,21 @@ def serve_main(argv) -> int:
         telemetry = serve_plane_telemetry(plane, port=args.telemetry_port)
         print(
             f"telemetry: {telemetry.url}/metrics /healthz /slo",
+            file=sys.stderr,
+        )
+    gateway = None
+    if args.gateway_port is not None:
+        from distributed_gol_tpu.serve.gateway import serve_plane_gateway
+
+        gateway = serve_plane_gateway(
+            plane, port=args.gateway_port, host=args.gateway_host
+        )
+        # The BOUND endpoint — an ephemeral port 0 is resolved here,
+        # never a literal placeholder (the PR-10 endpoint contract).
+        print(
+            f"gateway: {gateway.url}/v1/sessions "
+            f"(ws: /v1/sessions/<tenant>/events|frames; "
+            f"drive with tools/gol_client.py {gateway.url})",
             file=sys.stderr,
         )
     try:
@@ -477,19 +519,37 @@ def serve_main(argv) -> int:
         handles = []
         for name, w, h, turns in specs:
             try:
-                handles.append(
-                    plane.submit(name, tenant_params(name, w, h, turns))
-                )
+                params = tenant_params(name, w, h, turns)
+                if gateway is not None:
+                    # Through the gateway's books, so scripted and
+                    # re-adopted tenants are wire-controllable too.
+                    handles.append(gateway.local_submit(name, params))
+                else:
+                    handles.append(plane.submit(name, params))
             except AdmissionRejected as e:
                 print(f"tenant {name} shed: {e}", file=sys.stderr)
         for handle in handles:
             handle.wait()
+        if gateway is not None:
+            # A gateway pod is a SERVER: scripted tenants finishing does
+            # not end it — serve until a drain lands (SIGTERM, Ctrl-C,
+            # or POST /v1/drain over the wire).
+            try:
+                while not plane.draining:
+                    time.sleep(0.25)
+            except KeyboardInterrupt:
+                pass
         summary = plane.drain()  # no-op when every session already ended
-        print(json.dumps({"health": plane.health(), "sessions": summary}))
+        receipt = {"health": plane.health(), "sessions": summary}
+        if gateway is not None:
+            receipt["gateway"] = {"endpoint": gateway.url}
+        print(json.dumps(receipt))
     finally:
         restore()
         if telemetry is not None:
             telemetry.close()
+        if gateway is not None:
+            gateway.close()
         plane.close()
     bad = [h for h in handles if h.status == "failed"]
     return 1 if bad else 0

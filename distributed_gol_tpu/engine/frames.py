@@ -59,3 +59,41 @@ def bands_nbytes(bands) -> int:
     """Payload bytes of a delta (the rows only — the per-band scalar is
     noise), for the bytes/frame telemetry."""
     return int(sum(rows.nbytes for _, rows in bands))
+
+
+def pack_bands(bands) -> tuple[list, bytes]:
+    """Serialize delta ``bands`` for the network wire (ISSUE 14): a
+    JSON-able ``[[y0, rows, cols], ...]`` geometry list plus the
+    concatenated raw row payload.  The binary half of the one wire
+    format — the gateway's spectator leg and ``tools/gol_client.py``
+    both ride this, so in-process and on-the-wire streams cannot
+    drift."""
+    meta, parts = [], []
+    for y0, rows in bands:
+        rows = np.ascontiguousarray(rows, dtype=np.uint8)
+        meta.append([int(y0), int(rows.shape[0]), int(rows.shape[1])])
+        parts.append(rows.tobytes())
+    return meta, b"".join(parts)
+
+
+def unpack_bands(meta, payload: bytes) -> tuple:
+    """Inverse of :func:`pack_bands`: ``(y0, rows)`` pairs ready for
+    :func:`apply_bands`.  Raises ``ValueError`` on a geometry/payload
+    size mismatch (a truncated wire frame must not apply silently)."""
+    bands, off = [], 0
+    for y0, nrows, ncols in meta:
+        n = int(nrows) * int(ncols)
+        chunk = payload[off : off + n]
+        if len(chunk) != n:
+            raise ValueError(
+                f"band payload truncated: wanted {n} bytes at offset "
+                f"{off}, got {len(chunk)}"
+            )
+        rows = np.frombuffer(chunk, np.uint8).reshape(int(nrows), int(ncols))
+        bands.append((int(y0), rows))
+        off += n
+    if off != len(payload):
+        raise ValueError(
+            f"band payload has {len(payload) - off} trailing bytes"
+        )
+    return tuple(bands)

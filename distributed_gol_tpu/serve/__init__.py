@@ -1,10 +1,13 @@
 """Multi-tenant serving plane (ISSUE 6): admission control, per-session
 fault isolation, graceful pod drain, health surface; plus the batched
 dispatch cohorts (ISSUE 8) that amortise one launch across N resident
-tenants, and the spectator frame fan-out hub (ISSUE 11) that serves N
-viewers' viewports off one device fetch per turn.  See
+tenants, the spectator frame fan-out hub (ISSUE 11) that serves N
+viewers' viewports off one device fetch per turn, and the network
+gateway (ISSUE 14) that puts the whole contract on the wire —
+HTTP control plane + WebSocket controller/spectator streaming.  See
 ``serve/plane.py`` for the architecture and docs/API.md "Serving" /
-"Batched serving" / "Spectator streaming" for the contracts."""
+"Batched serving" / "Spectator streaming" / "Network gateway" for the
+contracts."""
 
 from distributed_gol_tpu.serve.admission import (
     AdmissionController,
@@ -13,6 +16,10 @@ from distributed_gol_tpu.serve.admission import (
 )
 from distributed_gol_tpu.serve.batcher import CohortBatcher, cohort_key
 from distributed_gol_tpu.serve.frames import FramePlane, FrameSubscriber
+from distributed_gol_tpu.serve.gateway import (
+    GatewayServer,
+    serve_plane_gateway,
+)
 from distributed_gol_tpu.serve.plane import ServePlane, SessionHandle
 from distributed_gol_tpu.serve.telemetry import (
     TelemetryServer,
@@ -25,10 +32,12 @@ __all__ = [
     "CohortBatcher",
     "FramePlane",
     "FrameSubscriber",
+    "GatewayServer",
     "ServeConfig",
     "ServePlane",
     "SessionHandle",
     "TelemetryServer",
     "cohort_key",
+    "serve_plane_gateway",
     "serve_plane_telemetry",
 ]

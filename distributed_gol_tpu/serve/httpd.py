@@ -1,0 +1,158 @@
+"""Stdlib HTTP scaffolding — ONE home for the pod's wire servers
+(ISSUE 14 satellite).
+
+Both network faces of the serving plane — the telemetry scrape surface
+(``serve/telemetry.py``, ISSUE 12) and the gateway control plane
+(``serve/gateway.py``, ISSUE 14) — are zero-dependency
+``ThreadingHTTPServer`` daemons with the same obligations:
+
+- **Quiet logs**: a wire surface must never block or spam the pod's
+  stderr (``log_message`` is a no-op).
+- **Send policy**: every response carries ``Content-Type`` +
+  ``Content-Length``; a client that vanished mid-response
+  (``BrokenPipeError``/``ConnectionResetError``) is swallowed, a handler
+  bug is a 500 with the exception name in the body, never a wedged
+  socket or a traceback-spew.
+- **Ephemeral-port publish**: ``port=0`` binds an ephemeral port, and
+  each server publishes its bound URL as an ``*.endpoint`` info label
+  (``telemetry.endpoint`` / ``gateway.endpoint``) right after
+  construction — a pod's own wire addresses belong in its telemetry,
+  and with port 0 they are otherwise only knowable from inside (the
+  PR-10 ``telemetry.endpoint`` contract, now shared).  Subclasses
+  register the label with a literal name so the metric-docs lint
+  (``tools/check_metric_docs.py``) sees it.
+- **Bounded-time contract** (by construction, not enforcement):
+  handlers compute from in-memory state — books, samples, handles —
+  and never touch a device, take a session lock, or wait on a
+  dispatch, so a wedged tenant can never hang a request.
+
+Subclasses implement :meth:`handle`; everything above stays here
+instead of growing a second hand-rolled copy per server.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+from distributed_gol_tpu.obs import metrics as metrics_lib
+
+
+class StdlibHTTPServer:
+    """The scaffolding base: bind, serve from daemon threads, publish
+    the endpoint, tear down.  ``request_counter`` (optional) is bumped
+    once per request before routing — the ``telemetry.scrapes`` /
+    ``gateway.requests`` families ride it."""
+
+    #: Thread name of the accept loop; subclasses override.
+    thread_name = "gol-http"
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        registry=None,
+        request_counter=None,
+    ):
+        self.registry = (
+            registry if registry is not None else metrics_lib.REGISTRY
+        )
+        self._request_counter = request_counter
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # A wire surface must never block on the pod's logs.
+            def log_message(self, fmt, *args):  # noqa: ARG002
+                pass
+
+            def _send(
+                self, code: int, body: bytes, ctype: str, headers=()
+            ) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for name, value in headers:
+                    self.send_header(name, value)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code: int, obj, headers=()) -> None:
+                self._send(
+                    code, json.dumps(obj).encode(), "application/json",
+                    headers,
+                )
+
+            def do_GET(self):  # noqa: N802 — http.server contract
+                outer._route(self, "GET")
+
+            def do_POST(self):  # noqa: N802
+                outer._route(self, "POST")
+
+            def do_DELETE(self):  # noqa: N802
+                outer._route(self, "DELETE")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = self._httpd.server_address[0]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=self.thread_name,
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- routing ---------------------------------------------------------------
+    def _route(self, request, method: str) -> None:
+        if self._request_counter is not None:
+            self._request_counter.inc()
+        split = urlsplit(request.path)
+        path = split.path.rstrip("/") or "/"
+        query = {
+            k: v[-1] for k, v in parse_qs(split.query).items()
+        }
+        try:
+            if not self.handle(request, method, path, query):
+                request._send(404, b"not found\n", "text/plain")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-response
+        except Exception as e:  # noqa: BLE001 — a handler bug is a 500
+            body = f"{type(e).__name__}: {e}\n".encode()
+            try:
+                request._send(500, body, "text/plain")
+            except OSError:
+                pass
+
+    def handle(self, request, method: str, path: str, query: dict) -> bool:
+        """Route one request.  ``request`` is the live handler (use its
+        ``_send`` / ``_send_json``; ``rfile``/``wfile``/``connection``
+        for protocol upgrades).  Return False for "no such route" — the
+        scaffolding sends the 404."""
+        raise NotImplementedError
+
+    # -- lifecycle -------------------------------------------------------------
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_body(request, cap: int = 1 << 26) -> bytes:
+    """The request body per its Content-Length (empty when absent),
+    refused past ``cap`` — a wire surface reads bounded input only."""
+    length = int(request.headers.get("Content-Length") or 0)
+    if length < 0 or length > cap:
+        raise ValueError(f"request body of {length} bytes exceeds the cap")
+    return request.rfile.read(length) if length else b""

@@ -123,6 +123,12 @@ class SessionHandle:
         self.params = params
         self.session = session
         self.events = events
+        #: Optional control/streaming seams (ISSUE 14): a keyboard-
+        #: equivalent key queue routed into the controller (the wire
+        #: gateway's pause/resume/quit leg) and a FramePlane the run
+        #: publishes every rendered turn to (the spectator leg).
+        self.keys: queue.Queue | None = None
+        self.frame_plane = None
         self.stop = GracefulStop()
         self.status = "queued"
         #: The admission verdict at submit time ("run" = a slot was
@@ -291,6 +297,13 @@ class ServePlane:
 
             self.batcher = CohortBatcher(self.config, metrics=metrics)
         self._handles: dict[str, SessionHandle] = {}  # latest per tenant
+        # Pre-drain hooks (ISSUE 14): callables invoked at the top of
+        # begin_drain, BEFORE admissions close and the queue sheds —
+        # how the network gateway stops accepting wire submissions
+        # before the pod starts refusing them (install() SIGTERM closes
+        # the gateway first).  Hooks must be fast, non-blocking, and
+        # idempotent (see add_drain_hook).
+        self._drain_hooks: list[Callable[[], None]] = []
         # Terminal handles in completion order — the eviction ring that
         # keeps a churning-tenant pod's memory bounded (``_on_done``).
         self._retired: deque[tuple[str, SessionHandle]] = deque()
@@ -357,6 +370,8 @@ class ServePlane:
         deadline_seconds: float | None = None,
         backend=None,
         backend_factory: Optional[Callable] = None,
+        keys: queue.Queue | None = None,
+        frame_plane=None,
     ) -> SessionHandle:
         """Admit one session or shed it (:class:`AdmissionRejected`).
 
@@ -366,7 +381,14 @@ class ServePlane:
         session's ``Params.dispatch_deadline_seconds`` watchdog, so a
         wedged dispatch surfaces as that tenant's own ``DispatchTimeout``
         instead of silently pinning a pod worker.  ``backend`` /
-        ``backend_factory`` are the chaos seams (``testing/faults``)."""
+        ``backend_factory`` are the chaos seams (``testing/faults``).
+
+        ``keys`` (ISSUE 14) is a keyboard-equivalent control queue
+        routed into the session's controller — 'p'/'q'/'k' semantics
+        exactly as the CLI viewer's listener; ``frame_plane`` attaches
+        a spectator fan-out hub the run publishes every rendered turn
+        to (frame-mode sessions only — see ``serve/frames.py``).  Both
+        are how the network gateway drives a resident session."""
         overrides: dict = {"tenant": tenant}
         if deadline_seconds is not None:
             # An explicit per-request deadline always wins.
@@ -421,6 +443,8 @@ class ServePlane:
                 handle.events = _DigestSink(handle)
             handle._backend = backend
             handle._backend_factory = backend_factory
+            handle.keys = keys
+            handle.frame_plane = frame_plane
             if (
                 self.batcher is not None
                 and backend is None
@@ -471,10 +495,12 @@ class ServePlane:
             gol.run(
                 handle.params,
                 handle.events,
+                key_presses=handle.keys,
                 session=handle.session,
                 backend=handle._backend,
                 backend_factory=handle._backend_factory,
                 stop=handle.stop,
+                frame_plane=handle.frame_plane,
             )
         except BaseException as e:  # noqa: BLE001 — isolation boundary
             exc = e
@@ -581,6 +607,14 @@ class ServePlane:
         the main thread holds that lock (mid-``submit``) and deadlock
         the drain.  :meth:`install` therefore routes signals through a
         trampoline that runs it on a fresh thread."""
+        # Close the wire face FIRST (outside the lock — a hook may be
+        # answering a request that wants plane state): new gateway
+        # submissions 503 before the plane sheds anything.
+        for hook in list(self._drain_hooks):
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — a hook bug must not block drain
+                pass
         with self._state:
             if self._admission.draining:
                 return
@@ -648,6 +682,12 @@ class ServePlane:
             ).start()
 
         return route_signals(handler, signals)
+
+    def add_drain_hook(self, hook: Callable[[], None]) -> None:
+        """Register a pre-drain hook (see ``_drain_hooks``).  Hooks must
+        be fast and idempotent: a repeated drain signal re-invokes them
+        even though the drain itself is once-only."""
+        self._drain_hooks.append(hook)
 
     def wait_idle(self, timeout: float | None = None) -> bool:
         """Block until no session is resident or queued."""
@@ -735,6 +775,8 @@ class ServePlane:
             )
             telemetry = {"sampling": False}
         counters = snap.get("counters", {})
+        snap_gauges = snap.get("gauges", {})
+        snap_info = snap.get("info", {})
         tenants = {
             t: {
                 "status": status,
@@ -791,6 +833,32 @@ class ServePlane:
             "telemetry": telemetry,
             "slo": self.slo.summary() if self.slo is not None else None,
             "slo_alerts": counters.get("serve.slo_alerts", 0),
+            # Spectator fan-out economics (ISSUE 14 satellite): the
+            # FramePlane counters, straight off the pod registry, so
+            # tools/pod_top.py renders a sessions/spectators panel
+            # without a second scrape.  ``subscribers`` is the lazy
+            # gauge — None until a lazy sampler tick has run.
+            "frames": {
+                "publishes": counters.get("frames.publishes", 0),
+                "fetches": counters.get("frames.fetches", 0),
+                "frames_served": counters.get("frames.frames_served", 0),
+                "bytes_shipped": counters.get("frames.bytes_shipped", 0),
+                "subscribers": snap_gauges.get("frames.subscribers"),
+            },
+            # The wire face (ISSUE 14): who is attached and what the
+            # gateway shipped — all-zero (endpoint None) on a pod
+            # serving no gateway.
+            "gateway": {
+                "endpoint": snap_info.get("gateway.endpoint"),
+                "sessions_submitted": counters.get(
+                    "gateway.sessions_submitted", 0
+                ),
+                "rejected": counters.get("gateway.rejected", 0),
+                "controllers": snap_gauges.get("gateway.controllers", 0),
+                "spectators": snap_gauges.get("gateway.spectators", 0),
+                "frames_streamed": counters.get("gateway.frames_streamed", 0),
+                "bytes_streamed": counters.get("gateway.bytes_streamed", 0),
+            },
             "tenants": tenants,
         }
 
@@ -830,6 +898,13 @@ class ServePlane:
     def handle(self, tenant: str) -> SessionHandle | None:
         with self._lock:
             return self._handles.get(tenant)
+
+    def handles(self) -> dict[str, SessionHandle]:
+        """A point-in-time copy of the tenant book (latest handle per
+        tenant, resident and retained-terminal) — the gateway's session
+        listing reads this."""
+        with self._lock:
+            return dict(self._handles)
 
     @property
     def draining(self) -> bool:

@@ -20,8 +20,10 @@ registry lock).  No handler ever touches a device, takes a session
 lock, or waits on a dispatch, so a wedged device or hung tenant can
 never hang a scrape — the worst case is a stale sample, and the
 staleness itself is published (``telemetry.sample_age_seconds`` on
-``/healthz``).  Served from daemon threads
-(``ThreadingHTTPServer``), one per in-flight scrape.
+``/healthz``).  The server scaffolding — daemon threads, quiet logs,
+the send/error policy, the ephemeral-port ``telemetry.endpoint``
+publish — is the shared :class:`serve.httpd.StdlibHTTPServer` (ISSUE 14
+satellite: one home, not a second hand-rolled copy).
 
 Entry points: ``TelemetryServer(...)`` directly,
 :func:`serve_plane_telemetry` for a ``ServePlane`` (the serve CLI's
@@ -31,19 +33,19 @@ Entry points: ``TelemetryServer(...)`` directly,
 
 from __future__ import annotations
 
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
 from distributed_gol_tpu.obs import metrics as metrics_lib
 from distributed_gol_tpu.obs import openmetrics
+from distributed_gol_tpu.serve.httpd import StdlibHTTPServer
 
 
-class TelemetryServer:
+class TelemetryServer(StdlibHTTPServer):
     """One pod's scrape surface.  ``port=0`` binds an ephemeral port
     (read it back from :attr:`port` — the test spelling); ``host``
     defaults to loopback, production pods pass ``"0.0.0.0"``."""
+
+    thread_name = "gol-telemetry-http"
 
     def __init__(
         self,
@@ -54,86 +56,38 @@ class TelemetryServer:
         host: str = "127.0.0.1",
         registry=None,
     ):
+        self._metrics_fn = metrics_fn
+        self._health_fn = health_fn
+        self._slo_fn = slo_fn
         registry = registry if registry is not None else metrics_lib.REGISTRY
-        m_scrapes = registry.counter("telemetry.scrapes")
-
-        class Handler(BaseHTTPRequestHandler):
-            # A scrape surface must never block the pod's logs.
-            def log_message(self, fmt, *args):  # noqa: ARG002
-                pass
-
-            def _send(self, code: int, body: bytes, ctype: str) -> None:
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_GET(self):  # noqa: N802 — http.server contract
-                m_scrapes.inc()
-                path = self.path.split("?", 1)[0].rstrip("/") or "/"
-                try:
-                    if path == "/metrics":
-                        text = openmetrics.render(metrics_fn())
-                        self._send(
-                            200,
-                            text.encode(),
-                            openmetrics.CONTENT_TYPE,
-                        )
-                    elif path == "/healthz":
-                        health = health_fn()
-                        code = 200 if health.get("ready", False) else 503
-                        self._send(
-                            code,
-                            json.dumps(health).encode(),
-                            "application/json",
-                        )
-                    elif path == "/slo" and slo_fn is not None:
-                        self._send(
-                            200,
-                            json.dumps(slo_fn()).encode(),
-                            "application/json",
-                        )
-                    else:
-                        self._send(404, b"not found\n", "text/plain")
-                except BrokenPipeError:
-                    pass  # scraper went away mid-response
-                except Exception as e:  # noqa: BLE001 — a scrape bug is a 500
-                    body = f"{type(e).__name__}: {e}\n".encode()
-                    try:
-                        self._send(500, body, "text/plain")
-                    except OSError:
-                        pass
-
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._httpd.daemon_threads = True
-        self.host = self._httpd.server_address[0]
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever,
-            name="gol-telemetry-http",
-            daemon=True,
+        # The scrape counter exists BEFORE the server binds (the base
+        # bumps it per request), so even a scrape racing construction
+        # is counted.
+        super().__init__(
+            port=port,
+            host=host,
+            registry=registry,
+            request_counter=registry.counter("telemetry.scrapes"),
         )
-        self._thread.start()
-        # Publish the bound address as an info label: with port=0 the
-        # ephemeral port is otherwise only knowable from inside, and a
-        # pod's own scrape address belongs in its telemetry anyway.
-        registry.info("telemetry.endpoint", self.url)
+        # Publish the bound address: with port=0 the ephemeral port is
+        # otherwise only knowable from inside the process.
+        self.registry.info("telemetry.endpoint", self.url)
 
-    @property
-    def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
-
-    def close(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        self._thread.join(timeout=5)
-
-    def __enter__(self) -> "TelemetryServer":
-        return self
-
-    def __exit__(self, *exc) -> None:
-        self.close()
+    def handle(self, request, method: str, path: str, query: dict) -> bool:
+        if method != "GET":
+            return False
+        if path == "/metrics":
+            text = openmetrics.render(self._metrics_fn())
+            request._send(200, text.encode(), openmetrics.CONTENT_TYPE)
+        elif path == "/healthz":
+            health = self._health_fn()
+            code = 200 if health.get("ready", False) else 503
+            request._send_json(code, health)
+        elif path == "/slo" and self._slo_fn is not None:
+            request._send_json(200, self._slo_fn())
+        else:
+            return False
+        return True
 
 
 def serve_plane_telemetry(plane, port: int = 0, host: str = "127.0.0.1"):

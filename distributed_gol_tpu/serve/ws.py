@@ -1,0 +1,298 @@
+"""Minimal RFC 6455 WebSocket — the streaming leg of the gateway
+(ISSUE 14), in the same zero-dependency stdlib style as the
+``ThreadingHTTPServer`` scrape surface (``serve/httpd.py``).
+
+Scope: exactly what a pod's controller/spectator legs need —
+server-side upgrade inside a ``BaseHTTPRequestHandler``, client-side
+connect over a raw socket, text/binary messages, fragmented-message
+assembly, auto-ponged pings, masked client frames (the RFC mandate),
+bounded frame sizes, and a clean close handshake.  No extensions, no
+subprotocol negotiation, no compression — a spectator stream's payload
+is already delta-encoded (``engine/frames.py``).
+
+Both ends of ``tools/gol_client.py`` ⇄ ``serve/gateway.py`` speak this
+one codec, so the wire format cannot drift between them.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import socket
+import struct
+import threading
+
+#: RFC 6455 §1.3 handshake GUID.
+GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_CONT = 0x0
+OP_TEXT = 0x1
+OP_BINARY = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+#: Frames over this are refused (a spectator keyframe of a 65536²
+#: pooled viewport is far below it; anything bigger is a protocol bug).
+MAX_PAYLOAD = 1 << 26
+
+
+class WsClosed(ConnectionError):
+    """The peer closed (or the socket died) — the detach signal."""
+
+
+def accept_key(key: str) -> str:
+    """RFC 6455 §4.2.2: the Sec-WebSocket-Accept for a client key."""
+    digest = hashlib.sha1((key + GUID).encode()).digest()
+    return base64.b64encode(digest).decode()
+
+
+def _mask(data: bytes, key: bytes) -> bytes:
+    """XOR-mask ``data`` with the 4-byte ``key`` (involutive)."""
+    n = len(data)
+    if not n:
+        return data
+    rep = (key * (n // 4 + 1))[:n]
+    return (
+        int.from_bytes(data, "little") ^ int.from_bytes(rep, "little")
+    ).to_bytes(n, "little")
+
+
+class WebSocket:
+    """One connected endpoint over buffered binary file objects
+    (``rfile``/``wfile`` of an HTTP handler, or ``socket.makefile``
+    pairs on the client).  ``send_*`` are thread-safe (one lock — the
+    gateway's reader thread pongs while the pump thread streams);
+    ``recv`` is single-consumer."""
+
+    def __init__(self, rfile, wfile, *, mask: bool, sock=None):
+        self._r = rfile
+        self._w = wfile
+        self._mask_frames = mask
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._close_sent = False
+        self.closed = False
+
+    # -- send ------------------------------------------------------------------
+    def send_text(self, text: str) -> int:
+        return self._send(OP_TEXT, text.encode())
+
+    def send_binary(self, payload: bytes) -> int:
+        return self._send(OP_BINARY, bytes(payload))
+
+    def ping(self, payload: bytes = b"") -> None:
+        self._send(OP_PING, payload)
+
+    def _send(self, opcode: int, payload: bytes) -> int:
+        n = len(payload)
+        if n > MAX_PAYLOAD:
+            raise ValueError(f"payload of {n} bytes exceeds MAX_PAYLOAD")
+        head = bytearray([0x80 | opcode])
+        mask_bit = 0x80 if self._mask_frames else 0
+        if n < 126:
+            head.append(mask_bit | n)
+        elif n < 1 << 16:
+            head.append(mask_bit | 126)
+            head += struct.pack(">H", n)
+        else:
+            head.append(mask_bit | 127)
+            head += struct.pack(">Q", n)
+        if self._mask_frames:
+            key = os.urandom(4)
+            head += key
+            payload = _mask(payload, key)
+        with self._send_lock:
+            if self.closed:
+                raise WsClosed("websocket is closed")
+            try:
+                self._w.write(bytes(head) + payload)
+                self._w.flush()
+            except (OSError, ValueError) as e:
+                self.closed = True
+                raise WsClosed(f"send failed: {e}") from e
+        return n
+
+    # -- receive ---------------------------------------------------------------
+    def recv(self) -> tuple[int, bytes]:
+        """The next complete MESSAGE as ``(opcode, payload)`` —
+        fragments assembled, pings auto-ponged, pongs swallowed.  A
+        close frame (or socket EOF) raises :class:`WsClosed` after
+        echoing the close handshake."""
+        opcode, buf = None, b""
+        while True:
+            op, fin, payload = self._read_frame()
+            if op == OP_PING:
+                try:
+                    self._send(OP_PONG, payload)
+                except WsClosed:
+                    pass
+                continue
+            if op == OP_PONG:
+                continue
+            if op == OP_CLOSE:
+                self.close()
+                raise WsClosed("peer closed")
+            if op in (OP_TEXT, OP_BINARY):
+                opcode, buf = op, payload
+            elif op == OP_CONT and opcode is not None:
+                buf += payload
+            else:
+                raise WsClosed(f"protocol error: unexpected opcode {op:#x}")
+            if fin:
+                return opcode, buf
+
+    def _read_frame(self) -> tuple[int, bool, bytes]:
+        head = self._read_exact(2)
+        fin = bool(head[0] & 0x80)
+        op = head[0] & 0x0F
+        masked = bool(head[1] & 0x80)
+        n = head[1] & 0x7F
+        if n == 126:
+            n = struct.unpack(">H", self._read_exact(2))[0]
+        elif n == 127:
+            n = struct.unpack(">Q", self._read_exact(8))[0]
+        if n > MAX_PAYLOAD:
+            raise WsClosed(f"frame of {n} bytes exceeds MAX_PAYLOAD")
+        key = self._read_exact(4) if masked else None
+        payload = self._read_exact(n)
+        if key is not None:
+            payload = _mask(payload, key)
+        return op, fin, payload
+
+    def _read_exact(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            try:
+                chunk = self._r.read(n - len(out))
+            except (OSError, ValueError) as e:
+                self.closed = True
+                raise WsClosed(f"read failed: {e}") from e
+            if not chunk:
+                self.closed = True
+                raise WsClosed("socket EOF")
+            out += chunk
+        return out
+
+    # -- lifecycle -------------------------------------------------------------
+    def settimeout(self, seconds: float | None) -> None:
+        if self._sock is not None:
+            self._sock.settimeout(seconds)
+
+    def close(self, code: int = 1000) -> None:
+        """Send the close frame (once) and mark the endpoint closed.
+        Idempotent; safe from any thread."""
+        with self._send_lock:
+            if self._close_sent:
+                self.closed = True
+                return
+            self._close_sent = True
+            try:
+                payload = struct.pack(">H", code)
+                head = bytearray([0x80 | OP_CLOSE])
+                if self._mask_frames:
+                    key = os.urandom(4)
+                    head += bytes([0x80 | len(payload)]) + key
+                    payload = _mask(payload, key)
+                else:
+                    head.append(len(payload))
+                self._w.write(bytes(head) + payload)
+                self._w.flush()
+            except (OSError, ValueError):
+                pass
+            self.closed = True
+
+
+# -- server side ---------------------------------------------------------------
+
+def server_upgrade(request) -> WebSocket | None:
+    """Upgrade a live ``BaseHTTPRequestHandler`` request to a WebSocket
+    (RFC 6455 §4.2).  Returns the server-side endpoint, or None after
+    answering 400 when the request is not a well-formed upgrade.  The
+    caller owns the connection from here on and must not send a normal
+    HTTP response."""
+    upgrade = (request.headers.get("Upgrade") or "").lower()
+    key = request.headers.get("Sec-WebSocket-Key")
+    if upgrade != "websocket" or not key:
+        request._send(400, b"websocket upgrade required\n", "text/plain")
+        return None
+    response = (
+        "HTTP/1.1 101 Switching Protocols\r\n"
+        "Upgrade: websocket\r\n"
+        "Connection: Upgrade\r\n"
+        f"Sec-WebSocket-Accept: {accept_key(key)}\r\n"
+        "\r\n"
+    )
+    request.wfile.write(response.encode())
+    request.wfile.flush()
+    request.close_connection = True  # the socket is ours until EOF
+    return WebSocket(
+        request.rfile, request.wfile, mask=False, sock=request.connection
+    )
+
+
+# -- client side ---------------------------------------------------------------
+
+def client_connect(
+    host: str,
+    port: int,
+    path: str,
+    timeout: float = 30.0,
+    recv_buffer: int | None = None,
+) -> WebSocket:
+    """Dial ``ws://host:port{path}``: TCP connect, upgrade handshake,
+    verified accept key.  Client frames are masked per the RFC.
+    ``recv_buffer`` pins SO_RCVBUF before connecting (how the chaos
+    tests simulate a slow consumer deterministically)."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    if recv_buffer is not None:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, recv_buffer)
+    sock.settimeout(timeout)
+    try:
+        sock.connect((host, port))
+    except BaseException:
+        sock.close()
+        raise
+    key = base64.b64encode(os.urandom(16)).decode()
+    req = (
+        f"GET {path} HTTP/1.1\r\n"
+        f"Host: {host}:{port}\r\n"
+        "Upgrade: websocket\r\n"
+        "Connection: Upgrade\r\n"
+        f"Sec-WebSocket-Key: {key}\r\n"
+        "Sec-WebSocket-Version: 13\r\n"
+        "\r\n"
+    )
+    rfile = sock.makefile("rb")
+    wfile = sock.makefile("wb")
+    try:
+        wfile.write(req.encode())
+        wfile.flush()
+        status = rfile.readline(4096).decode("latin-1")
+        if " 101 " not in status:
+            raise WsClosed(f"upgrade refused: {status.strip()!r}")
+        accept = None
+        while True:
+            line = rfile.readline(4096).decode("latin-1")
+            if line in ("\r\n", "\n", ""):
+                break
+            name, _, value = line.partition(":")
+            if name.strip().lower() == "sec-websocket-accept":
+                accept = value.strip()
+        if accept != accept_key(key):
+            raise WsClosed("handshake accept-key mismatch")
+    except BaseException:
+        sock.close()
+        raise
+    return WebSocket(rfile, wfile, mask=True, sock=sock)
+
+
+__all__ = [
+    "MAX_PAYLOAD",
+    "WebSocket",
+    "WsClosed",
+    "accept_key",
+    "client_connect",
+    "server_upgrade",
+]

@@ -1,0 +1,864 @@
+"""The network gateway suite (ISSUE 14).
+
+Contracts, asserted hermetically on CPU over REAL loopback sockets:
+
+- **Codecs**: the RFC 6455 frame codec (mask involution, the RFC
+  handshake vector, fragmentation), the binary frame-event codec
+  (keyframe/delta round-trip, truncation refused), wire-message
+  mapping, and session-spec parsing (board upload vs soup, whitelists,
+  SpecError on garbage).
+- **Broker contract on the wire**: submit + pause/resume + quit for
+  two tenants driven via ``tools/gol_client.py`` against a live pod,
+  each completed session's final board bit-identical to its
+  in-process ``ServePlane.submit`` oracle; shed submissions answer
+  429 + Retry-After.
+- **Detach/resume**: client disconnect is the reference's controller
+  detach — the run keeps going; a reconnected controller (``?since=``)
+  observes the same event-stream tail (seq-contiguous, turn ranges
+  tiling the run with no gaps).
+- **Spectators**: N wire spectators on one session cost 1.00 device
+  fetches/frame (the FramePlane superset-fetch preserved over the
+  wire), each reconstructing bit-identically to the final-board crop
+  oracle; a stalled spectator never wedges the producer and re-anchors
+  via drop-oldest + re-keyframe observed on the wire.
+- **Chaos**: every gateway response stays bounded-time while a
+  hang-faulted tenant is resident (the PR-10 2 s scrape bound); drain
+  over the wire returns the parked-resumable receipt and a fresh pod
+  re-adopts from it.
+"""
+
+import contextlib
+import io
+import json
+import queue
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from distributed_gol_tpu.engine import frames as frames_lib
+from distributed_gol_tpu.engine.backend import Backend
+from distributed_gol_tpu.engine.events import (
+    CellFlipped,
+    FrameDelta,
+    FrameReady,
+    TurnComplete,
+    TurnsCompleted,
+)
+from distributed_gol_tpu.engine.params import Params
+from distributed_gol_tpu.obs import metrics as obs_metrics
+from distributed_gol_tpu.serve import (
+    GatewayServer,
+    ServeConfig,
+    ServePlane,
+)
+from distributed_gol_tpu.serve import wire
+from distributed_gol_tpu.serve import ws as ws_lib
+from distributed_gol_tpu.testing.faults import (
+    Fault,
+    FaultInjectionBackend,
+    FaultPlan,
+)
+from tools.gol_client import GatewayError, GolClient
+
+W = H = 16
+SUPERSTEP = 4
+TURNS = 24
+
+
+def base_spec(**kw):
+    """A small fast wire session spec (soup-seeded, cycle probe off so
+    control tests race nothing)."""
+    spec = {
+        "params": {
+            "width": W,
+            "height": H,
+            "turns": TURNS,
+            "engine": "roll",
+            "superstep": SUPERSTEP,
+            "cycle_check": 0,
+            "ticker_period": 60.0,
+        },
+        "soup": {"density": 0.25, "seed": 7},
+    }
+    params = kw.pop("params", {})
+    spec["params"].update(params)
+    spec.update(kw)
+    return spec
+
+
+@pytest.fixture
+def pod(tmp_path):
+    plane = ServePlane(
+        ServeConfig(max_sessions=4, telemetry_sample_seconds=0.1),
+        checkpoint_root=tmp_path / "ckpt",
+    )
+    gateway = GatewayServer(plane, port=0)
+    client = GolClient(gateway.url)
+    yield plane, gateway, client
+    gateway.close()
+    plane.close()
+
+
+def submit_spec(client: GolClient, tenant: str, spec: dict) -> dict:
+    """POST a raw spec dict through the client's request machinery."""
+    return client._request(
+        "POST", "/v1/sessions", {"tenant": tenant, **spec}
+    )
+
+
+def wait_status(client, tenant, statuses, timeout=60.0) -> dict:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = client.state(tenant)
+        if st["status"] in statuses:
+            return st
+        time.sleep(0.05)
+    raise AssertionError(
+        f"{tenant} never reached {statuses}: {client.state(tenant)}"
+    )
+
+
+def oracle_final(tmp_path, tenant: str, spec: dict):
+    """The in-process ServePlane.submit oracle for one wire spec: the
+    same Params through the same plane machinery, no sockets."""
+    params, _ = wire.params_from_spec(
+        tenant, json.loads(json.dumps(spec)), root=tmp_path / "oracle-up"
+    )
+    with ServePlane(
+        ServeConfig(max_sessions=1),
+        checkpoint_root=tmp_path / "oracle-ckpt",
+    ) as plane:
+        handle = plane.submit(tenant, params)
+        assert handle.wait(timeout=120)
+        assert handle.status == "completed"
+        return handle.final
+
+
+# -- codec units ---------------------------------------------------------------
+
+
+class TestWsCodec:
+    def test_accept_key_rfc_vector(self):
+        # RFC 6455 §1.3's worked example.
+        assert (
+            ws_lib.accept_key("dGhlIHNhbXBsZSBub25jZQ==")
+            == "s3pPLMBiTxaQ9kYGzzhZRbK+xOo="
+        )
+
+    def test_mask_is_involutive(self):
+        data = bytes(range(251))
+        key = b"\x12\x34\x56\x78"
+        masked = ws_lib._mask(data, key)
+        assert masked != data
+        assert ws_lib._mask(masked, key) == data
+        assert ws_lib._mask(b"", key) == b""
+
+    def test_frame_roundtrip_over_a_socket_pair(self):
+        import socket
+
+        a, b = socket.socketpair()
+        try:
+            end_a = ws_lib.WebSocket(
+                a.makefile("rb"), a.makefile("wb"), mask=True, sock=a
+            )
+            end_b = ws_lib.WebSocket(
+                b.makefile("rb"), b.makefile("wb"), mask=False, sock=b
+            )
+            end_a.send_text("hello")
+            opcode, payload = end_b.recv()
+            assert (opcode, payload) == (ws_lib.OP_TEXT, b"hello")
+            blob = bytes(range(256)) * 300  # > 64 KiB: 8-byte length path
+            end_b.send_binary(blob)
+            opcode, payload = end_a.recv()
+            assert opcode == ws_lib.OP_BINARY and payload == blob
+            # Ping is answered transparently under a recv.
+            end_a.ping(b"x")
+            end_a.send_text("after")
+            assert end_b.recv() == (ws_lib.OP_TEXT, b"after")
+            end_a.close()
+            with pytest.raises(ws_lib.WsClosed):
+                end_b.recv()
+        finally:
+            a.close()
+            b.close()
+
+
+class TestFrameWireCodec:
+    def test_keyframe_roundtrip(self):
+        frame = np.arange(12 * 7, dtype=np.uint8).reshape(12, 7)
+        blob = wire.encode_frame_event(FrameReady(5, frame, rect=(1, 2, 12, 7)))
+        out = wire.decode_frame_event(blob)
+        assert isinstance(out, FrameReady)
+        assert out.completed_turns == 5 and out.rect == (1, 2, 12, 7)
+        assert np.array_equal(np.asarray(out.frame), frame)
+
+    def test_delta_roundtrip_applies_bit_identically(self):
+        prev = np.zeros((32, 16), np.uint8)
+        new = prev.copy()
+        new[3, 4] = 255
+        new[25, :] = 7
+        bands = frames_lib.delta_bands(prev, new)
+        blob = wire.encode_frame_event(
+            FrameDelta(9, bands=bands, rect=(0, 0, 32, 16))
+        )
+        out = wire.decode_frame_event(blob)
+        assert isinstance(out, FrameDelta)
+        buf = prev.copy()
+        frames_lib.apply_bands(buf, out.bands)
+        assert np.array_equal(buf, new)
+
+    def test_truncated_payload_refused(self):
+        frame = np.ones((8, 8), np.uint8)
+        blob = wire.encode_frame_event(FrameReady(1, frame))
+        with pytest.raises(ValueError):
+            wire.decode_frame_event(blob[:-3])
+        with pytest.raises(ValueError):
+            wire.decode_frame_event(b"\x00\x01")
+
+    def test_pack_bands_mismatch_refused(self):
+        meta, payload = frames_lib.pack_bands(
+            ((0, np.ones((2, 4), np.uint8)),)
+        )
+        with pytest.raises(ValueError, match="truncated"):
+            frames_lib.unpack_bands(meta, payload[:-1])
+        with pytest.raises(ValueError, match="trailing"):
+            frames_lib.unpack_bands(meta, payload + b"x")
+
+
+class TestWireMessages:
+    def test_event_mapping(self):
+        assert wire.event_to_wire(TurnComplete(3)) == {
+            "type": "turns", "first": 3, "turn": 3,
+        }
+        assert wire.event_to_wire(
+            TurnsCompleted(completed_turns=8, first_turn=5)
+        ) == {"type": "turns", "first": 5, "turn": 8}
+        # Chatty per-cell forms are elided from the controller leg.
+        from distributed_gol_tpu.utils.cell import Cell
+
+        assert wire.event_to_wire(CellFlipped(1, Cell(0, 0))) is None
+        assert wire.event_to_wire(FrameReady(1, np.zeros((2, 2)))) is None
+
+    def test_parse_control(self):
+        assert wire.parse_control('{"type": "pause"}') == {"type": "pause"}
+        assert wire.parse_control(
+            '{"type": "set_viewport", "rect": [1, 2, 3, 4]}'
+        ) == {"type": "set_viewport", "rect": (1, 2, 3, 4)}
+        assert wire.parse_control('{"type": "key", "key": "s"}') == {
+            "type": "key", "key": "s",
+        }
+        for bad in (
+            "not json",
+            "[1]",
+            '{"type": "reboot"}',
+            '{"type": "key", "key": "Z"}',
+            '{"type": "set_viewport", "rect": [1, 2]}',
+        ):
+            with pytest.raises(wire.SpecError):
+                wire.parse_control(bad)
+
+
+class TestSessionSpecs:
+    def test_soup_spec(self, tmp_path):
+        params, options = wire.params_from_spec(
+            "alice", base_spec(), root=tmp_path
+        )
+        assert params.image_width == W and params.turns == TURNS
+        assert params.soup_density == 0.25 and params.soup_seed == 7
+        assert params.turn_events == "batch"
+        assert not options["spectate"]
+
+    def test_board_upload_roundtrip(self, tmp_path):
+        import base64
+
+        from distributed_gol_tpu.engine import pgm
+
+        board = (np.random.default_rng(3).random((24, 16)) < 0.3).astype(
+            np.uint8
+        ) * 255
+        spec = {
+            "params": {"turns": 10},
+            "board_b64": base64.b64encode(pgm.encode_pgm(board)).decode(),
+        }
+        params, _ = wire.params_from_spec("bob", spec, root=tmp_path)
+        assert (params.image_width, params.image_height) == (16, 24)
+        stored = pgm.read_pgm(Path(params.images_dir) / "16x24.pgm")
+        assert np.array_equal(stored, board)
+
+    def test_spectate_defaults(self, tmp_path):
+        params, options = wire.params_from_spec(
+            "carol", base_spec(spectate=True), root=tmp_path
+        )
+        assert options["spectate"]
+        assert params.no_vis is False and params.view_mode == "frame"
+        assert params.viewport == (0, 0, W, H)  # clamped to the board
+        assert params.frame_stride == 1
+
+    @pytest.mark.parametrize(
+        "mutate",
+        [
+            {"params": {"width": "x"}},
+            {"params": {"mesh_shape": [2, 1]}},
+            {"nonsense": True},
+            {"soup": {"density": "thick"}},
+            {"viewport": [0, 0, 8, 8]},  # needs spectate
+            {"spectate": True, "frame_stride": "fast"},
+        ],
+        ids=lambda m: str(sorted(m)[0]),
+    )
+    def test_bad_specs_refused(self, tmp_path, mutate):
+        spec = base_spec()
+        for key, val in mutate.items():
+            if key == "params":
+                spec["params"].update(val)
+            else:
+                spec[key] = val
+        with pytest.raises(wire.SpecError):
+            wire.params_from_spec("eve", spec, root=tmp_path)
+
+    def test_board_and_soup_conflict(self, tmp_path):
+        spec = base_spec(board_b64="aGk=")
+        with pytest.raises(wire.SpecError, match="not both"):
+            wire.params_from_spec("eve", spec, root=tmp_path)
+
+    def test_missing_board_refused(self, tmp_path):
+        with pytest.raises(wire.SpecError, match="needs a board"):
+            wire.params_from_spec(
+                "eve", {"params": {"turns": 5}}, root=tmp_path
+            )
+
+
+# -- the broker contract over a real socket ------------------------------------
+
+
+class TestEndToEnd:
+    def test_two_tenants_submit_control_quit_bit_identical(
+        self, pod, tmp_path
+    ):
+        """THE acceptance row: two tenants driven entirely through
+        tools/gol_client.py — alice runs to completion and her final
+        board is bit-identical to the in-process ServePlane.submit
+        oracle; bob is paused, resumed, then quit — the reference
+        detach — leaving a parked resumable checkpoint."""
+        plane, gateway, client = pod
+        alice_spec = base_spec()
+        doc = submit_spec(client, "alice", alice_spec)
+        assert doc["status"] in ("queued", "running")
+        bob_spec = base_spec(
+            params={"turns": 500_000, "ticker_period": 0.2},
+            soup={"density": 0.3, "seed": 11},
+        )
+        submit_spec(client, "bob", bob_spec)
+
+        #
+
+        # Bob: pause freezes the turn counter, resume advances it.
+        assert client.pause("bob")["ok"]
+        st1 = wait_status(client, "bob", ("running",), timeout=30)
+        time.sleep(0.5)
+        st1 = client.state("bob")
+        time.sleep(0.5)
+        st2 = client.state("bob")
+        assert st2["paused"] and st2["turn"] == st1["turn"]
+        client.resume("bob")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if client.state("bob")["turn"] > st2["turn"]:
+                break
+            time.sleep(0.05)
+        assert client.state("bob")["turn"] > st2["turn"]
+        # Quit = the reference detach: parked and resumable.
+        client.quit("bob")
+        st = wait_status(client, "bob", ("parked",), timeout=30)
+        assert st["resumable"]
+
+        # Alice: completed; the wire-observed final board equals the
+        # in-process oracle bit for bit.
+        st = wait_status(client, "alice", ("completed",), timeout=60)
+        with client.controller("alice") as ctrl:
+            final = None
+            while True:
+                msg = ctrl.recv(timeout=30)
+                if msg["type"] == "final":
+                    final = msg
+                if msg["type"] == "end":
+                    assert msg["status"] == "completed"
+                    break
+        assert final is not None and final["turn"] == TURNS
+        oracle = oracle_final(tmp_path, "alice", alice_spec)
+        assert oracle.completed_turns == TURNS
+        assert set(map(tuple, final["alive"])) == {
+            (c.x, c.y) for c in oracle.alive
+        }
+
+    def test_shed_submission_is_429_with_retry_after(self, tmp_path):
+        plane = ServePlane(
+            ServeConfig(max_sessions=1, max_queued=0),
+            checkpoint_root=tmp_path / "ckpt",
+        )
+        gateway = GatewayServer(plane, port=0)
+        client = GolClient(gateway.url)
+        try:
+            submit_spec(
+                client, "a", base_spec(params={"turns": 500_000})
+            )
+            with pytest.raises(GatewayError) as ei:
+                submit_spec(client, "b", base_spec())
+            assert ei.value.status == 429
+            assert ei.value.retry_after is not None
+            # A permanent rejection (board over budget) is 409, no hint.
+            with pytest.raises(GatewayError) as ei:
+                submit_spec(
+                    client,
+                    "c",
+                    base_spec(params={"width": 1 << 14, "height": 1 << 14}),
+                )
+            assert ei.value.status == 409
+            client.quit("a")
+        finally:
+            gateway.close()
+            plane.close()
+
+    def test_errors_are_json_not_tracebacks(self, pod, tmp_path):
+        plane, gateway, client = pod
+        with pytest.raises(GatewayError) as ei:
+            client.state("nobody")
+        assert ei.value.status == 404
+        with pytest.raises(GatewayError) as ei:
+            submit_spec(client, "bad name!", base_spec())
+        assert ei.value.status == 400
+        with pytest.raises(GatewayError) as ei:
+            submit_spec(client, "x", {"params": {"warp_factor": 9}})
+        assert ei.value.status == 400
+        # A plane-submitted tenant has state but no control channel.
+        plane.submit("direct", Params(
+            image_width=W, image_height=H, turns=SUPERSTEP,
+            engine="roll", superstep=SUPERSTEP, soup_density=0.2,
+            turn_events="batch", cycle_check=0, out_dir=tmp_path / "direct",
+        ))
+        assert wait_status(client, "direct", ("completed",), timeout=60)
+        with pytest.raises(GatewayError) as ei:
+            client.pause("direct")
+        assert ei.value.status == 409
+
+
+class TestWireBooksBounded:
+    def test_ended_sessions_are_pruned_with_the_plane_eviction_ring(
+        self, tmp_path
+    ):
+        """A churning-tenant gateway pod stays bounded-memory: wire
+        books (replay rings, key queues) for ended tenants the plane
+        evicted are pruned at the next submission."""
+        plane = ServePlane(
+            ServeConfig(max_sessions=1, max_retained_handles=2),
+            checkpoint_root=tmp_path / "ckpt",
+        )
+        gateway = GatewayServer(plane, port=0)
+        client = GolClient(gateway.url)
+        try:
+            for i in range(6):
+                submit_spec(
+                    client,
+                    f"churn-{i}",
+                    base_spec(params={"turns": SUPERSTEP}),
+                )
+                wait_status(
+                    client, f"churn-{i}", ("completed",), timeout=60
+                )
+            with gateway._lock:
+                books = len(gateway._sessions)
+            # The current tenant plus at most the plane's retained ring.
+            assert books <= 1 + plane.config.max_retained_handles
+        finally:
+            gateway.close()
+            plane.close()
+
+
+class TestDetachReconnect:
+    def test_disconnect_is_detach_and_reconnect_reads_the_same_tail(
+        self, pod
+    ):
+        """Controller disconnect must not touch the run; a reconnect
+        with ?since= replays the ring tail seq-contiguously, and the
+        union of both attachments tiles the whole turn range — the
+        'same event stream as an attached oracle' acceptance bar."""
+        plane, gateway, client = pod
+        # 400 turns / superstep 4 = 100 turn-ranges: comfortably inside
+        # the RING_DEPTH replay window, so the reconnect tail is exact.
+        submit_spec(client, "alice", base_spec(params={"turns": 400}))
+        seen: list[dict] = []
+        with client.controller("alice") as ctrl:
+            hello = ctrl.recv(timeout=30)
+            assert hello["type"] == "hello"
+            while len(seen) < 2:
+                msg = ctrl.recv(timeout=30)
+                if msg["type"] == "turns":
+                    seen.append(msg)
+        last_seq = seen[-1]["seq"]
+        # Detached: the run keeps advancing without any controller.
+        turn0 = client.state("alice")["turn"]
+        wait_status(client, "alice", ("completed",), timeout=60)
+        assert client.state("alice")["turn"] == 400 >= turn0
+        # Reconnect after the end: the ring replays the tail.
+        with client.controller("alice", since=last_seq) as ctrl:
+            hello = ctrl.recv(timeout=30)
+            assert hello["type"] == "hello" and hello["replay"] > 0
+            while True:
+                msg = ctrl.recv(timeout=30)
+                if msg["type"] == "end":
+                    assert msg["status"] == "completed"
+                    break
+                seen.append(msg)
+        seqs = [m["seq"] for m in seen]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        turns = [m for m in seen if m["type"] == "turns"]
+        # The ranges tile 1..400 with no gaps or overlaps — the union
+        # of both attachments IS the attached oracle's stream.
+        expect = 1
+        for msg in turns:
+            assert msg["first"] == expect
+            expect = msg["turn"] + 1
+        assert expect == 401
+
+
+# -- spectators ----------------------------------------------------------------
+
+
+def crop(board: np.ndarray, rect) -> np.ndarray:
+    y0, x0, vh, vw = rect
+    h, w = board.shape
+    rows = (np.arange(vh) + y0) % h
+    cols = (np.arange(vw) + x0) % w
+    return board[rows[:, None], cols[None, :]]
+
+
+class TestSpectators:
+    SIZE = 64
+    TURNS = 20
+
+    def _spectate_spec(self, turns=None):
+        return base_spec(
+            params={
+                "width": self.SIZE,
+                "height": self.SIZE,
+                "turns": turns or self.TURNS,
+            },
+            soup={"density": 0.3, "seed": 17},
+            spectate=True,
+            viewport=[0, 0, 32, 32],
+        )
+
+    def test_n_spectators_cost_one_fetch_per_frame_and_reconstruct(
+        self, pod
+    ):
+        plane, gateway, client = pod
+        reg = obs_metrics.REGISTRY
+        fetches0 = reg.counter("frames.fetches").value
+        publishes0 = reg.counter("frames.publishes").value
+        submit_spec(client, "alice", self._spectate_spec())
+        rng = np.random.default_rng(5)
+        rects = [
+            (
+                int(rng.integers(0, self.SIZE)),
+                int(rng.integers(0, self.SIZE)),
+                24,
+                24,
+            )
+            for _ in range(3)
+        ]
+        streams = [
+            client.spectate("alice", rect=r, queue_depth=self.TURNS + 2)
+            for r in rects
+        ]
+        try:
+            finals = []
+            for stream in streams:
+                while not stream.ended:
+                    event = stream.recv(timeout=60)
+                    if not isinstance(event, dict):
+                        stream.feed(event)
+                finals.append((stream.buf, stream.turn))
+        finally:
+            for stream in streams:
+                stream.close()
+        st = wait_status(client, "alice", ("completed",), timeout=30)
+        # Superset-fetch economics preserved over the wire: however
+        # many wire spectators, fetches/frame == 1.
+        fetches = reg.counter("frames.fetches").value - fetches0
+        publishes = reg.counter("frames.publishes").value - publishes0
+        assert publishes == self.TURNS
+        assert fetches == publishes, "fetches/frame != 1 over the wire"
+        # Every spectator's reconstruction equals the final-board crop.
+        final_board = self._final_board(client, "alice")
+        for (buf, turn), rect in zip(finals, rects):
+            assert turn == self.TURNS
+            want = (crop(final_board, rect) != 0) * np.uint8(255)
+            assert np.array_equal(buf, want)
+
+    def _final_board(self, client, tenant) -> np.ndarray:
+        with client.controller(tenant) as ctrl:
+            while True:
+                msg = ctrl.recv(timeout=30)
+                if msg["type"] == "final":
+                    board = np.zeros((self.SIZE, self.SIZE), np.uint8)
+                    for x, y in msg["alive"]:
+                        board[y, x] = 255
+                    return board
+                if msg["type"] == "end":
+                    raise AssertionError("stream ended without a final")
+
+    def test_stalled_spectator_never_wedges_the_producer(self, pod):
+        """A spectator that attaches and then reads NOTHING while the
+        run completes: the producer finishes every turn on schedule
+        (drop-oldest, bounded queues); when the client finally drains,
+        it observes dropped turns and a re-anchoring keyframe, and
+        still converges to the final board."""
+        plane, gateway, client = pod
+        turns = 150
+        submit_spec(client, "alice", self._spectate_spec(turns=turns))
+        # Slow consumer, deterministically: a pinned 4 KiB receive
+        # buffer (+ the gateway's bounded spectator SO_SNDBUF) wedges
+        # the SOCKET after a handful of full-board frames, so the
+        # subscriber queue (depth 2) must drop-oldest long before the
+        # run ends.
+        stream = client.spectate(
+            "alice",
+            rect=(0, 0, self.SIZE, self.SIZE),
+            queue_depth=2,
+            recv_buffer=4096,
+        )
+        try:
+            # Stall: no reads while the whole run executes.
+            st = wait_status(client, "alice", ("completed",), timeout=120)
+            assert st["turn"] == turns, "stalled spectator wedged the run"
+            keyframes, frame_turns = 0, []
+            while not stream.ended:
+                event = stream.recv(timeout=30)
+                if isinstance(event, dict):
+                    continue
+                if isinstance(event, FrameReady):
+                    keyframes += 1
+                frame_turns.append(event.completed_turns)
+                stream.feed(event)
+            # Drop-oldest on the wire: the stalled client cannot have
+            # received every turn, and the post-drop re-keyframe is
+            # what re-anchored the survivors.
+            assert len(frame_turns) < turns
+            assert keyframes >= 2, "no re-keyframe observed on the wire"
+            assert stream.turn == turns
+            final_board = self._final_board(client, "alice")
+            want = (final_board != 0) * np.uint8(255)
+            assert np.array_equal(stream.buf, want)
+        finally:
+            stream.close()
+
+    def test_set_viewport_rekeyframes_midstream(self, pod):
+        plane, gateway, client = pod
+        submit_spec(client, "alice", self._spectate_spec(turns=200))
+        with client.spectate("alice", rect=(0, 0, 16, 16)) as stream:
+            first = stream.recv(timeout=30)
+            while isinstance(first, dict):
+                first = stream.recv(timeout=30)
+            assert isinstance(first, FrameReady)
+            assert first.rect == (0, 0, 16, 16)
+            stream.set_viewport((8, 8, 24, 24))
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                event = stream.recv(timeout=30)
+                if (
+                    not isinstance(event, dict)
+                    and event.rect == (8, 8, 24, 24)
+                ):
+                    assert isinstance(event, FrameReady), (
+                        "viewport change must re-keyframe"
+                    )
+                    break
+            else:
+                raise AssertionError("new viewport never arrived")
+        client.quit("alice")
+        wait_status(client, "alice", ("parked",), timeout=30)
+
+
+# -- chaos ---------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestGatewayChaos:
+    BOUND_S = 2.0
+
+    def test_bounded_time_with_a_hang_faulted_tenant_resident(
+        self, pod, tmp_path
+    ):
+        """The PR-10 scrape bound, on the gateway: while one tenant's
+        dispatch is wedged (hang fault, bounded by its own watchdog),
+        every list/state/healthz answer lands within 2 s."""
+        plane, gateway, client = pod
+        hang_params = Params(
+            image_width=W, image_height=H, turns=500_000,
+            engine="roll", superstep=SUPERSTEP, soup_density=0.25,
+            soup_seed=31, turn_events="batch", cycle_check=0,
+            dispatch_deadline_seconds=3.0, out_dir=tmp_path / "hang",
+        )
+        hang_backend = FaultInjectionBackend(
+            Backend(hang_params), FaultPlan([Fault(1, "hang", seconds=60.0)])
+        )
+        try:
+            plane.submit("hang", hang_params, backend=hang_backend)
+            submit_spec(client, "healthy", base_spec())
+            worst = 0.0
+            deadline = time.monotonic() + 60
+            done = False
+            while time.monotonic() < deadline and not done:
+                for fn in (
+                    lambda: client.sessions(),
+                    lambda: client.state("hang"),
+                    lambda: client.health(),
+                ):
+                    t0 = time.monotonic()
+                    fn()
+                    worst = max(worst, time.monotonic() - t0)
+                hang_h = plane.handle("hang")
+                done = (
+                    client.state("healthy")["status"] == "completed"
+                    and hang_h is not None
+                    and hang_h.done
+                )
+                time.sleep(0.1)
+            assert done, "storm never settled"
+            assert worst < self.BOUND_S, (
+                f"gateway took {worst:.2f}s with a wedged tenant resident"
+            )
+            st = client.state("hang")
+            assert st["status"] == "parked"
+            assert "DispatchTimeout" in (st["error"] or "")
+        finally:
+            hang_backend.release_hangs()
+
+    def test_drain_over_the_wire_and_readopt(self, tmp_path):
+        """POST /v1/drain: the parked-resumable receipt comes back over
+        the socket, the gateway refuses new submissions before the
+        plane sheds, and a restarted pod re-adopts every tenant — the
+        serve --readopt contract end to end."""
+        root = tmp_path / "ckpt"
+        plane = ServePlane(
+            ServeConfig(max_sessions=4, telemetry_sample_seconds=0.1),
+            checkpoint_root=root,
+        )
+        gateway = GatewayServer(plane, port=0)
+        client = GolClient(gateway.url)
+        try:
+            for name, seed in (("alice", 1), ("bob", 2)):
+                submit_spec(
+                    client,
+                    name,
+                    base_spec(
+                        params={"turns": 500_000},
+                        soup={"density": 0.3, "seed": seed},
+                    ),
+                )
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if all(
+                    client.state(t)["turn"] > 0 for t in ("alice", "bob")
+                ):
+                    break
+                time.sleep(0.05)
+            receipt = client.drain(timeout=60)
+            assert receipt["draining"]
+            for name in ("alice", "bob"):
+                row = receipt["sessions"][name]
+                assert row["status"] == "drained"
+                assert row["resumable"] and row["turn"] > 0
+            with pytest.raises(GatewayError) as ei:
+                submit_spec(client, "late", base_spec())
+            assert ei.value.status == 503
+        finally:
+            gateway.close()
+            plane.close()
+        # The restarted pod: re-adopt and run each tenant forward.
+        with ServePlane(
+            ServeConfig(max_sessions=4), checkpoint_root=root
+        ) as fresh:
+            adoptable = fresh.resumable_tenants()
+            assert set(adoptable) == {"alice", "bob"}
+            parked_turn = adoptable["alice"]["turn"]
+            target = parked_turn + 2 * SUPERSTEP
+            handle = fresh.submit(
+                "alice",
+                Params(
+                    image_width=W, image_height=H, turns=target,
+                    engine="roll", superstep=SUPERSTEP,
+                    turn_events="batch", cycle_check=0,
+                    out_dir=root / "alice",
+                ),
+            )
+            assert handle.wait(timeout=60)
+            assert handle.status == "completed"
+            assert handle.last_turn == target
+
+
+# -- the serve CLI with a gateway ----------------------------------------------
+
+
+class TestServeCliGateway:
+    def test_gateway_pod_serves_until_drained_and_prints_endpoints(
+        self, tmp_path
+    ):
+        """serve --gateway-port 0: the banner and the JSON receipt both
+        carry the RESOLVED endpoint (never a placeholder), scripted
+        tenants are wire-controllable, and drain-over-the-wire ends
+        the pod."""
+        from distributed_gol_tpu.__main__ import serve_main
+
+        before = (
+            obs_metrics.REGISTRY.snapshot()
+            .to_dict()["info"]
+            .get("gateway.endpoint")
+        )
+        out, err = io.StringIO(), io.StringIO()
+        rc: list[int] = []
+
+        def run():
+            with contextlib.redirect_stdout(out), contextlib.redirect_stderr(
+                err
+            ):
+                rc.append(
+                    serve_main(
+                        [
+                            "--tenant", f"scripted:{W}x{H}x500000",
+                            "--checkpoint-root", str(tmp_path / "ckpt"),
+                            "--superstep", str(SUPERSTEP),
+                            "--engine", "roll",
+                            "--gateway-port", "0",
+                        ]
+                    )
+                )
+
+        thread = threading.Thread(target=run, daemon=True)
+        thread.start()
+        url = None
+        deadline = time.monotonic() + 60
+        while url is None and time.monotonic() < deadline:
+            info = obs_metrics.REGISTRY.snapshot().to_dict()["info"]
+            got = info.get("gateway.endpoint")
+            if got and got != before:
+                url = got
+            else:
+                time.sleep(0.05)
+        assert url is not None, "pod never published its gateway endpoint"
+        client = GolClient(url)
+        st = wait_status(client, "scripted", ("running",), timeout=60)
+        assert st["controllable"], "scripted tenant must be wire-controllable"
+        receipt = client.drain(timeout=60)
+        assert receipt["sessions"]["scripted"]["resumable"]
+        thread.join(timeout=60)
+        assert not thread.is_alive(), "pod did not exit after the drain"
+        assert rc == [0]
+        doc = json.loads(out.getvalue().strip().splitlines()[-1])
+        assert doc["gateway"]["endpoint"] == url
+        assert "<ephemeral>" not in out.getvalue() + err.getvalue()
+        banner = err.getvalue()
+        assert f"gateway: {url}/v1/sessions" in banner

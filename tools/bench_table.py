@@ -66,6 +66,13 @@ def main():
                     "latency) plus the FramePlane fan-out row")
     ap.add_argument("--frames-viewport", type=int, default=1024,
                     metavar="V", help="viewport side for --frames")
+    ap.add_argument("--gateway", action="store_true",
+                    help="also run bench.bench_gateway (ISSUE 14) and "
+                    "render the wire A/B: control RTT over a real "
+                    "socket vs in-process, frame bytes/frame wire vs "
+                    "FramePlane, and the N-spectator fetches/frame pin")
+    ap.add_argument("--gateway-spectators", type=int, default=8,
+                    metavar="N", help="wire spectator count for --gateway")
     ap.add_argument("--sharded-meshes", metavar="LIST", default=None,
                     help="also run bench.bench_sharded per mesh (comma "
                     "list of NY[xNX] specs, e.g. '8,4x2,2x4') at the "
@@ -142,6 +149,13 @@ def main():
         print_frames_table(
             bench_frames(sizes[-1], viewport=args.frames_viewport)
         )
+
+    if args.gateway:
+        from bench import bench_gateway
+
+        rec = bench_gateway(spectators=args.gateway_spectators)
+        _lint_serve(rec)
+        print_gateway_table(rec)
 
     if args.serve and args.batched:
         from bench import bench_serve_batched
@@ -262,6 +276,42 @@ def print_frames_table(rec: dict) -> None:
         f"\nboard-bytes ratio x{rec['bytes_ratio']:.0f}, frame-latency "
         f"ratio x{rec['latency_ratio']:.2f}, fetches/frame "
         f"{fan['fetches_per_frame']:.2f} (identity: {rec['identity']})"
+    )
+
+
+def print_gateway_table(rec: dict) -> None:
+    """Render a ``bench.bench_gateway`` record (ISSUE 14) as markdown:
+    the control-RTT arm (in-process handle read vs GET state over a
+    real socket) and the frame arm (FramePlane bytes/frame vs the wire
+    stream's), with the N-spectator fetches/frame pin under it."""
+    ctl = rec["control_rtt"]
+    fr = rec["frames"]
+    print()
+    print("| Gateway arm | median | spread | reps | bytes/frame |")
+    print("|---|---|---|---|---|")
+    print(
+        f"| control in-process | {ctl['in_process']['median']:,.0f} ops/s "
+        f"| {ctl['in_process']['spread']:.1%} | "
+        f"{ctl['in_process']['reps']} | — |"
+    )
+    print(
+        f"| control over-the-wire | {ctl['wire']['median']:,.0f} ops/s "
+        f"({ctl['wire_rtt_ms']:.2f} ms RTT) | {ctl['wire']['spread']:.1%} "
+        f"| {ctl['wire']['reps']} | — |"
+    )
+    for label, row in (
+        ("frames in-process", fr["in_process"]),
+        ("frames over-the-wire", fr["wire"]),
+    ):
+        print(
+            f"| {label} | {row['median']:,.1f} frames/s | "
+            f"{row['spread']:.1%} | {row['reps']} | "
+            f"{row['bytes_per_frame']:,.0f} |"
+        )
+    print(
+        f"\n{rec['spectators']} wire spectators on one {rec['size']}² run: "
+        f"{fr['fetches_per_frame']:.2f} device fetches/frame; wire byte "
+        f"overhead x{fr['wire_overhead_ratio']:.2f} vs in-process"
     )
 
 

@@ -1,0 +1,460 @@
+"""Remote-pod client for the network gateway (ISSUE 14).
+
+Drive and watch a live serving pod from a second terminal — pure
+stdlib (``http.client`` + the same ``serve/ws.py``/``serve/wire.py``
+codec the gateway speaks, so client and server cannot drift).  The
+verbs are the reference broker contract on the wire: ``submit`` is
+``Broker.Publish``, ``pause``/``resume`` ``Broker.Pause``, ``state``/
+``list`` ``Broker.CheckStates``, ``quit`` ``Broker.Quit``; ``events``
+attaches as a *controller* (detach/reattach any time — the run keeps
+going), ``watch`` as a *spectator* (keyframe + delta frames for a
+viewport rect).
+
+Usage (terminal 1 runs the pod, e.g.
+``python -m distributed_gol_tpu serve --gateway-port 9191 ...``):
+
+    python tools/gol_client.py http://127.0.0.1:9191 submit alice \\
+        --size 512 --turns 100000 --soup 0.3 --spectate
+    python tools/gol_client.py http://127.0.0.1:9191 watch alice \\
+        --rect 0,0,64,64
+    python tools/gol_client.py http://127.0.0.1:9191 events alice
+    python tools/gol_client.py http://127.0.0.1:9191 pause alice
+    python tools/gol_client.py http://127.0.0.1:9191 state alice
+    python tools/gol_client.py http://127.0.0.1:9191 quit alice
+    python tools/gol_client.py http://127.0.0.1:9191 drain
+
+Tests import :class:`GolClient` as a library; the CLI is a thin shell
+over it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import http.client
+import json
+import sys
+import time
+from pathlib import Path
+from urllib.parse import urlsplit
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from distributed_gol_tpu.engine import frames as frames_lib  # noqa: E402
+from distributed_gol_tpu.engine.events import (  # noqa: E402
+    FrameDelta,
+    FrameReady,
+)
+from distributed_gol_tpu.serve import wire  # noqa: E402
+from distributed_gol_tpu.serve.ws import (  # noqa: E402
+    OP_TEXT,
+    WebSocket,
+    WsClosed,
+    client_connect,
+)
+
+
+class GatewayError(RuntimeError):
+    """A non-2xx gateway response; carries status, body, and the 429
+    ``retry_after`` hint when the pod shed the request."""
+
+    def __init__(self, status: int, body):
+        self.status = status
+        self.body = body
+        self.retry_after = None
+        if isinstance(body, dict):
+            self.retry_after = body.get("retry_after")
+        super().__init__(f"HTTP {status}: {body}")
+
+
+class GolClient:
+    """One pod's gateway, as an object.  ``base_url`` is the gateway
+    endpoint (``http://host:port``)."""
+
+    def __init__(self, base_url: str, timeout: float = 60.0):
+        split = urlsplit(base_url if "//" in base_url else f"//{base_url}")
+        self.host = split.hostname or "127.0.0.1"
+        self.port = split.port or 80
+        self.timeout = timeout
+
+    # -- REST ------------------------------------------------------------------
+    def _request(self, method: str, path: str, body: dict | None = None):
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout
+        )
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"} if payload else {}
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            try:
+                doc = json.loads(raw) if raw else {}
+            except ValueError:
+                doc = {"raw": raw.decode(errors="replace")}
+            if resp.status >= 400:
+                raise GatewayError(resp.status, doc)
+            return doc
+        finally:
+            conn.close()
+
+    def submit(
+        self,
+        tenant: str,
+        *,
+        width: int | None = None,
+        height: int | None = None,
+        turns: int | None = None,
+        soup: float | None = None,
+        seed: int = 0,
+        board: "np.ndarray | bytes | None" = None,
+        spectate: bool = False,
+        viewport=None,
+        frame_stride: int | None = None,
+        deadline_seconds: float | None = None,
+        params: dict | None = None,
+    ) -> dict:
+        """``Broker.Publish`` over the wire: soup spec or board upload
+        (a numpy array or raw PGM bytes, shipped base64 in the POST)."""
+        p = dict(params or {})
+        for key, val in (
+            ("width", width), ("height", height), ("turns", turns),
+        ):
+            if val is not None:
+                p[key] = val
+        doc: dict = {"tenant": tenant, "params": p}
+        if board is not None:
+            if isinstance(board, np.ndarray):
+                from distributed_gol_tpu.engine import pgm
+
+                board = pgm.encode_pgm(board)
+            doc["board_b64"] = base64.b64encode(board).decode()
+        elif soup is not None:
+            doc["soup"] = {"density": soup, "seed": seed}
+        if spectate:
+            doc["spectate"] = True
+            if viewport is not None:
+                doc["viewport"] = list(viewport)
+            if frame_stride is not None:
+                doc["frame_stride"] = frame_stride
+        if deadline_seconds is not None:
+            doc["deadline_seconds"] = deadline_seconds
+        return self._request("POST", "/v1/sessions", doc)
+
+    def sessions(self) -> dict:
+        return self._request("GET", "/v1/sessions")
+
+    def state(self, tenant: str) -> dict:
+        return self._request("GET", f"/v1/sessions/{tenant}/state")
+
+    def pause(self, tenant: str) -> dict:
+        return self._request("POST", f"/v1/sessions/{tenant}/pause")
+
+    def resume(self, tenant: str) -> dict:
+        return self._request("POST", f"/v1/sessions/{tenant}/resume")
+
+    def quit(self, tenant: str) -> dict:
+        return self._request("POST", f"/v1/sessions/{tenant}/quit")
+
+    def drain(self, timeout: float | None = None) -> dict:
+        path = "/v1/drain"
+        if timeout is not None:
+            path += f"?timeout={timeout:g}"
+        return self._request("POST", path)
+
+    def health(self) -> dict:
+        try:
+            return self._request("GET", "/healthz")
+        except GatewayError as e:
+            if isinstance(e.body, dict) and "ready" in e.body:
+                return e.body  # 503 still carries the health dict
+            raise
+
+    # -- WebSocket legs --------------------------------------------------------
+    def controller(self, tenant: str, since: int = 0) -> "ControllerStream":
+        """Attach as a controller: live JSON events + control frames.
+        Disconnecting is a detach — the run keeps going."""
+        path = f"/v1/sessions/{tenant}/events"
+        if since:
+            path += f"?since={since}"
+        return ControllerStream(
+            client_connect(self.host, self.port, path, timeout=self.timeout)
+        )
+
+    def spectate(
+        self,
+        tenant: str,
+        rect=None,
+        queue_depth: int = 8,
+        recv_buffer: int | None = None,
+    ) -> "SpectatorStream":
+        """Attach as a spectator for a viewport rect: keyframe +
+        delta frames off the session's FramePlane.  ``recv_buffer``
+        pins the socket's SO_RCVBUF (slow-consumer simulation)."""
+        path = f"/v1/sessions/{tenant}/frames"
+        qs = []
+        if rect is not None:
+            qs.append("rect=" + ",".join(str(int(v)) for v in rect))
+        if queue_depth != 8:
+            qs.append(f"queue={queue_depth}")
+        if qs:
+            path += "?" + "&".join(qs)
+        return SpectatorStream(
+            client_connect(
+                self.host,
+                self.port,
+                path,
+                timeout=self.timeout,
+                recv_buffer=recv_buffer,
+            )
+        )
+
+
+class ControllerStream:
+    """The controller leg, client side: ``recv()`` yields wire message
+    dicts (``hello``/``turns``/``alive``/``state``/``end``/...); the
+    control verbs send the matching frames."""
+
+    def __init__(self, ws: WebSocket):
+        self.ws = ws
+
+    def recv(self, timeout: float | None = None) -> dict:
+        self.ws.settimeout(timeout)
+        opcode, payload = self.ws.recv()
+        if opcode != OP_TEXT:
+            raise WsClosed("unexpected binary frame on the controller leg")
+        return json.loads(payload)
+
+    def _send(self, msg: dict) -> None:
+        self.ws.send_text(json.dumps(msg))
+
+    def pause(self):
+        self._send({"type": "pause"})
+
+    def resume(self):
+        self._send({"type": "resume"})
+
+    def quit(self):
+        self._send({"type": "quit"})
+
+    def key(self, key: str):
+        self._send({"type": "key", "key": key})
+
+    def close(self):
+        self.ws.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class SpectatorStream:
+    """The spectator leg, client side: ``recv()`` yields decoded
+    ``FrameReady``/``FrameDelta`` events (binary frames) or message
+    dicts (text frames: ``hello``/``end``/``error``);
+    :meth:`reconstruct` folds them into a live frame buffer with the
+    same skip-orphan-deltas contract as the in-process subscriber."""
+
+    def __init__(self, ws: WebSocket):
+        self.ws = ws
+        self.buf: np.ndarray | None = None
+        self.turn = 0
+        self.ended = False
+
+    def recv(self, timeout: float | None = None):
+        self.ws.settimeout(timeout)
+        opcode, payload = self.ws.recv()
+        if opcode == OP_TEXT:
+            msg = json.loads(payload)
+            if msg.get("type") == "end":
+                self.ended = True
+            return msg
+        return wire.decode_frame_event(payload)
+
+    def feed(self, event) -> np.ndarray | None:
+        """Fold one frame event into the reconstruction buffer (None
+        until the first keyframe; orphan deltas are skipped — the
+        post-drop re-keyframe converges the stream)."""
+        if isinstance(event, FrameReady):
+            self.buf = np.array(event.frame, dtype=np.uint8, copy=True)
+            self.turn = event.completed_turns
+        elif isinstance(event, FrameDelta) and self.buf is not None:
+            frames_lib.apply_bands(self.buf, event.bands)
+            self.turn = event.completed_turns
+        return self.buf
+
+    def set_viewport(self, rect) -> None:
+        self.ws.send_text(
+            json.dumps(
+                {"type": "set_viewport", "rect": [int(v) for v in rect]}
+            )
+        )
+
+    def close(self):
+        self.ws.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -- CLI -----------------------------------------------------------------------
+
+def _render(buf: np.ndarray, max_cols: int = 96) -> str:
+    """Terminal render of a frame buffer: '#' alive, '.' dead, column-
+    subsampled to fit."""
+    step = max(1, -(-buf.shape[1] // max_cols))
+    view = buf[::step, ::step]
+    return "\n".join(
+        "".join("#" if v else "." for v in row) for row in view
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("url", help="gateway base URL, e.g. http://127.0.0.1:9191")
+    sub = ap.add_subparsers(dest="verb", required=True)
+
+    p_submit = sub.add_parser("submit", help="Broker.Publish: start a session")
+    p_submit.add_argument("tenant")
+    p_submit.add_argument("--size", type=int, default=512)
+    p_submit.add_argument("--width", type=int, default=None)
+    p_submit.add_argument("--height", type=int, default=None)
+    p_submit.add_argument("--turns", type=int, default=10_000)
+    p_submit.add_argument("--soup", type=float, default=None,
+                          help="soup density (omit with --board)")
+    p_submit.add_argument("--seed", type=int, default=0)
+    p_submit.add_argument("--board", default=None, metavar="FILE.pgm",
+                          help="upload this PGM as the starting board")
+    p_submit.add_argument("--engine", default=None)
+    p_submit.add_argument("--superstep", type=int, default=None)
+    p_submit.add_argument("--spectate", action="store_true",
+                          help="frame-mode session: spectators may attach")
+    p_submit.add_argument("--viewport", default=None, metavar="Y0,X0,VH,VW")
+    p_submit.add_argument("--checkpoint-every-turns", type=int, default=None)
+
+    for verb in ("state", "pause", "resume", "quit"):
+        p = sub.add_parser(verb)
+        p.add_argument("tenant")
+    sub.add_parser("list", help="Broker.CheckStates across the pod")
+    sub.add_parser("health")
+    p_drain = sub.add_parser("drain", help="drain the pod over the wire")
+    p_drain.add_argument("--timeout", type=float, default=None)
+
+    p_events = sub.add_parser("events", help="attach as a controller")
+    p_events.add_argument("tenant")
+    p_events.add_argument("--since", type=int, default=0)
+
+    p_watch = sub.add_parser("watch", help="attach as a spectator")
+    p_watch.add_argument("tenant")
+    p_watch.add_argument("--rect", default=None, metavar="Y0,X0,VH,VW")
+    p_watch.add_argument("--frames", type=int, default=0,
+                         help="stop after N frames (0 = until the end)")
+    p_watch.add_argument("--no-render", action="store_true",
+                         help="stats lines only, no board render")
+
+    args = ap.parse_args(argv)
+    client = GolClient(args.url)
+    try:
+        return _run_verb(client, args)
+    except GatewayError as e:
+        print(f"error: {e}", file=sys.stderr)
+        if e.retry_after is not None:
+            print(f"retry after {e.retry_after:g}s", file=sys.stderr)
+        return 1
+    except (ConnectionError, OSError) as e:
+        print(f"{args.url}: unreachable ({e})", file=sys.stderr)
+        return 1
+
+
+def _run_verb(client: GolClient, args) -> int:
+    if args.verb == "submit":
+        board = None
+        if args.board:
+            board = Path(args.board).read_bytes()
+        params = {}
+        for key in ("engine", "superstep", "checkpoint_every_turns"):
+            val = getattr(args, key)
+            if val is not None:
+                params[key] = val
+        viewport = None
+        if args.viewport:
+            viewport = [int(v) for v in args.viewport.split(",")]
+        doc = client.submit(
+            args.tenant,
+            width=args.width or args.size,
+            height=args.height or args.size,
+            turns=args.turns,
+            soup=args.soup if board is None else None,
+            seed=args.seed,
+            board=board,
+            spectate=args.spectate,
+            viewport=viewport,
+            params=params,
+        )
+        print(json.dumps(doc, indent=2))
+        return 0
+    if args.verb in ("state", "pause", "resume", "quit"):
+        print(json.dumps(getattr(client, args.verb)(args.tenant), indent=2))
+        return 0
+    if args.verb == "list":
+        print(json.dumps(client.sessions(), indent=2))
+        return 0
+    if args.verb == "health":
+        print(json.dumps(client.health(), indent=2))
+        return 0
+    if args.verb == "drain":
+        print(json.dumps(client.drain(args.timeout), indent=2))
+        return 0
+    if args.verb == "events":
+        with client.controller(args.tenant, since=args.since) as stream:
+            try:
+                while True:
+                    msg = stream.recv()
+                    print(json.dumps(msg))
+                    if msg.get("type") == "end":
+                        return 0
+            except (WsClosed, KeyboardInterrupt):
+                return 0
+    if args.verb == "watch":
+        rect = None
+        if args.rect:
+            rect = [int(v) for v in args.rect.split(",")]
+        shown = 0
+        with client.spectate(args.tenant, rect=rect) as stream:
+            try:
+                while True:
+                    event = stream.recv()
+                    if isinstance(event, dict):
+                        if event.get("type") == "end":
+                            return 0
+                        continue
+                    buf = stream.feed(event)
+                    shown += 1
+                    kind = (
+                        "keyframe"
+                        if isinstance(event, FrameReady)
+                        else f"delta({len(event.bands)} bands)"
+                    )
+                    if buf is not None and not args.no_render:
+                        print(f"\x1b[2J\x1b[H{_render(buf)}")
+                    print(
+                        f"turn {stream.turn}: {kind}, "
+                        f"{int(np.count_nonzero(stream.buf))} alive tiles",
+                        flush=True,
+                    )
+                    if args.frames and shown >= args.frames:
+                        return 0
+            except (WsClosed, KeyboardInterrupt):
+                return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,6 +64,16 @@ def _fmt_latency(pcts: dict | None) -> str:
     return f"{p99 * 1000:.0f}ms" if p99 < 1 else f"{p99:.2f}s"
 
 
+def _fmt_bytes(n: float | None) -> str:
+    if not n:
+        return "0B"
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024:
+            return f"{n:,.0f}{unit}"
+        n /= 1024
+    return f"{n:,.1f}TB"
+
+
 def _fmt_budget(row: dict | None) -> str:
     if not row:
         return "-"
@@ -114,6 +124,25 @@ def render_frame(cur: dict, prev: dict | None = None) -> str:
     alerting = slo.get("alerting") or []
     if alerting:
         lines.append("ALERTING: " + ", ".join(alerting))
+
+    # The wire panel (ISSUE 14): who is attached over the gateway and
+    # what the frame fan-out shipped — rendered only when the pod has
+    # a wire face or served spectator frames.
+    gw = health.get("gateway") or {}
+    fr = health.get("frames") or {}
+    if gw.get("endpoint") or fr.get("publishes"):
+        lines.append(
+            f"wire {gw.get('endpoint') or '-'} | "
+            f"ctrl {gw.get('controllers', 0)} "
+            f"spect {gw.get('spectators', 0)} | "
+            f"submitted {gw.get('sessions_submitted', 0)} "
+            f"rejected {gw.get('rejected', 0)} | "
+            f"frames {fr.get('publishes', 0)}pub/"
+            f"{fr.get('fetches', 0)}fetch "
+            f"{fr.get('frames_served', 0)} served, "
+            f"{_fmt_bytes(fr.get('bytes_shipped', 0))} shipped "
+            f"({_fmt_bytes(gw.get('bytes_streamed', 0))} on wire)"
+        )
 
     # Client-side per-tenant rates from consecutive scrapes.
     dt = (cur["t"] - prev["t"]) if prev else 0.0
