@@ -474,6 +474,7 @@ def bench_controller_path(
     params_overrides: dict | None = None,
     backend_factory=None,
     out_stats: dict | None = None,
+    trace_request: bool = False,
 ) -> tuple[float, int]:
     """Throughput of the full product surface — ``gol.run()`` with a live
     consumer draining the event queue — NOT the bench harness's bare
@@ -574,13 +575,26 @@ def bench_controller_path(
 
     timer = threading.Thread(target=quit_later, daemon=True)
     timer.start()
-    run(
-        params,
-        events,
-        keys,
-        session=Session(),
-        backend=backend_factory(params) if backend_factory else None,
+    # ``trace_request`` (ISSUE 15): run under an active request trace —
+    # the tracing-on arm of the overhead A/B; every obs.spans call site
+    # then records host spans exactly like a traced serving-plane run.
+    import contextlib
+
+    from distributed_gol_tpu.obs import tracing
+
+    req_trace = (
+        tracing.TRACER.start_trace(sampled=True) if trace_request else None
     )
+    with tracing.activate(req_trace) if req_trace else contextlib.nullcontext():
+        run(
+            params,
+            events,
+            keys,
+            session=Session(),
+            backend=backend_factory(params) if backend_factory else None,
+        )
+    if req_trace is not None:
+        tracing.TRACER.end_trace(req_trace, status="completed")
     consumer.join(timeout=300)
     if consumer.is_alive():
         log("  WARNING: event consumer still draining; results may be skewed")
@@ -2167,6 +2181,53 @@ def bench_telemetry_overhead(
     }
 
 
+def bench_tracing_overhead(
+    size: int = 256,
+    budget_seconds: float = 2.0,
+    reps: int = 3,
+) -> dict:
+    """The ISSUE-15 tracing-overhead arm: interleaved A/B controller-path
+    reps with NO active request trace (the always-on baseline — one
+    ContextVar read per span site) vs a live trace recording host spans
+    on every dispatch.  Same methodology and verdict tolerance as
+    ``bench_telemetry_overhead`` (interleaved arms, each arm's measured
+    rep envelope, 30% quiet-rig floor)."""
+    from distributed_gol_tpu.utils import measure
+
+    off_rates, on_rates = [], []
+    for _ in range(reps):
+        gps, _ = bench_controller_path(
+            size, budget_seconds=budget_seconds, superstep=256
+        )
+        if gps > 0:
+            off_rates.append(gps)
+        gps, _ = bench_controller_path(
+            size,
+            budget_seconds=budget_seconds,
+            superstep=256,
+            trace_request=True,
+        )
+        if gps > 0:
+            on_rates.append(gps)
+    if not off_rates or not on_rates:
+        return {"error": "no surviving reps", "off": off_rates, "on": on_rates}
+    off = measure.summarize(off_rates)
+    on = measure.summarize(on_rates)
+    envelope = off["spread"] + on["spread"]
+    tolerance = max(0.3, envelope)
+    rel = abs(on["median"] - off["median"]) / off["median"]
+    return {
+        "metric": f"gol_tracing_overhead_pilot_{size}x{size}",
+        "unit": "generations/sec",
+        "value": round(on["median"], 2),
+        **on,
+        "tracing_off": off,
+        "overhead_rel": round(rel, 4),
+        "tolerance": round(tolerance, 4),
+        "within_rep_spread": rel <= tolerance,
+    }
+
+
 def pilot_record(dev) -> dict:
     """``--pilot``: the whole record shape — engine row with quiet stats,
     controller-path row, bit-identity — at toy scale (256², fixed shallow
@@ -2214,6 +2275,11 @@ def pilot_record(dev) -> dict:
     # Telemetry-overhead arm (ISSUE 12): sampler on vs off, interleaved,
     # asserted within the rep spread by tier-1 (test_bench_pilot).
     record["telemetry_overhead"] = bench_telemetry_overhead(
+        size, budget_seconds=2.0, reps=3
+    )
+    # Tracing-overhead arm (ISSUE 15): request trace on vs off,
+    # interleaved, asserted within the rep spread by tier-1.
+    record["tracing_overhead"] = bench_tracing_overhead(
         size, budget_seconds=2.0, reps=3
     )
     ok = verify_engine(size, engine, turns=16)

@@ -389,6 +389,23 @@ def build_serve_parser() -> argparse.ArgumentParser:
                     help="burn-rate alert threshold: page when BOTH "
                     "windows burn the error budget faster than this "
                     "multiple of the sustainable pace")
+    ap.add_argument("--slo-queue-wait", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="queue-wait SLO (ISSUE 15; 0 = off): the "
+                    "latency percentile of admissions must start within "
+                    "this many seconds of submit (judged from the "
+                    "sli.queue_wait_seconds histogram the request-"
+                    "tracing plane derives)")
+    # Request-scoped tracing (ISSUE 15; docs/API.md "Distributed
+    # tracing").
+    ap.add_argument("--trace-sample-rate", type=float, default=1.0,
+                    metavar="RATE",
+                    help="head-sampling rate in [0, 1]: fraction of "
+                    "request traces RETAINED for /traces (error traces "
+                    "are tail-retained regardless; an inbound "
+                    "traceparent sampled flag always retains)")
+    ap.add_argument("--trace-ring-depth", type=int, default=256,
+                    help="finished-trace ring depth (the /traces window)")
     return ap
 
 
@@ -447,6 +464,9 @@ def serve_main(argv) -> int:
             slo_fast_window_seconds=args.slo_fast_window,
             slo_slow_window_seconds=args.slo_slow_window,
             slo_burn_threshold=args.slo_burn_threshold,
+            slo_queue_wait_seconds=args.slo_queue_wait,
+            trace_sample_rate=args.trace_sample_rate,
+            trace_ring_depth=args.trace_ring_depth,
         )
     except ValueError as e:
         ap.error(str(e))

@@ -57,6 +57,7 @@ from distributed_gol_tpu.engine.session import Session, default_session
 from distributed_gol_tpu.obs import flight as flight_lib
 from distributed_gol_tpu.obs import metrics as metrics_lib
 from distributed_gol_tpu.obs import spans
+from distributed_gol_tpu.obs import tracing
 from distributed_gol_tpu.utils.cell import AliveCells, Cell
 
 
@@ -340,6 +341,12 @@ class Controller:
         # The tier label every span carries: the sharded exchange tier
         # when one is in play, else the engine that actually runs.
         self._tier = self.backend.sharded_tier or self.backend.engine_used
+        # Request trace (ISSUE 15): the serving plane activates the
+        # request's trace on the worker context before gol.run, so the
+        # controller (and everything it calls through obs.spans) attaches
+        # without parameter threading.  None for untraced runs — every
+        # per-dispatch check below is then one attribute compare.
+        self.trace = tracing.current()
         qsize = getattr(self.events, "qsize", None)
         self._dispatch_rec = metrics_lib.DispatchRecorder(
             self.metrics,
@@ -348,6 +355,14 @@ class Controller:
             emit_timing=params.emit_timing,
             qsize=qsize,
             tenant=params.tenant,
+            trace=self.trace,
+        )
+        # Time-to-first-frame SLI (ISSUE 15): request start → first
+        # rendered/published frame, per tenant (frame-mode sessions).
+        self._h_ttff = self.metrics.histogram(
+            metrics_lib.labelled(
+                "sli.time_to_first_frame_seconds", params.tenant
+            )
         )
         self._m_pipeline_overlap = self.metrics.counter(
             "controller.pipeline_overlap"
@@ -554,11 +569,22 @@ class Controller:
         """Watchdog-fire observability: counter + flight-ring transition
         (the state change a postmortem needs to see)."""
         self.metrics.counter("faults.watchdog_fires").inc()
-        self.flight.record(
-            "watchdog_fire",
+        fields = dict(
             deadline_s=self.params.dispatch_deadline_seconds,
             turn=self._dispatch_rec.last_turn,
         )
+        if self.trace is not None:
+            # Tail retention (ISSUE 15): a watchdog fire makes this
+            # request's trace an error trace — retained at end even when
+            # head sampling dropped it, with the fire in the
+            # always-retained event ring and the short id on the flight
+            # row for the postmortem join.
+            fields["trace"] = self.trace.short_id
+            self.trace.add_event(
+                "gol.watchdog.fire", turn=self._dispatch_rec.last_turn
+            )
+            self.trace.flag("watchdog_fire")
+        self.flight.record("watchdog_fire", **fields)
 
     def _dispatch(self, step, board, turn: int):
         """Run one device dispatch under the watchdog, with the retry
@@ -1068,6 +1094,7 @@ class Controller:
                 metrics=metrics,
                 run_id=self.run_id,
                 tenant=self.params.tenant,
+                trace_id=self.trace.trace_id if self.trace else None,
             )
         except Exception:  # noqa: BLE001 — the abort must still propagate
             pass
@@ -1298,12 +1325,21 @@ class Controller:
         the starting viewport)."""
         return self.params.factors_for(rect[2], rect[3])
 
+    def _mark_first_frame(self) -> None:
+        """Time-to-first-frame SLI (ISSUE 15): observed once per traced
+        request, at the first frame emitted to the viewer stream."""
+        if self.trace is not None:
+            first = self.trace.mark("first_frame")
+            if first is not None:
+                self._h_ttff.observe(first)
+
     def _emit_frame(self, turn: int, frame, factors, rect):
         """Emit one rendered frame: a FrameReady keyframe when the delta
         protocol is off, not yet anchored, or just re-anchored (first
         frame, pan/zoom, shape change); else the changed-band FrameDelta
         against the last delivered frame (``engine/frames.py`` — the ONE
         wire-format home shared with the FramePlane fan-out)."""
+        self._mark_first_frame()
         if not self._deltas_on:
             self._emit(FrameReady(turn, frame, factors, rect=rect))
             return
@@ -1736,6 +1772,7 @@ class Controller:
                     processes=len(snaps),
                     run_id=self.run_id,
                     tenant=self.params.tenant,
+                    trace_id=self.trace.trace_id if self.trace else "",
                 )
             )
         if self._outcome == "completed":

@@ -294,12 +294,18 @@ class MetricsReport(Event):
     with the run's flight dumps and checkpoint sidecars, so a scrape
     series, a postmortem, and a resumed session can be joined offline.
     Stable across supervisor restarts of one logical run; excluded from
-    equality like the snapshot."""
+    equality like the snapshot.
+
+    ``trace_id`` (ISSUE 15): the request trace this run served, when it
+    was submitted through the traced serving path — joins the report to
+    the ``/traces`` timeline and the gateway receipt.  Empty for
+    untraced runs."""
 
     snapshot: dict = field(default_factory=dict, compare=False)
     processes: int = 1
     run_id: str = field(default="", compare=False)
     tenant: str | None = field(default=None, compare=False)
+    trace_id: str = field(default="", compare=False)
 
 
 class _TurnRange:

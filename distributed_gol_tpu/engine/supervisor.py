@@ -51,6 +51,7 @@ from distributed_gol_tpu.engine.session import Session, default_session
 from distributed_gol_tpu.obs import flight as flight_lib
 from distributed_gol_tpu.obs import metrics as metrics_lib
 from distributed_gol_tpu.obs import spans
+from distributed_gol_tpu.obs import tracing
 from distributed_gol_tpu.parallel import mesh as mesh_lib
 
 
@@ -487,6 +488,22 @@ class Supervisor:
                 )
                 self.history.append({**record, "t": t_detect})
                 self._restart_times.append(now)
+                # Request trace (ISSUE 15): a restart makes this an error
+                # trace — tail-retained with the restart in the
+                # always-kept event ring, and the restart flight record
+                # carries the short id for the postmortem join.  The
+                # trace rides the worker context the plane activated, so
+                # no plumbing.
+                req_trace = tracing.current()
+                if req_trace is not None:
+                    record["trace"] = req_trace.short_id
+                    req_trace.add_event(
+                        "gol.supervisor.restart",
+                        attempt=attempt,
+                        cause=record["cause"],
+                        resume_turn=ckpt.turn,
+                    )
+                    req_trace.flag("restart")
                 # t= overrides the ring's own stamp with the DETECTION
                 # time (see above).
                 self.flight.record("restart", t=t_detect, **record)

@@ -27,6 +27,17 @@ Plus the continuous half (ISSUE 12), built on the registry:
   multi-window burn-rate alerts, and error budgets evaluated over the
   sampler ring.
 
+And the request-scoped half (ISSUE 15):
+
+- :mod:`~distributed_gol_tpu.obs.tracing` — the always-on, bounded,
+  lock-cheap host span store: W3C ``traceparent`` in at the gateway,
+  ``X-Gol-Trace-Id`` out on every traced response, spans from the
+  admission ladder to the kernel launch (the ``obs.spans`` call sites
+  feed both sinks), head-sampled with tail retention for error traces,
+  exported via ``/traces`` and ``tools/trace_export.py`` (Chrome Trace
+  Event JSON) — plus the per-request SLI histograms (queue wait,
+  time-to-first-dispatch/-frame) the SLO machinery targets.
+
 Everything degrades to a no-op: ``Params.metrics=False`` swaps in null
 instruments, ``Params.flight_recorder_depth=0`` disables the ring, and
 spans become ``nullcontext`` on profiler-less builds — exactly like

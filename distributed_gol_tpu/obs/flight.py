@@ -79,12 +79,15 @@ class FlightRecorder:
         metrics: dict | None = None,
         run_id: str | None = None,
         tenant: str | None = None,
+        trace_id: str | None = None,
     ) -> Path | None:
         """Write the postmortem ``flight-<ts>.json`` into ``directory``
         (created if needed).  Appends the terminal ``abort`` record first
         so the tail always explains the abort.  ``run_id``/``tenant``
         (ISSUE 12) stamp the correlation id shared with the run's
-        MetricsReport and checkpoint sidecars.  Best-effort by contract:
+        MetricsReport and checkpoint sidecars; ``trace_id`` (ISSUE 15)
+        joins the dump to the request's ``/traces`` timeline.
+        Best-effort by contract:
         a failing dump (ENOSPC, perms) returns None — the postmortem
         artifact must never mask the abort it is documenting."""
         if not self.depth:
@@ -102,6 +105,8 @@ class FlightRecorder:
             doc["run_id"] = run_id
         if tenant is not None:
             doc["tenant"] = tenant
+        if trace_id:
+            doc["trace_id"] = trace_id
         if metrics is not None:
             doc["metrics"] = metrics
         try:

@@ -383,11 +383,18 @@ class DispatchRecorder:
         emit_timing: bool = False,
         qsize: Callable[[], int] | None = None,
         tenant: str | None = None,
+        trace=None,
     ):
         self._flight = flight
         self._emit = emit
         self._emit_timing = emit_timing
         self._qsize = qsize
+        # Request trace (ISSUE 15): when the run serves a traced request
+        # the recorder stamps the trace's short id on flight dispatch
+        # records and observes the time-to-first-dispatch SLI off the
+        # trace's first-occurrence mark.  None (the untraced default)
+        # keeps the hot path at one attribute compare.
+        self._trace = trace
         # ``tenant`` labels every instrument (ISSUE 6 satellite): N
         # sessions multiplexed onto one process-wide registry stay
         # separable in a single snapshot — and the labels ride the run's
@@ -412,6 +419,13 @@ class DispatchRecorder:
         self._c_failures = registry.counter(
             labelled("controller.dispatch_failures", tenant)
         )
+        # Time-to-first-dispatch SLI (ISSUE 15): request start (trace
+        # t0) → first RESOLVED dispatch, per tenant — the "how long until
+        # this request computed anything" histogram the SLO machinery
+        # was missing.  Observed once per request, only for traced runs.
+        self._h_ttfd = registry.histogram(
+            labelled("sli.time_to_first_dispatch_seconds", tenant)
+        )
         self.last_turn = 0  # the abort path's best known turn
 
     def record(self, turn: int, k: int, seconds: float) -> None:
@@ -424,7 +438,24 @@ class DispatchRecorder:
         self._g_superstep.set(k)
         if self._qsize is not None:
             self._g_qdepth.set(self._qsize())
-        self._flight.record("dispatch", turn=turn, k=k, s=round(seconds, 6))
+        if self._trace is None:
+            self._flight.record(
+                "dispatch", turn=turn, k=k, s=round(seconds, 6)
+            )
+        else:
+            # The flight↔trace correlation (ISSUE 15): dispatch records
+            # carry the trace's short id, so `flight_report` joins a
+            # postmortem ring to the request timeline.
+            self._flight.record(
+                "dispatch",
+                turn=turn,
+                k=k,
+                s=round(seconds, 6),
+                trace=self._trace.short_id,
+            )
+            first = self._trace.mark("first_dispatch")
+            if first is not None:
+                self._h_ttfd.observe(first)
         self.last_turn = turn
         if self._emit_timing:
             self._emit(TurnTiming(turn, k, seconds))

@@ -64,9 +64,19 @@ class SLOObjectives:
     slow_window_seconds: float = 300.0
     burn_threshold: float = 2.0
     budget_window_seconds: float = 3600.0
+    # Queue-wait objective (ISSUE 15; 0 = off): "latency_percentile of
+    # admissions start within queue_wait_seconds", judged from the
+    # per-tenant ``sli.queue_wait_seconds{tenant=}`` histogram the
+    # request-tracing plane observes at session start — the admission
+    # half of request latency the dispatch objective cannot see.
+    queue_wait_seconds: float = 0.0
 
     def __post_init__(self):
-        if self.latency_seconds < 0 or self.error_rate < 0:
+        if (
+            self.latency_seconds < 0
+            or self.error_rate < 0
+            or self.queue_wait_seconds < 0
+        ):
             raise ValueError("SLO thresholds must be >= 0 (0 disables)")
         if not 0 < self.latency_percentile < 1:
             raise ValueError("latency_percentile must be in (0, 1)")
@@ -81,7 +91,11 @@ class SLOObjectives:
 
     @property
     def enabled(self) -> bool:
-        return self.latency_seconds > 0 or self.error_rate > 0
+        return (
+            self.latency_seconds > 0
+            or self.error_rate > 0
+            or self.queue_wait_seconds > 0
+        )
 
 
 def _tenants_of(snapshot: dict) -> set[str]:
@@ -89,6 +103,12 @@ def _tenants_of(snapshot: dict) -> set[str]:
     for name in snapshot.get("counters", {}):
         t = metrics_lib.tenant_of(name)
         if t is not None and name.startswith("controller."):
+            out.add(t)
+    # A queued tenant has SLI observations before its first dispatch
+    # counter exists — the queue-wait objective must see it (ISSUE 15).
+    for name in snapshot.get("histograms", {}):
+        t = metrics_lib.tenant_of(name)
+        if t is not None and name.startswith(("controller.", "sli.")):
             out.add(t)
     return out
 
@@ -108,17 +128,36 @@ class SLOTracker:
         self._summary: dict[str, dict] = {}
 
     # -- the window math -------------------------------------------------------
-    def _latency_bad_fraction(
-        self, sampler: TelemetrySampler, tenant: str, seconds: float
+    def _hist_bad_fraction(
+        self,
+        sampler: TelemetrySampler,
+        metric: str,
+        tenant: str,
+        window_seconds: float,
+        threshold: float,
     ) -> float | None:
-        w = sampler.window(seconds)
+        """Fraction of ``metric``'s window observations above
+        ``threshold`` (bucket-rounded-down, conservative) — shared by
+        the dispatch-latency and queue-wait objectives."""
+        w = sampler.window(window_seconds)
         if w is None:
             return None
         old, new = w
-        name = metrics_lib.labelled("controller.dispatch_seconds", tenant)
+        name = metrics_lib.labelled(metric, tenant)
         return fraction_above(
             new.snapshot.get("histograms", {}).get(name),
             old.snapshot.get("histograms", {}).get(name),
+            threshold,
+        )
+
+    def _latency_bad_fraction(
+        self, sampler: TelemetrySampler, tenant: str, seconds: float
+    ) -> float | None:
+        return self._hist_bad_fraction(
+            sampler,
+            "controller.dispatch_seconds",
+            tenant,
+            seconds,
             self.objectives.latency_seconds,
         )
 
@@ -179,6 +218,23 @@ class SLOTracker:
                         sampler, tenant, obj.budget_window_seconds
                     ),
                 )
+            if obj.queue_wait_seconds > 0:
+                allowed = 1.0 - obj.latency_percentile
+                qwait = lambda window: self._hist_bad_fraction(  # noqa: E731
+                    sampler,
+                    "sli.queue_wait_seconds",
+                    tenant,
+                    window,
+                    obj.queue_wait_seconds,
+                )
+                row["queue_wait"] = self._objective_row(
+                    tenant,
+                    "queue_wait",
+                    allowed,
+                    fast=qwait(obj.fast_window_seconds),
+                    slow=qwait(obj.slow_window_seconds),
+                    budget=qwait(obj.budget_window_seconds),
+                )
             if obj.error_rate > 0:
                 fast = self._error_fraction(
                     sampler, tenant, obj.fast_window_seconds
@@ -204,7 +260,11 @@ class SLOTracker:
             # while the latency budget is burnt).
             budgets = [
                 o["budget_remaining"]
-                for o in (row.get("latency"), row.get("errors"))
+                for o in (
+                    row.get("latency"),
+                    row.get("errors"),
+                    row.get("queue_wait"),
+                )
                 if o is not None and o.get("budget_remaining") is not None
             ]
             if budgets:
@@ -295,6 +355,7 @@ class SLOTracker:
                 "slow_window_seconds": obj.slow_window_seconds,
                 "burn_threshold": obj.burn_threshold,
                 "budget_window_seconds": obj.budget_window_seconds,
+                "queue_wait_seconds": obj.queue_wait_seconds,
             },
             "alerting": sorted(
                 f"{t}:{o}" for t, o in self._alerting

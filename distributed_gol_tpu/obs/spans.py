@@ -15,14 +15,22 @@ Naming convention (documented in docs/API.md "Observability"):
 resilience layer's ``gol.supervisor.restore``, ``gol.sdc.check``,
 ``gol.preempt.checkpoint`` (ISSUE 5).
 
-Degrades exactly like ``utils.profiling.trace``: on a stripped jax build
-(no profiler backend) every helper returns ``contextlib.nullcontext`` —
-resolved once, cached, zero per-call import cost afterwards.
+Since ISSUE 15 the same call sites feed TWO sinks: the ``jax.profiler``
+annotation (a ``--trace`` Perfetto capture, unchanged) AND the
+request-scoped host-side span store (``obs.tracing``) whenever a trace
+is active on the calling context — so "why was this request slow" and
+"what did the device do" are answered from one instrumentation point.
+
+Degrades exactly like ``utils.profiling.trace`` — the profiler class is
+resolved ONCE through the shared ``utils.profiling.profiler()`` seam
+(ISSUE 15 satellite: one tested profiler-less path): on a stripped jax
+build the device half is skipped; with no active trace the host half is
+skipped; with neither, every helper returns ``contextlib.nullcontext``.
 """
 
 from __future__ import annotations
 
-import contextlib
+from distributed_gol_tpu.obs import tracing
 
 _UNRESOLVED = object()
 _TRACE_CLS = _UNRESOLVED  # jax.profiler.TraceAnnotation, or None
@@ -32,28 +40,69 @@ _STEP_CLS = _UNRESOLVED  # jax.profiler.StepTraceAnnotation, or None
 def _resolve():
     global _TRACE_CLS, _STEP_CLS
     if _TRACE_CLS is _UNRESOLVED:
-        try:
-            import jax
+        from distributed_gol_tpu.utils import profiling
 
-            _TRACE_CLS = jax.profiler.TraceAnnotation
-            _STEP_CLS = getattr(jax.profiler, "StepTraceAnnotation", None)
-        except Exception:  # stripped build: spans are no-ops, like trace()
-            _TRACE_CLS = None
-            _STEP_CLS = None
+        mod = profiling.profiler()  # the ONE resolution seam
+        _TRACE_CLS = getattr(mod, "TraceAnnotation", None)
+        _STEP_CLS = getattr(mod, "StepTraceAnnotation", None)
     return _TRACE_CLS, _STEP_CLS
+
+
+def _reset() -> None:
+    """Testing hook: re-resolve on next use (pairs with
+    ``utils.profiling._reset_profiler_cache``)."""
+    global _TRACE_CLS, _STEP_CLS
+    _TRACE_CLS = _UNRESOLVED
+    _STEP_CLS = _UNRESOLVED
+
+
+class _Pair:
+    """Enter/exit two context managers as one (device annotation +
+    host-side trace span) without ExitStack overhead."""
+
+    __slots__ = ("_a", "_b")
+
+    def __init__(self, a, b):
+        self._a = a
+        self._b = b
+
+    def __enter__(self):
+        self._a.__enter__()
+        self._b.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            self._b.__exit__(*exc)
+        finally:
+            self._a.__exit__(*exc)
+        return False
+
+
+def _combine(dev, name, labels):
+    """Device annotation (may be None) + host span (nullcontext when no
+    trace is active on this context) → the cheapest CM that covers both."""
+    host = tracing.span(name, **labels)
+    if dev is None:
+        return host  # host may itself be the shared nullcontext
+    if host is tracing.NULL_CM:
+        return dev
+    return _Pair(dev, host)
 
 
 def span(name: str, **labels):
     """A ``TraceAnnotation`` context manager for one host-side operation;
-    ``labels`` ride as TraceMe metadata (Perfetto args).  No-op without a
-    profiler backend."""
+    ``labels`` ride as TraceMe metadata (Perfetto args) and, when a
+    request trace is active (``obs.tracing``), as host-span labels.
+    No-op without a profiler backend and without an active trace."""
     cls, _ = _resolve()
-    if cls is None:
-        return contextlib.nullcontext()
-    try:
-        return cls(name, **labels)
-    except Exception:  # an exotic label type must never take the run down
-        return contextlib.nullcontext()
+    dev = None
+    if cls is not None:
+        try:
+            dev = cls(name, **labels)
+        except Exception:  # an exotic label type must never take the run down
+            dev = None
+    return _combine(dev, name, labels)
 
 
 def step_span(name: str, step: int, **labels):
@@ -63,8 +112,9 @@ def step_span(name: str, step: int, **labels):
     StepTraceAnnotation."""
     _, cls = _resolve()
     if cls is None:
-        return span(name, **labels)
+        return span(name, step=step, **labels)
     try:
-        return cls(name, step_num=step, **labels)
+        dev = cls(name, step_num=step, **labels)
     except Exception:
-        return contextlib.nullcontext()
+        dev = None
+    return _combine(dev, name, dict(labels, step=step))

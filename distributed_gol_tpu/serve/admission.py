@@ -138,6 +138,27 @@ class ServeConfig:
     slo_slow_window_seconds: float = 300.0
     slo_burn_threshold: float = 2.0
     slo_budget_window_seconds: float = 600.0
+    # Queue-wait SLO (ISSUE 15; 0 = off): "slo_latency_percentile of
+    # admissions start within this many seconds of submit", judged from
+    # the per-tenant ``sli.queue_wait_seconds`` histogram the request-
+    # tracing plane derives — the admission-ladder half of request
+    # latency the dispatch-latency objective cannot see.
+    slo_queue_wait_seconds: float = 0.0
+    # --- request-scoped tracing (ISSUE 15; docs/API.md "Distributed
+    # tracing") ---
+    # Head-sampling rate in [0, 1]: the fraction of requests whose trace
+    # is RETAINED at end (deterministic in the trace id; an inbound
+    # traceparent with the sampled flag set always retains).  Tracing
+    # itself is always on — unsampled traces still buffer in-flight so
+    # tail retention can keep any trace that ends in a failure,
+    # watchdog fire, or supervisor restart.  1.0 (demo default) retains
+    # everything; production pods sample down.
+    trace_sample_rate: float = 1.0
+    # Finished-trace ring depth (the /traces window) and the per-trace
+    # span cap (the FIRST N spans are kept; later ones are counted in
+    # dropped_spans — a request timeline's interesting part is its head).
+    trace_ring_depth: int = 256
+    trace_max_spans: int = 512
 
     def __post_init__(self):
         if self.max_sessions < 1:
@@ -176,6 +197,16 @@ class ServeConfig:
             raise ValueError("telemetry_ring_depth must be >= 2")
         if self.telemetry_lazy_every < 1:
             raise ValueError("telemetry_lazy_every must be >= 1")
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError("trace_sample_rate must be in [0, 1]")
+        if self.trace_ring_depth < 1:
+            raise ValueError("trace_ring_depth must be >= 1")
+        if self.trace_max_spans < 16:
+            raise ValueError("trace_max_spans must be >= 16")
+        if self.slo_queue_wait_seconds < 0:
+            raise ValueError(
+                "slo_queue_wait_seconds must be >= 0 (0 disables)"
+            )
         # The SLO field set validates as a unit (ranges, window ordering)
         # and an armed objective REQUIRES the sampler: the burn windows
         # live on its ring.
@@ -212,6 +243,7 @@ class ServeConfig:
             slow_window_seconds=self.slo_slow_window_seconds,
             burn_threshold=self.slo_burn_threshold,
             budget_window_seconds=self.slo_budget_window_seconds,
+            queue_wait_seconds=self.slo_queue_wait_seconds,
         )
         return objectives if objectives.enabled else None
 

@@ -54,6 +54,7 @@ truthful per tenant, pinned by test).
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from dataclasses import fields
@@ -61,6 +62,7 @@ from dataclasses import fields
 from distributed_gol_tpu.engine.backend import Backend, BatchedBackend
 from distributed_gol_tpu.engine.params import Params
 from distributed_gol_tpu.obs import metrics as metrics_lib
+from distributed_gol_tpu.obs import tracing
 
 #: Params fields that cannot change what or when a session dispatches:
 #: identity, filesystem scoping, and the board's INITIAL CONTENT (cohort
@@ -198,6 +200,7 @@ class _Cohort:
             evicted = self._evict_stale(present)
         for m in evicted:
             self._batcher._c_evicted.inc()
+        t0_ns = tracing.clock_ns()
         try:
             outs, counts = self.backend.run_boards(
                 [b for _, b in entries], turns
@@ -209,6 +212,7 @@ class _Cohort:
             error = None
         except Exception as e:  # noqa: BLE001 — members fall back solo
             results, error = {}, e
+        self._record_launch_spans(entries, turns, t0_ns, error)
         with self._cond:
             rnd.results = results
             rnd.error = error
@@ -230,6 +234,35 @@ class _Cohort:
         if error is not None:
             return None
         return results[tenant]
+
+    def _record_launch_spans(self, entries, turns, t0_ns, error) -> None:
+        """One batched-launch span per MEMBER trace (ISSUE 15): every
+        member's request timeline shows the shared launch, stamped with
+        one ``launch`` id and cross-``links`` to the other members'
+        trace ids — how an operator attributes one tenant's latency to a
+        cohort-mate's compile or a shared device stall.  Cold-ish path
+        (once per fired round); tenants without an active trace cost one
+        dict lookup."""
+        t1_ns = tracing.clock_ns()
+        launch_id = self._batcher._next_launch_id()
+        member_traces = [
+            (t, tracing.TRACER.for_tenant(t)) for t, _ in entries
+        ]
+        member_traces = [
+            (t, tr) for t, tr in member_traces if tr is not None and not tr.ended
+        ]
+        ids = [tr.trace_id for _, tr in member_traces]
+        for tenant, tr in member_traces:
+            tr.record_span(
+                "gol.cohort.launch",
+                t0_ns,
+                t1_ns,
+                launch=launch_id,
+                boards=len(entries),
+                turns=turns,
+                error=type(error).__name__ if error is not None else None,
+                links=[i for i in ids if i != tr.trace_id],
+            )
 
     def _evict_stale(self, present: set[str]) -> list["_CohortMember"]:
         """Under the lock: the straggler/faulted-slot eviction ladder.
@@ -312,6 +345,13 @@ class CohortBatcher:
         self._c_evicted = reg.counter("serve.cohort_evictions")
         self._g_cohorts = reg.gauge("serve.cohorts")
         self._g_cohorts.set(0)
+        # Monotonic batched-launch id (ISSUE 15): stamped on the
+        # ``gol.cohort.launch`` span in every member's request trace, so
+        # the traces of one shared launch join on it.
+        self._launch_ids = itertools.count(1)
+
+    def _next_launch_id(self) -> int:
+        return next(self._launch_ids)
 
     def member_backend(self, params: Params):
         """Build the backend for one admitted session: a cohort member

@@ -41,6 +41,7 @@ import numpy as np
 from distributed_gol_tpu.engine import frames as frames_lib
 from distributed_gol_tpu.engine.events import FrameDelta, FrameReady
 from distributed_gol_tpu.obs import metrics as obs_metrics
+from distributed_gol_tpu.obs import tracing
 
 
 class FrameSubscriber:
@@ -232,34 +233,45 @@ class FramePlane:
         # One fetch: the torus-shortest bounding rect of every viewport.
         h, w = self._board_shape
         rect = self._bound_rects([r for _, r in subs], h, w)
-        superset = fetch(rect)
-        self._m_fetches.inc()
-        self._m_bytes_fetched.inc(superset.nbytes)
-        by0, bx0, bvh, bvw = rect
-        shipped = 0
-        for sub, (sy, sx, svh, svw) in subs:
-            # Subscriber offset inside the fetched superset.  Coverage
-            # guarantees oy + svh <= bvh whenever bvh < h; a full-axis
-            # superset (bvh == h) is the whole ring anchored at by0, so
-            # the index arithmetic wraps mod bvh.
-            oy = (sy - by0) % h
-            ox = (sx - bx0) % w
-            rows = (
-                slice(oy, oy + svh)
-                if oy + svh <= bvh
-                else (np.arange(svh) + oy) % bvh
-            )
-            cols = (
-                slice(ox, ox + svw)
-                if ox + svw <= bvw
-                else (np.arange(svw) + ox) % bvw
-            )
-            view = superset[rows][:, cols]
-            shipped += sub._ship(
-                turn, np.ascontiguousarray(view), (sy, sx, svh, svw)
-            )
-            self._m_frames.inc()
-        self._m_bytes_shipped.inc(shipped)
+        # The publish span (ISSUE 15): rides the producer's request
+        # trace when one is active on this context (the controller
+        # publishes from the run's worker) — nullcontext otherwise.
+        # Covers the WHOLE publish (coalesced fetch AND the
+        # per-subscriber slice/ship fan-out), so a many-spectator
+        # tenant's frame latency is attributable to this span, not
+        # unaccounted host time after it.
+        with tracing.span(
+            "gol.frame.publish", turn=turn, subscribers=len(subs)
+        ):
+            superset = fetch(rect)
+            self._m_fetches.inc()
+            self._m_bytes_fetched.inc(superset.nbytes)
+            by0, bx0, bvh, bvw = rect
+            shipped = 0
+            for sub, (sy, sx, svh, svw) in subs:
+                # Subscriber offset inside the fetched superset.
+                # Coverage guarantees oy + svh <= bvh whenever bvh < h;
+                # a full-axis superset (bvh == h) is the whole ring
+                # anchored at by0, so the index arithmetic wraps mod
+                # bvh.
+                oy = (sy - by0) % h
+                ox = (sx - bx0) % w
+                rows = (
+                    slice(oy, oy + svh)
+                    if oy + svh <= bvh
+                    else (np.arange(svh) + oy) % bvh
+                )
+                cols = (
+                    slice(ox, ox + svw)
+                    if ox + svw <= bvw
+                    else (np.arange(svw) + ox) % bvw
+                )
+                view = superset[rows][:, cols]
+                shipped += sub._ship(
+                    turn, np.ascontiguousarray(view), (sy, sx, svh, svw)
+                )
+                self._m_frames.inc()
+            self._m_bytes_shipped.inc(shipped)
         return {
             "subscribers": len(subs),
             "fetched_bytes": int(superset.nbytes),

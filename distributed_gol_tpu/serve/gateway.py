@@ -71,6 +71,7 @@ from distributed_gol_tpu.engine.events import (
     TurnComplete,
     TurnsCompleted,
 )
+from distributed_gol_tpu.obs import tracing
 from distributed_gol_tpu.serve import wire
 from distributed_gol_tpu.serve.admission import AdmissionRejected
 from distributed_gol_tpu.serve.httpd import StdlibHTTPServer, read_body
@@ -106,6 +107,10 @@ class _WireSession:
                 metrics=params.metrics,
             )
         self.handle = None  # set right after plane.submit
+        #: The request trace (ISSUE 15): created from the submission's
+        #: inbound ``traceparent`` (or minted) — its id rides every
+        #: response for this tenant as ``X-Gol-Trace-Id``.
+        self.trace = None
         self.lock = threading.Lock()
         self.seq = 0
         self.ring: deque = deque(maxlen=RING_DEPTH)
@@ -333,12 +338,15 @@ class GatewayServer(StdlibHTTPServer):
         params,
         deadline_seconds: float | None = None,
         spectate: bool = False,
+        trace=None,
     ):
         """Submit one session THROUGH the gateway's books (key queue,
         event pump, optional FramePlane) so it is wire-controllable —
         the path the serve CLI's scripted/re-adopted tenants take when
         a gateway is armed.  Raises ``AdmissionRejected`` like
-        ``plane.submit``."""
+        ``plane.submit``.  ``trace`` (ISSUE 15) is the request trace the
+        wire handler created from the inbound ``traceparent``; None
+        mints one in the plane."""
         session = _WireSession(tenant, params, spectate)
         handle = self.plane.submit(
             tenant,
@@ -347,8 +355,10 @@ class GatewayServer(StdlibHTTPServer):
             deadline_seconds=deadline_seconds,
             keys=session.keys,
             frame_plane=session.frame_plane,
+            trace=trace,
         )
         session.handle = handle
+        session.trace = handle.trace
         with self._lock:
             self._sessions[tenant] = session
             self._prune_sessions()
@@ -370,10 +380,26 @@ class GatewayServer(StdlibHTTPServer):
                 del self._sessions[tenant]
 
     # -- routing ---------------------------------------------------------------
+    def _trace_headers(self, session) -> list:
+        """``X-Gol-Trace-Id`` for every response that resolves to a
+        traced session (ISSUE 15) — how a client correlates any
+        state/control answer with the request timeline on ``/traces``."""
+        trace = session.trace if session is not None else None
+        if trace is None:
+            return []
+        return [("X-Gol-Trace-Id", trace.trace_id)]
+
     def handle(self, request, method: str, path: str, query: dict) -> bool:
         if path == "/healthz" and method == "GET":
             health = self.plane.health()
             request._send_json(200 if health.get("ready") else 503, health)
+            return True
+        if path == "/traces" and method == "GET":
+            # The request-timeline surface, served from the gateway too
+            # (one base URL drives tools/gol_client.py --trace); the
+            # telemetry server carries the same route.
+            code, obj = tracing.http_traces(query)
+            request._send_json(code, obj)
             return True
         if path == "/v1/sessions":
             if method == "GET":
@@ -403,7 +429,11 @@ class GatewayServer(StdlibHTTPServer):
             request._send_json(404, {"error": f"no session {tenant!r}"})
             return True
         if method == "GET" and action in (None, "state"):
-            request._send_json(200, self._summary(tenant, session, handle))
+            request._send_json(
+                200,
+                self._summary(tenant, session, handle),
+                headers=self._trace_headers(session),
+            )
             return True
         if method == "GET" and action == "events":
             return self._controller_ws(request, tenant, session, query)
@@ -467,12 +497,31 @@ class GatewayServer(StdlibHTTPServer):
                 {"error": "tenant must match [A-Za-z0-9][A-Za-z0-9._-]*"},
             )
             return True
+        # Request-scoped tracing (ISSUE 15): accept the inbound W3C
+        # ``traceparent`` (a malformed one starts a fresh trace; an
+        # inbound sampled flag forces retention) — the wire-handling
+        # span below is the timeline's first entry, BEFORE admission.
+        req_ns = tracing.clock_ns()
+        req_trace = tracing.TRACER.start_trace(
+            "gol.request",
+            traceparent=request.headers.get("traceparent"),
+            tenant=tenant,
+        )
+        trace_headers = [
+            ("X-Gol-Trace-Id", req_trace.trace_id),
+            ("traceparent", req_trace.traceparent()),
+        ]
         try:
             params, options = wire.params_from_spec(
                 tenant, doc, root=self._upload_root
             )
         except wire.SpecError as e:
-            request._send_json(400, {"error": str(e)})
+            tracing.TRACER.end_trace(
+                req_trace, status="rejected", error=str(e)
+            )
+            request._send_json(
+                400, {"error": str(e)}, headers=trace_headers
+            )
             return True
         try:
             handle = self.local_submit(
@@ -480,20 +529,34 @@ class GatewayServer(StdlibHTTPServer):
                 params,
                 deadline_seconds=options.get("deadline_seconds"),
                 spectate=options["spectate"],
+                trace=req_trace,
             )
         except AdmissionRejected as e:
             # The admission ladder on the wire: transient rejections are
             # 429 + Retry-After (the shed hint), permanent ones 409.
+            # The plane already ended the trace ``rejected``; the id
+            # still rides the answer so a shed caller can fetch it.
             self._m_rejected.inc()
             if e.retry_after is not None:
                 request._send_json(
                     429,
                     {"error": e.reason, "retry_after": e.retry_after},
-                    headers=[("Retry-After", f"{e.retry_after:g}")],
+                    headers=[("Retry-After", f"{e.retry_after:g}")]
+                    + trace_headers,
                 )
             else:
-                request._send_json(409, {"error": e.reason})
+                request._send_json(
+                    409, {"error": e.reason}, headers=trace_headers
+                )
             return True
+        req_trace.record_span(
+            "gol.request.handle",
+            req_ns,
+            tracing.clock_ns(),
+            method="POST",
+            path="/v1/sessions",
+            tenant=tenant,
+        )
         request._send_json(
             201,
             {
@@ -501,12 +564,18 @@ class GatewayServer(StdlibHTTPServer):
                 "status": handle.status,
                 "admitted_as": handle.admitted_as,
                 "spectate": options["spectate"],
+                # The correlation stamp (ISSUE 15): fetch the timeline
+                # at GET /traces?trace_id=<this> once the run moves.
+                "trace_id": req_trace.trace_id,
+                "traceparent": req_trace.traceparent(),
                 "links": {
                     "state": f"/v1/sessions/{tenant}/state",
                     "events": f"/v1/sessions/{tenant}/events",
                     "frames": f"/v1/sessions/{tenant}/frames",
+                    "trace": f"/traces?trace_id={req_trace.trace_id}",
                 },
             },
+            headers=trace_headers,
         )
         return True
 
@@ -523,11 +592,15 @@ class GatewayServer(StdlibHTTPServer):
         ok = getattr(session, action)()
         if not ok:
             request._send_json(
-                409, {"error": f"session {tenant!r} already ended"}
+                409,
+                {"error": f"session {tenant!r} already ended"},
+                headers=self._trace_headers(session),
             )
             return True
         request._send_json(
-            200, {"tenant": tenant, "action": action, "ok": True}
+            200,
+            {"tenant": tenant, "action": action, "ok": True},
+            headers=self._trace_headers(session),
         )
         return True
 
@@ -649,6 +722,7 @@ class GatewayServer(StdlibHTTPServer):
                 )
             )
             self._start_reader(ws, session, dead, spectator=sub)
+            first_send = True
             while not dead.is_set() and not self._closing:
                 try:
                     ev = sub.events.get(timeout=0.25)
@@ -659,6 +733,18 @@ class GatewayServer(StdlibHTTPServer):
                     continue
                 blob = wire.encode_frame_event(ev)
                 ws.send_binary(blob)
+                if first_send:
+                    # The last hop of the request timeline (ISSUE 15):
+                    # FramePlane publish → this spectator's first wire
+                    # frame.  Once per connection, into the session's
+                    # always-retained event ring.
+                    first_send = False
+                    if session.trace is not None:
+                        session.trace.add_event(
+                            "gol.spectator.first_send",
+                            turn=ev.completed_turns,
+                            bytes=len(blob),
+                        )
                 self._m_frames.inc()
                 self._m_bytes.inc(len(blob))
         except (WsClosed, OSError):

@@ -73,6 +73,7 @@ from distributed_gol_tpu.engine.session import Session
 from distributed_gol_tpu.engine.supervisor import GracefulStop
 from distributed_gol_tpu.obs import flight as flight_lib
 from distributed_gol_tpu.obs import metrics as metrics_lib
+from distributed_gol_tpu.obs import tracing
 from distributed_gol_tpu.obs.slo import SLOTracker
 from distributed_gol_tpu.obs.timeseries import TelemetrySampler
 from distributed_gol_tpu.parallel import mesh as mesh_lib
@@ -129,6 +130,13 @@ class SessionHandle:
         #: publishes every rendered turn to (the spectator leg).
         self.keys: queue.Queue | None = None
         self.frame_plane = None
+        #: The request trace (ISSUE 15): created (or accepted from the
+        #: gateway's ``traceparent`` handling) at submit, activated on
+        #: the worker context for the whole run, ended at terminal
+        #: classification.  Always present on plane-submitted sessions.
+        self.trace = None
+        self._submit_ns = 0  # tracing clock at submit (queue-wait span)
+        self._h_qwait = None  # the tenant's queue-wait SLI histogram
         self.stop = GracefulStop()
         self.status = "queued"
         #: The admission verdict at submit time ("run" = a slot was
@@ -330,6 +338,16 @@ class ServePlane:
         # ``plane.flight.records()`` — distinct from the per-session
         # rings each controller dumps on ITS terminal path.
         self.flight = flight_lib.FlightRecorder(256 if metrics else 0)
+        # Request-scoped tracing (ISSUE 15): the plane applies its
+        # config's knobs to the process-wide store — sampling rate,
+        # /traces ring depth, per-trace span cap.  (One store per
+        # process; the last-constructed plane's config wins, like the
+        # registry's process-wide instruments.)
+        tracing.TRACER.configure(
+            sample_rate=self.config.trace_sample_rate,
+            ring_depth=self.config.trace_ring_depth,
+            max_spans=self.config.trace_max_spans,
+        )
         self.slo: SLOTracker | None = None
         objectives = self.config.slo_objectives()
         if metrics and objectives is not None:
@@ -372,6 +390,7 @@ class ServePlane:
         backend_factory: Optional[Callable] = None,
         keys: queue.Queue | None = None,
         frame_plane=None,
+        trace=None,
     ) -> SessionHandle:
         """Admit one session or shed it (:class:`AdmissionRejected`).
 
@@ -388,7 +407,16 @@ class ServePlane:
         exactly as the CLI viewer's listener; ``frame_plane`` attaches
         a spectator fan-out hub the run publishes every rendered turn
         to (frame-mode sessions only — see ``serve/frames.py``).  Both
-        are how the network gateway drives a resident session."""
+        are how the network gateway drives a resident session.
+
+        ``trace`` (ISSUE 15) is the request's ``obs.tracing.Trace`` —
+        the gateway creates it from the inbound ``traceparent`` so the
+        wire-handling span precedes admission; direct submitters get one
+        minted here.  The plane OWNS its end: the admission verdict is a
+        span, queue wait is a span + the ``sli.queue_wait_seconds``
+        SLI, the whole run is activated under it, and terminal
+        classification ends it (failure/watchdog/restart traces are
+        tail-retained regardless of head sampling)."""
         overrides: dict = {"tenant": tenant}
         if deadline_seconds is not None:
             # An explicit per-request deadline always wins.
@@ -409,9 +437,13 @@ class ServePlane:
             )
         params = replace(params, **overrides)
         cells = params.image_width * params.image_height
+        if trace is None:
+            trace = tracing.TRACER.start_trace("gol.request", tenant=tenant)
+        admit_ns = tracing.clock_ns()
         with self._lock:
             if self._closed:
                 self._c_rejected.inc()
+                self._reject_trace(trace, admit_ns, "pod is closed")
                 raise AdmissionRejected("pod is closed")
             # Degraded-mode sync (ISSUE 7): a resident supervisor that
             # condemned devices onto the process-wide blacklist shrank
@@ -421,8 +453,9 @@ class ServePlane:
             self._admission.capacity_factor = mesh_lib.capacity_fraction()
             try:
                 verdict = self._admission.admit(tenant, cells)
-            except AdmissionRejected:
+            except AdmissionRejected as e:
                 self._c_rejected.inc()
+                self._reject_trace(trace, admit_ns, e.reason)
                 raise
             session = Session(self._root / tenant) if self._root else Session()
             handle = SessionHandle(
@@ -458,12 +491,34 @@ class ServePlane:
                     lambda p, attempt: self.batcher.member_backend(p)
                 )
             handle.admitted_as = verdict
+            handle.trace = trace
+            handle._submit_ns = admit_ns
+            handle._h_qwait = self.metrics.histogram(
+                metrics_lib.labelled("sli.queue_wait_seconds", tenant)
+            )
+            trace.record_span(
+                "gol.admission", admit_ns, tracing.clock_ns(),
+                verdict=verdict, cells=cells,
+            )
+            tracing.TRACER.bind_tenant(tenant, trace)
             self._handles[tenant] = handle
             self._c_admitted.inc()
             self._sync_gauges()
         if verdict == ADMIT_RUN:
             self._launch(handle)
         return handle
+
+    @staticmethod
+    def _reject_trace(trace, admit_ns: int, reason: str) -> None:
+        """A shed submission still yields a complete (tiny) trace: the
+        admission span carries the rejection, the trace ends
+        ``rejected`` — head sampling decides retention (a shed request
+        is a normal outcome, not an error)."""
+        trace.record_span(
+            "gol.admission", admit_ns, tracing.clock_ns(),
+            verdict="rejected", reason=reason,
+        )
+        tracing.TRACER.end_trace(trace, status="rejected", error=reason)
 
     # -- scheduling ------------------------------------------------------------
     def _launch(self, handle: SessionHandle) -> None:
@@ -490,18 +545,42 @@ class ServePlane:
         a tenant's failure must never propagate into the plane."""
         handle.status = "running"
         handle.t_start = time.perf_counter()
+        trace = handle.trace
+        if trace is not None:
+            # The queue-wait SLI (ISSUE 15): submit → this worker
+            # picking the session up, observed for EVERY admission —
+            # run-now sessions contribute their (near-zero) wait so the
+            # queue-wait SLO's bad fraction is over all admissions, not
+            # just the queued tail.  The timeline span is recorded only
+            # when the session actually queued (a µs-wide span on every
+            # run-now request would be noise).
+            now_ns = tracing.clock_ns()
+            if handle._h_qwait is not None:
+                handle._h_qwait.observe(
+                    (now_ns - handle._submit_ns) / 1e9
+                )
+            if handle.admitted_as != ADMIT_RUN:
+                trace.record_span(
+                    "gol.queue.wait", handle._submit_ns, now_ns
+                )
         exc: BaseException | None = None
         try:
-            gol.run(
-                handle.params,
-                handle.events,
-                key_presses=handle.keys,
-                session=handle.session,
-                backend=handle._backend,
-                backend_factory=handle._backend_factory,
-                stop=handle.stop,
-                frame_plane=handle.frame_plane,
-            )
+            # Activate the request trace on THIS worker context: the
+            # controller, supervisor, and every obs.spans call site
+            # attach to it with no parameter threading.
+            with tracing.activate(trace), tracing.span(
+                "gol.session.run", tenant=handle.tenant
+            ):
+                gol.run(
+                    handle.params,
+                    handle.events,
+                    key_presses=handle.keys,
+                    session=handle.session,
+                    backend=handle._backend,
+                    backend_factory=handle._backend_factory,
+                    stop=handle.stop,
+                    frame_plane=handle.frame_plane,
+                )
         except BaseException as e:  # noqa: BLE001 — isolation boundary
             exc = e
         finally:
@@ -532,6 +611,16 @@ class ServePlane:
             handle._finish(
                 "parked" if handle.session.paused else "failed",
                 error=f"{type(exc).__name__}: {exc}",
+            )
+        if handle.trace is not None:
+            # Tail retention (ISSUE 15): a request that ended in a
+            # failure (terminal park or raw failure) keeps its trace
+            # even when head sampling dropped it — error traces are
+            # never lost.  Clean terminals keep the head decision.
+            if handle.status in ("failed", "parked"):
+                handle.trace.flag(handle.status)
+            tracing.TRACER.end_trace(
+                handle.trace, status=handle.status, error=handle.error
             )
 
     def _on_done(self, handle: SessionHandle) -> None:
@@ -578,6 +667,9 @@ class ServePlane:
             self._state.notify_all()
         for t in evicted:
             self.metrics.clear_tenant(t)
+            # The tracer's tenant binding rides the same eviction ring
+            # (ISSUE 15): a churning-tenant pod stays bounded-memory.
+            tracing.TRACER.unbind_tenant(t)
         if promoted is not None:
             self._launch(promoted)
 

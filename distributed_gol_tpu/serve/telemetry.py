@@ -37,6 +37,7 @@ from typing import Callable
 
 from distributed_gol_tpu.obs import metrics as metrics_lib
 from distributed_gol_tpu.obs import openmetrics
+from distributed_gol_tpu.obs import tracing
 from distributed_gol_tpu.serve.httpd import StdlibHTTPServer
 
 
@@ -85,6 +86,13 @@ class TelemetryServer(StdlibHTTPServer):
             request._send_json(code, health)
         elif path == "/slo" and self._slo_fn is not None:
             request._send_json(200, self._slo_fn())
+        elif path == "/traces":
+            # Request-scoped tracing (ISSUE 15): recent retained traces
+            # (``?tenant=``, ``?limit=``) or one by ``?trace_id=`` —
+            # pure in-memory ring reads, the same bounded-time contract
+            # as every other endpoint here.
+            code, obj = tracing.http_traces(query)
+            request._send_json(code, obj)
         else:
             return False
         return True

@@ -29,7 +29,7 @@ def test_bench_pilot_record_shape(tmp_path):
         [sys.executable, str(REPO / "bench.py"), "--pilot"],
         capture_output=True,
         text=True,
-        timeout=240,
+        timeout=300,  # the pilot grew the telemetry + tracing A/B arms
         cwd=REPO,
         env=env,
     )
@@ -64,6 +64,16 @@ def test_bench_pilot_record_shape(tmp_path):
         f"sampler overhead {arm['overhead_rel']:.1%} exceeds the "
         f"measured rep envelope {arm['tolerance']:.1%} "
         f"(on {arm['rates']}, off {arm['sampler_off']['rates']})"
+    )
+    # Tracing-overhead arm (ISSUE 15): request trace on vs off,
+    # interleaved, within the rep spread — the tier-1 proof of the
+    # always-on tracing acceptance bar.
+    arm = record["tracing_overhead"]
+    assert arm["tracing_off"]["median"] > 0 and arm["median"] > 0
+    assert arm["within_rep_spread"] is True, (
+        f"tracing overhead {arm['overhead_rel']:.1%} exceeds the "
+        f"measured rep envelope {arm['tolerance']:.1%} "
+        f"(on {arm['rates']}, off {arm['tracing_off']['rates']})"
     )
     # The record survives the bench gate against itself (zero drift),
     # end to end through the CLI.

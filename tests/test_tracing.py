@@ -1,0 +1,677 @@
+"""Request-scoped tracing suite (ISSUE 15).
+
+The acceptance paths, asserted hermetically on CPU:
+
+- **End-to-end wire trace**: a session submitted through the gateway
+  with a W3C ``traceparent`` yields ONE trace whose spans cover request
+  handling, admission, the session run, dispatches, and the first
+  published frame — and ``tools/trace_export.py`` renders it to valid
+  Chrome Trace Event JSON.
+- **Tail retention**: a hang-faulted tenant's trace is retained with
+  the watchdog-fire event inside it even at sample rate 0 (error traces
+  are never lost), while a clean run's trace IS head-sampled out.
+- **Cohort linking**: a batched launch records a ``gol.cohort.launch``
+  span into >= 2 member traces sharing one launch id with cross-links.
+- **Overhead**: tracing-on lands within the measured rep spread of
+  tracing-off at pilot scale (the ``utils/measure.py`` discipline, like
+  the ISSUE-4 metrics-overhead test).
+- **Docs lint**: every recorded ``gol.*`` span name appears in the
+  docs/API.md span table, both directions
+  (``tools/check_metric_docs.check_spans``).
+"""
+
+import json
+import queue
+import time
+
+import pytest
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.engine.backend import Backend
+from distributed_gol_tpu.engine.params import Params
+from distributed_gol_tpu.engine.session import Session
+from distributed_gol_tpu.obs import metrics as obs_metrics
+from distributed_gol_tpu.obs import spans, tracing
+from distributed_gol_tpu.serve import ServeConfig, ServePlane
+from distributed_gol_tpu.serve.gateway import GatewayServer
+from distributed_gol_tpu.testing.faults import (
+    Fault,
+    FaultInjectionBackend,
+    FaultPlan,
+)
+
+W = H = 16
+SUPERSTEP = 4
+TURNS = 24
+
+
+def tenant_params(out_dir, seed, turns=TURNS, **kw):
+    cfg = dict(
+        engine="roll",
+        mesh_shape=(1, 1),
+        image_width=W,
+        image_height=H,
+        superstep=SUPERSTEP,
+        turns=turns,
+        soup_density=0.25,
+        soup_seed=seed,
+        out_dir=out_dir,
+        cycle_check=0,
+        ticker_period=60.0,
+    )
+    cfg.update(kw)
+    return Params(**cfg)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    """Every test sees an empty store at the default knobs (the store is
+    process-wide, like the metrics registry)."""
+    tracing.TRACER.configure(sample_rate=1.0, ring_depth=256, max_spans=512)
+    tracing.TRACER.clear()
+    yield
+    tracing.TRACER.configure(sample_rate=1.0, ring_depth=256, max_spans=512)
+    tracing.TRACER.clear()
+
+
+# -- W3C propagation -----------------------------------------------------------
+
+
+class TestTraceparent:
+    def test_roundtrip(self):
+        tid, sid = "ab" * 16, "cd" * 8
+        header = tracing.format_traceparent(tid, sid, sampled=True)
+        assert header == f"00-{tid}-{sid}-01"
+        assert tracing.parse_traceparent(header) == (tid, sid, True)
+        assert tracing.parse_traceparent(
+            tracing.format_traceparent(tid, sid, sampled=False)
+        ) == (tid, sid, False)
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            None,
+            "",
+            "garbage",
+            "00-short-cdcdcdcdcdcdcdcd-01",
+            "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",  # all-zero trace id
+            "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # all-zero span id
+        ],
+    )
+    def test_malformed_headers_start_fresh(self, bad):
+        assert tracing.parse_traceparent(bad) is None
+        # ...and a malformed header never fails the request: start_trace
+        # just mints a new id.
+        t = tracing.TRACER.start_trace(traceparent=bad)
+        assert len(t.trace_id) == 32
+
+    def test_inbound_id_and_sampled_flag_are_adopted(self):
+        header = tracing.format_traceparent("12" * 16, "34" * 8, sampled=True)
+        tracing.TRACER.configure(sample_rate=0.0)  # head-drop everything...
+        t = tracing.TRACER.start_trace(traceparent=header)
+        assert t.trace_id == "12" * 16
+        assert t.parent_span_id == "34" * 8
+        assert t.sampled  # ...but the caller asked: retention forced
+
+    def test_head_sampling_is_deterministic(self):
+        tid = tracing.new_trace_id()
+        assert tracing.head_sampled(tid, 1.0)
+        assert not tracing.head_sampled(tid, 0.0)
+        assert tracing.head_sampled(tid, 0.5) == tracing.head_sampled(tid, 0.5)
+
+
+# -- the span store ------------------------------------------------------------
+
+
+class TestTraceStore:
+    def test_span_nesting_parent_links(self):
+        t = tracing.TRACER.start_trace(tenant="a")
+        with tracing.activate(t):
+            with tracing.span("outer"):
+                with tracing.span("inner"):
+                    pass
+        tracing.TRACER.end_trace(t, status="ok")
+        doc = tracing.TRACER.lookup(t.trace_id)
+        by_name = {s["name"]: s for s in doc["spans"]}
+        assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+        assert by_name["outer"]["parent_id"] == doc["root_span_id"]
+        # The root span is the whole-request bar, appended at end.
+        assert doc["spans"][-1]["name"] == "gol.request"
+        assert doc["spans"][-1]["dur_ns"] == doc["duration_ns"]
+
+    def test_span_cap_keeps_head_and_counts_tail(self):
+        tracing.TRACER.configure(max_spans=16)
+        t = tracing.TRACER.start_trace()
+        with tracing.activate(t):
+            for i in range(40):
+                with tracing.span("s", i=i):
+                    pass
+        tracing.TRACER.end_trace(t)
+        doc = tracing.TRACER.lookup(t.trace_id)
+        body = [s for s in doc["spans"] if s["name"] == "s"]
+        assert len(body) == 16
+        assert [s["labels"]["i"] for s in body] == list(range(16))  # the HEAD
+        assert doc["dropped_spans"] == 24
+        # Always-retained events survive the cap.
+        t2 = tracing.TRACER.start_trace()
+        t2.add_event("gol.watchdog.fire", turn=9)
+        tracing.TRACER.end_trace(t2)
+        ev = tracing.TRACER.lookup(t2.trace_id)["events"][0]
+        assert ev["name"] == "gol.watchdog.fire" and ev["labels"]["turn"] == 9
+
+    def test_tail_retention_and_head_drop(self):
+        tracing.TRACER.configure(sample_rate=0.0)
+        clean = tracing.TRACER.start_trace(tenant="clean")
+        tracing.TRACER.end_trace(clean, status="completed")
+        assert tracing.TRACER.lookup(clean.trace_id) is None  # head-dropped
+        bad = tracing.TRACER.start_trace(tenant="bad")
+        bad.flag("watchdog_fire")
+        tracing.TRACER.end_trace(bad, status="parked", error="boom")
+        doc = tracing.TRACER.lookup(bad.trace_id)
+        assert doc is not None and doc["flagged"] == "watchdog_fire"
+        assert doc["status"] == "parked" and doc["error"] == "boom"
+
+    def test_end_is_idempotent_and_recent_filters_by_tenant(self):
+        a = tracing.TRACER.start_trace(tenant="a")
+        b = tracing.TRACER.start_trace(tenant="b")
+        tracing.TRACER.end_trace(a)
+        tracing.TRACER.end_trace(a)  # no double-retention
+        tracing.TRACER.end_trace(b)
+        assert len(tracing.TRACER.recent()) == 2
+        only_a = tracing.TRACER.recent(tenant="a")
+        assert [d["tenant"] for d in only_a] == ["a"]
+        # Prefix lookup resolves.
+        assert tracing.TRACER.lookup(b.trace_id[:8])["trace_id"] == b.trace_id
+
+    def test_mark_fires_once(self):
+        t = tracing.TRACER.start_trace()
+        first = t.mark("first_dispatch")
+        assert first is not None and first >= 0
+        assert t.mark("first_dispatch") is None
+        tracing.TRACER.end_trace(t)
+        assert "first_dispatch" in tracing.TRACER.lookup(t.trace_id)["marks"]
+
+    def test_http_traces_payload(self):
+        t = tracing.TRACER.start_trace(tenant="x")
+        tracing.TRACER.end_trace(t)
+        code, obj = tracing.http_traces({})
+        assert code == 200 and obj["traces"][0]["trace_id"] == t.trace_id
+        code, obj = tracing.http_traces({"trace_id": t.trace_id[:10]})
+        assert code == 200 and obj["trace_id"] == t.trace_id
+        code, obj = tracing.http_traces({"trace_id": "f" * 32})
+        assert code == 404
+
+    def test_spans_module_feeds_the_active_trace(self, monkeypatch):
+        """obs.spans call sites feed the host store from the SAME call
+        site as the jax.profiler annotation — including on a
+        profiler-less build (the single degradation seam)."""
+        monkeypatch.setattr(spans, "_TRACE_CLS", None)
+        monkeypatch.setattr(spans, "_STEP_CLS", None)
+        t = tracing.TRACER.start_trace()
+        with tracing.activate(t):
+            with spans.span("gol.test", turn=3):
+                pass
+            with spans.step_span("gol.test.step", 7, k=50):
+                pass
+        tracing.TRACER.end_trace(t)
+        names = [s["name"] for s in tracing.TRACER.lookup(t.trace_id)["spans"]]
+        assert "gol.test" in names and "gol.test.step" in names
+        # With NO active trace the same sites are free no-ops.
+        with spans.span("gol.test", turn=4):
+            pass
+
+
+class TestProfilerSeam:
+    def test_one_resolution_home_degrades_both_consumers(self, monkeypatch):
+        """ISSUE 15 satellite: utils.profiling.profiler() is the ONE
+        jax.profiler resolution home — stubbing it degrades BOTH
+        utils.profiling.trace and obs.spans through the same path."""
+        from distributed_gol_tpu.utils import profiling
+
+        monkeypatch.setattr(profiling, "_PROFILER", None)  # stripped build
+        spans._reset()
+        try:
+            cls, step_cls = spans._resolve()
+            assert cls is None and step_cls is None
+            with spans.span("gol.test"):
+                pass
+            with pytest.warns(RuntimeWarning, match="profiler unavailable"):
+                with profiling.trace("/tmp/never-used"):
+                    pass
+        finally:
+            spans._reset()
+
+    def test_real_resolution_is_cached(self):
+        from distributed_gol_tpu.utils import profiling
+
+        profiling._reset_profiler_cache()
+        spans._reset()
+        assert profiling.profiler() is profiling.profiler()
+        cls, _ = spans._resolve()
+        import jax
+
+        assert cls is jax.profiler.TraceAnnotation
+
+
+# -- the end-to-end wire acceptance path ---------------------------------------
+
+
+class TestWireTrace:
+    def test_gateway_submission_reconstructs_end_to_end(self, tmp_path):
+        """THE acceptance row: traceparent in → one trace whose spans
+        cover request handling, admission, session run, dispatches, and
+        the first published frame; the receipt carries the id; /traces
+        serves it; trace_export renders valid Chrome Trace JSON."""
+        from tools.gol_client import GolClient, render_trace
+        from tools import trace_export
+
+        sent_id = "fe" * 16
+        header = tracing.format_traceparent(sent_id, "12" * 8, sampled=True)
+        plane = ServePlane(
+            ServeConfig(max_sessions=2), checkpoint_root=tmp_path / "ckpt"
+        )
+        gateway = GatewayServer(plane, port=0)
+        client = GolClient(gateway.url)
+        try:
+            receipt = client._request(
+                "POST",
+                "/v1/sessions",
+                {
+                    "tenant": "alice",
+                    "params": {
+                        "width": W,
+                        "height": H,
+                        "turns": TURNS,
+                        "engine": "roll",
+                        "cycle_check": 0,
+                        "ticker_period": 60.0,
+                    },
+                    "soup": {"density": 0.25, "seed": 7},
+                    "spectate": True,
+                    "viewport": [0, 0, W, H],
+                },
+                headers={"traceparent": header},
+            )
+            assert receipt["trace_id"] == sent_id
+            assert receipt["traceparent"].split("-")[1] == sent_id
+            assert receipt["links"]["trace"].endswith(sent_id)
+            # A spectator on the wire: its first frame becomes the
+            # trace's last-hop event.
+            with client.spectate("alice", rect=(0, 0, 8, 8)) as stream:
+                deadline = time.monotonic() + 120
+                got_frame = False
+                while time.monotonic() < deadline:
+                    ev = stream.recv(timeout=120)
+                    if isinstance(ev, dict):
+                        if ev.get("type") == "end":
+                            break
+                        continue
+                    got_frame = True
+                assert got_frame
+            handle = plane.handle("alice")
+            assert handle.wait(timeout=120)
+            assert handle.status == "completed"
+            # State responses carry the correlation header.
+            doc, hdrs = _get_with_headers(client, "/v1/sessions/alice/state")
+            assert hdrs.get("X-Gol-Trace-Id") == sent_id
+            # The retained trace, over the wire (the gateway serves
+            # /traces too).  wait() returns a hair before the plane's
+            # end_trace finalizes — poll the terminal status briefly.
+            deadline = time.monotonic() + 30
+            while True:
+                trace = client.traces(trace_id=sent_id[:12])
+                if trace["status"] == "completed" or time.monotonic() > deadline:
+                    break
+                time.sleep(0.05)
+            names = {s["name"] for s in trace["spans"]}
+            assert {
+                "gol.request",
+                "gol.admission",
+                "gol.session.run",
+                "gol.dispatch.sync",
+                "gol.frame.publish",
+            } <= names, names
+            assert trace["tenant"] == "alice"
+            assert trace["status"] == "completed"
+            # SLI marks: first dispatch + first frame stamped once.
+            assert "first_dispatch" in trace["marks"]
+            assert "first_frame" in trace["marks"]
+            event_names = {e["name"] for e in trace["events"]}
+            assert "gol.spectator.first_send" in event_names
+            # The terminal MetricsReport and the SLI histograms join on
+            # the same identifiers.
+            assert handle.report.trace_id == sent_id
+            hists = handle.report.snapshot["histograms"]
+            assert (
+                hists[
+                    obs_metrics.labelled(
+                        "sli.time_to_first_dispatch_seconds", "alice"
+                    )
+                ]["count"]
+                >= 1
+            )
+            assert (
+                hists[
+                    obs_metrics.labelled(
+                        "sli.time_to_first_frame_seconds", "alice"
+                    )
+                ]["count"]
+                >= 1
+            )
+            # Chrome Trace Event export is valid, loadable JSON.
+            chrome = trace_export.to_chrome(trace)
+            blob = json.loads(json.dumps(chrome))
+            assert blob["traceEvents"], "no events exported"
+            assert all(
+                ("ts" in e and "ph" in e and "name" in e) or e["ph"] == "M"
+                for e in blob["traceEvents"]
+            )
+            assert any(
+                e["name"] == "mark:first_dispatch"
+                for e in blob["traceEvents"]
+            )
+            # ...and the human renderer mentions the key hops.
+            text = render_trace(trace)
+            assert "gol.session.run" in text and "first_dispatch" in text
+        finally:
+            gateway.close()
+            plane.close()
+
+    def test_queue_wait_is_a_span_and_an_sli(self, tmp_path):
+        """A queued admission's wait (submit → worker pickup) lands as
+        the gol.queue.wait span AND the sli.queue_wait_seconds
+        observation the queue-wait SLO judges."""
+        with ServePlane(
+            ServeConfig(max_sessions=1), checkpoint_root=tmp_path / "ckpt"
+        ) as plane:
+            first = plane.submit("first", tenant_params(tmp_path / "a", 1))
+            queued = plane.submit("queued", tenant_params(tmp_path / "b", 2))
+            assert queued.admitted_as == "queue"
+            assert plane.wait_idle(timeout=120)
+            assert first.status == queued.status == "completed"
+            doc = queued.trace.to_dict()
+            names = [s["name"] for s in doc["spans"]]
+            assert "gol.queue.wait" in names
+            snap = obs_metrics.REGISTRY.snapshot()
+            hist = snap.data["histograms"][
+                obs_metrics.labelled("sli.queue_wait_seconds", "queued")
+            ]
+            assert hist["count"] >= 1
+            # Run-now admissions observe their (near-zero) wait too, so
+            # the queue-wait SLO's fraction covers ALL requests — but
+            # no gol.queue.wait span pollutes their timeline.
+            run_now = snap.data["histograms"][
+                obs_metrics.labelled("sli.queue_wait_seconds", "first")
+            ]
+            assert run_now["count"] >= 1
+            assert run_now["sum"] < hist["sum"]
+            assert "gol.queue.wait" not in [
+                s["name"] for s in first.trace.to_dict()["spans"]
+            ]
+
+    def test_rejection_yields_a_rejected_trace_with_the_reason(self, tmp_path):
+        with ServePlane(
+            ServeConfig(max_sessions=1, max_queued=0),
+            checkpoint_root=tmp_path / "ckpt",
+        ) as plane:
+            plane.submit("a", tenant_params(tmp_path / "a", 1))
+            from distributed_gol_tpu.serve import AdmissionRejected
+
+            with pytest.raises(AdmissionRejected):
+                plane.submit("b", tenant_params(tmp_path / "b", 2))
+            assert plane.wait_idle(timeout=120)
+            shed = [
+                d
+                for d in tracing.TRACER.recent()
+                if d["status"] == "rejected"
+            ]
+            assert shed and "pod full" in shed[0]["error"]
+
+
+def _get_with_headers(client, path):
+    """GET returning (json body, response headers) — the X-Gol-Trace-Id
+    assertion needs the raw header surface GolClient doesn't expose."""
+    import http.client
+
+    conn = http.client.HTTPConnection(client.host, client.port, timeout=30)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return json.loads(resp.read()), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+# -- tail retention under faults (chaos) ---------------------------------------
+
+
+@pytest.mark.chaos
+class TestFaultTraces:
+    def test_hang_trace_is_tail_retained_with_the_watchdog_fire(
+        self, tmp_path
+    ):
+        """Head sampling at 0 drops every clean trace — but the
+        hang-faulted tenant's trace survives, with the watchdog fire in
+        its always-retained event ring (error traces are never lost)."""
+        sick_params = tenant_params(tmp_path / "sick", 999)
+        sick_backend = FaultInjectionBackend(
+            Backend(sick_params),
+            FaultPlan([Fault(1, "hang", seconds=90.0)]),
+        )
+        try:
+            with ServePlane(
+                ServeConfig(
+                    max_sessions=2,
+                    default_deadline_seconds=1.0,
+                    trace_sample_rate=0.0,
+                ),
+                checkpoint_root=tmp_path / "ckpt",
+            ) as plane:
+                healthy = plane.submit(
+                    "healthy", tenant_params(tmp_path / "good", 101)
+                )
+                sick = plane.submit("sick", sick_params, backend=sick_backend)
+                assert plane.wait_idle(timeout=120)
+                assert healthy.status == "completed"
+                assert sick.status == "parked"
+                assert "DispatchTimeout" in sick.error
+                # The clean trace was head-sampled out; the sick one was
+                # tail-retained with the fire inside it.
+                assert tracing.TRACER.lookup(healthy.trace.trace_id) is None
+                doc = tracing.TRACER.lookup(sick.trace.trace_id)
+                assert doc is not None
+                assert doc["flagged"] == "watchdog_fire"
+                assert any(
+                    e["name"] == "gol.watchdog.fire" for e in doc["events"]
+                )
+                assert doc["status"] == "parked"
+        finally:
+            sick_backend.release_hangs()
+
+    def test_supervisor_dump_carries_the_trace_correlation(self, tmp_path):
+        """Satellite: a REAL supervisor-produced flight dump joins the
+        request timeline — trace_id in the header, the short id on
+        dispatch and restart rows, and flight_report prints all three."""
+        from distributed_gol_tpu.engine.supervisor import supervise
+        from tools import flight_report
+
+        plan = FaultPlan([Fault(2, "issue"), Fault(3, "issue")])
+
+        def always_faulty(p, attempt):
+            return FaultInjectionBackend(Backend(p), plan)
+
+        params = tenant_params(
+            tmp_path / "out",
+            999,
+            checkpoint_every_turns=SUPERSTEP,
+            restart_limit=1,
+        )
+        session = Session(tmp_path / "ckpt")
+        events: queue.Queue = queue.Queue()
+        req = tracing.TRACER.start_trace(tenant="sup")
+        with tracing.activate(req):
+            with pytest.raises(RuntimeError):
+                supervise(
+                    params, events, session=session,
+                    backend_factory=always_faulty,
+                )
+        tracing.TRACER.end_trace(req, status="failed", error="exhausted")
+        from distributed_gol_tpu.obs import flight as flight_lib
+
+        dump = flight_lib.latest_flight_record(tmp_path / "ckpt")
+        assert dump is not None
+        doc = flight_lib.load_flight_record(dump)
+        assert doc["trace_id"] == req.trace_id
+        kinds = {}
+        for r in doc["records"]:
+            kinds.setdefault(r["kind"], []).append(r)
+        assert kinds["dispatch"][0]["trace"] == req.trace_id[:8]
+        assert kinds["restart"][0]["trace"] == req.trace_id[:8]
+        text = flight_report.render(doc)
+        assert f"trace_id {req.trace_id}" in text
+        assert f"[trace {req.trace_id[:8]}]" in text
+        # The trace itself was flagged by the restart and records it.
+        tdoc = tracing.TRACER.lookup(req.trace_id)
+        assert tdoc["flagged"] == "restart"
+        assert any(
+            e["name"] == "gol.supervisor.restart" for e in tdoc["events"]
+        )
+
+
+# -- cohort-batched launches link member traces --------------------------------
+
+
+@pytest.mark.chaos
+class TestCohortTraces:
+    def test_batched_launch_links_member_traces(self, tmp_path):
+        with ServePlane(
+            ServeConfig(max_sessions=4, batched=True),
+            checkpoint_root=tmp_path / "ckpt",
+        ) as plane:
+            a = plane.submit("a", tenant_params(tmp_path / "a", 11))
+            b = plane.submit("b", tenant_params(tmp_path / "b", 22))
+            assert plane.wait_idle(timeout=180)
+            assert a.status == b.status == "completed"
+            docs = {h.tenant: h.trace.to_dict() for h in (a, b)}
+            launches = {
+                t: [
+                    s
+                    for s in d["spans"]
+                    if s["name"] == "gol.cohort.launch"
+                ]
+                for t, d in docs.items()
+            }
+            assert launches["a"] and launches["b"], launches
+            shared = {
+                s["labels"]["launch"] for s in launches["a"]
+            } & {s["labels"]["launch"] for s in launches["b"]}
+            assert shared, "no launch id shared across the two member traces"
+            lid = next(iter(shared))
+            span_a = next(
+                s for s in launches["a"] if s["labels"]["launch"] == lid
+            )
+            assert span_a["labels"]["boards"] >= 2
+            assert docs["b"]["trace_id"] in span_a["labels"]["links"]
+
+
+# -- overhead (the tier-1 acceptance bar) --------------------------------------
+
+
+def test_tracing_overhead_within_rep_spread():
+    """Tracing-on (a live request trace recording host spans on every
+    dispatch) lands within the measured rep spread of tracing-off (the
+    always-on baseline: one ContextVar read per span site) at pilot
+    scale — interleaved A/B medians, each arm's own rep envelope,
+    floored at 30% for quiet rigs (the ISSUE-4 methodology)."""
+    import bench
+    from distributed_gol_tpu.utils import measure
+
+    off_rates, on_rates = [], []
+    for _ in range(3):
+        gps, _ = bench.bench_controller_path(
+            256, budget_seconds=2.0, superstep=256
+        )
+        if gps > 0:
+            off_rates.append(gps)
+        gps, _ = bench.bench_controller_path(
+            256, budget_seconds=2.0, superstep=256, trace_request=True
+        )
+        if gps > 0:
+            on_rates.append(gps)
+    assert off_rates and on_rates, (off_rates, on_rates)
+    # The traced arm actually traced: retained traces carry dispatches.
+    traced = [d for d in tracing.TRACER.recent() if d["status"] == "completed"]
+    assert traced and any(
+        s["name"] == "gol.resolve" for s in traced[0]["spans"]
+    )
+    med_off = measure.median(off_rates)
+    med_on = measure.median(on_rates)
+    envelope = (
+        (measure.spread(off_rates) if len(off_rates) > 1 else 0.0)
+        + (measure.spread(on_rates) if len(on_rates) > 1 else 0.0)
+    )
+    tol = max(0.3, envelope)
+    rel = abs(med_on - med_off) / med_off
+    assert rel <= tol, (
+        f"tracing-on median {med_on:,.0f} vs off {med_off:,.0f}: "
+        f"{rel:.1%} apart, tolerance {tol:.1%} "
+        f"(off reps {off_rates}, on reps {on_rates})"
+    )
+
+
+# -- config + docs lint --------------------------------------------------------
+
+
+class TestConfig:
+    def test_trace_knobs_validate(self):
+        with pytest.raises(ValueError):
+            ServeConfig(trace_sample_rate=1.5)
+        with pytest.raises(ValueError):
+            ServeConfig(trace_ring_depth=0)
+        with pytest.raises(ValueError):
+            ServeConfig(trace_max_spans=4)
+        with pytest.raises(ValueError):
+            ServeConfig(slo_queue_wait_seconds=-1)
+        cfg = ServeConfig(slo_queue_wait_seconds=0.5)
+        obj = cfg.slo_objectives()
+        assert obj is not None and obj.queue_wait_seconds == 0.5
+
+    def test_queue_wait_objective_enables_slo(self):
+        from distributed_gol_tpu.obs.slo import SLOObjectives
+
+        assert not SLOObjectives().enabled
+        assert SLOObjectives(queue_wait_seconds=1.0).enabled
+
+
+class TestSpanDocsLint:
+    def test_shipped_tree_is_clean(self):
+        from tools import check_metric_docs
+
+        assert check_metric_docs.check_spans() == []
+
+    def test_drift_fails_both_directions(self, tmp_path):
+        from tools import check_metric_docs
+
+        pkg = tmp_path / "distributed_gol_tpu"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            'from x import spans\n'
+            'def f():\n'
+            '    with spans.span("gol.only_in_code"):\n'
+            '        pass\n'
+        )
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        (docs / "API.md").write_text(
+            "| Span | Where |\n|---|---|\n"
+            "| `gol.only_in_docs` | nowhere |\n"
+        )
+        problems = check_metric_docs.check_spans(tmp_path)
+        assert any("gol.only_in_code" in p for p in problems)
+        assert any("gol.only_in_docs" in p for p in problems)
+        # Fixing both directions clears it.
+        (docs / "API.md").write_text(
+            "| Span | Where |\n|---|---|\n"
+            "| `gol.only_in_code` | mod.f |\n"
+        )
+        assert check_metric_docs.check_spans(tmp_path) == []

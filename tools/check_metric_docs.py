@@ -1,4 +1,4 @@
-"""Metric-name docs lint (ISSUE 12 satellite): no undocumented metrics.
+"""Metric- and span-name docs lint: no undocumented observability names.
 
 Walks the package source for instrument registrations —
 ``counter("…")`` / ``gauge("…")`` / ``histogram("…")`` /
@@ -8,7 +8,15 @@ the metric table in docs/API.md's Observability section.  A metric
 registered in code but missing from the table fails, and so does a
 documented metric no code registers: new instruments cannot ship
 undocumented, and the table cannot rot.  Runs inside tier-1
-(``tests/test_telemetry.py``).
+(``tests/test_telemetry.py``; ISSUE 12 satellite).
+
+The SAME contract covers span names (ISSUE 15 satellite): every
+``gol.*`` name recorded through ``obs.spans.span``/``step_span`` or the
+request-tracing faces (``tracing.span`` / ``Trace.span`` /
+``add_event`` / ``record_span``) must appear in the docs/API.md span
+table (``| Span | Where |``), both directions — so the request-timeline
+vocabulary can't drift from its documentation either
+(:func:`check_spans`, run in tier-1 by ``tests/test_tracing.py``).
 
 Dynamic names are matched by prefix: an f-string registration like
 ``counter(f"faults.failures.{type(e).__name__}")`` is collected as the
@@ -84,6 +92,87 @@ def documented_metric_names(api_md: Path | None = None) -> set[str]:
     return names
 
 
+#: Span-recording sites (ISSUE 15): obs.spans + the tracing faces, with
+#: a (possibly f-)string literal ``gol.*`` first argument.  ``\(\s*``
+#: spans newlines like the metric pattern.
+_SPAN_SITE = re.compile(
+    r"\b(?:span|step_span|add_event|record_span|start_trace)\(\s*"
+    r'(f?)"(gol\.[^"]+)"'
+)
+
+
+def source_span_names(
+    package_dir: Path | None = None,
+) -> tuple[set[str], set[str]]:
+    """(exact span names, dynamic prefixes) recorded across the package
+    source — the span-name half of the lint."""
+    package_dir = package_dir or (REPO / "distributed_gol_tpu")
+    exact: set[str] = set()
+    prefixes: set[str] = set()
+    for path in sorted(package_dir.rglob("*.py")):
+        for is_f, name in _SPAN_SITE.findall(path.read_text()):
+            if is_f:
+                prefix = name.split("{", 1)[0]
+                if prefix:
+                    prefixes.add(prefix)
+            else:
+                exact.add(name)
+    return exact, prefixes
+
+
+def documented_span_names(api_md: Path | None = None) -> set[str]:
+    """Names from the docs/API.md span table (rows under a
+    ``| Span | Where |`` header), same backtick/suffix conventions as
+    the metric table."""
+    api_md = api_md or (REPO / "docs" / "API.md")
+    names: set[str] = set()
+    in_table = False
+    for line in api_md.read_text().splitlines():
+        stripped = line.strip()
+        if stripped.startswith("| Span | Where |"):
+            in_table = True
+            continue
+        if in_table:
+            if not stripped.startswith("|"):
+                in_table = False
+                continue
+            first_cell = stripped.split("|")[1]
+            for token in re.findall(r"`([^`]+)`", first_cell):
+                names.add(token)
+    return names
+
+
+def check_spans(repo: Path | None = None) -> list[str]:
+    """Span-name violations (empty = the span table and the recording
+    sites agree, both directions)."""
+    repo = repo or REPO
+    exact, prefixes = source_span_names(repo / "distributed_gol_tpu")
+    documented = documented_span_names(repo / "docs" / "API.md")
+    problems = []
+    for name in sorted(exact):
+        if not _source_matches(name, documented):
+            problems.append(
+                f"span recorded but undocumented: {name!r} (add a row to "
+                "the docs/API.md span table)"
+            )
+    for prefix in sorted(prefixes):
+        if not any(
+            ("<" in d and d.split("<", 1)[0] == prefix) or d.startswith(prefix)
+            for d in documented
+        ):
+            problems.append(
+                f"dynamically-named span family {prefix!r}* has no "
+                "docs/API.md span-table row (use a <placeholder> name)"
+            )
+    for doc_name in sorted(documented):
+        if not _doc_matches(doc_name, exact, prefixes):
+            problems.append(
+                f"span documented but never recorded: {doc_name!r} (stale "
+                "docs/API.md span-table row?)"
+            )
+    return problems
+
+
 def _doc_matches(doc_name: str, exact: set[str], prefixes: set[str]) -> bool:
     if "<" in doc_name:
         doc_prefix = doc_name.split("<", 1)[0]
@@ -133,16 +222,18 @@ def check(repo: Path | None = None) -> list[str]:
 
 
 def main() -> int:
-    problems = check()
+    problems = check() + check_spans()
     for p in problems:
         print(p, file=sys.stderr)
     if problems:
-        print(f"{len(problems)} metric-docs violation(s)", file=sys.stderr)
+        print(f"{len(problems)} metric/span-docs violation(s)", file=sys.stderr)
         return 1
     exact, prefixes = source_metric_names()
+    spans, span_prefixes = source_span_names()
     print(
         f"metric docs clean: {len(exact)} named + {len(prefixes)} dynamic "
-        "families all documented"
+        f"families all documented; span docs clean: {len(spans)} named + "
+        f"{len(span_prefixes)} dynamic"
     )
     return 0
 

@@ -41,10 +41,14 @@ def _d_restart(r):
         mesh = f" on mesh {ny}x{nx}"
         if excluded:
             mesh += f", devices {excluded} excluded"
+    # The request-trace join (ISSUE 15): restart records of a traced run
+    # carry the trace's short id — fetch the full timeline from /traces.
+    trace = f" [trace {r['trace']}]" if r.get("trace") else ""
     return (
         f"supervisor restart #{r.get('attempt', '?')} after "
         f"{r.get('cause', '?')}: rolled back turn {r.get('from_turn', '?')}"
         f" -> {r.get('resume_turn', '?')} ({r.get('tier', '?')} tier{mesh})"
+        f"{trace}"
     )
 
 
@@ -207,6 +211,11 @@ def render(doc: dict, tail: int = 20) -> str:
         ids.append(f"run_id {doc['run_id']}")
     if doc.get("tenant") is not None:
         ids.append(f"tenant {doc['tenant']}")
+    if doc.get("trace_id"):
+        # The request-timeline join (ISSUE 15): feed this id to
+        # /traces?trace_id= or tools/trace_export.py — the dispatch/
+        # restart/watchdog ring rows below carry its short form.
+        ids.append(f"trace_id {doc['trace_id']}")
     if ids:
         out.append("  ".join(ids))
     out.append(

@@ -22,6 +22,10 @@ Usage (terminal 1 runs the pod, e.g.
     python tools/gol_client.py http://127.0.0.1:9191 state alice
     python tools/gol_client.py http://127.0.0.1:9191 quit alice
     python tools/gol_client.py http://127.0.0.1:9191 drain
+    # request tracing (ISSUE 15): submit traced, then pull the timeline
+    python tools/gol_client.py http://127.0.0.1:9191 submit bob \\
+        --size 512 --turns 100000 --soup 0.3 --trace
+    python tools/gol_client.py http://127.0.0.1:9191 trace bob
 
 Tests import :class:`GolClient` as a library; the CLI is a thin shell
 over it.
@@ -80,14 +84,22 @@ class GolClient:
         self.timeout = timeout
 
     # -- REST ------------------------------------------------------------------
-    def _request(self, method: str, path: str, body: dict | None = None):
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: dict | None = None,
+        headers: dict | None = None,
+    ):
         conn = http.client.HTTPConnection(
             self.host, self.port, timeout=self.timeout
         )
         try:
             payload = json.dumps(body).encode() if body is not None else None
-            headers = {"Content-Type": "application/json"} if payload else {}
-            conn.request(method, path, body=payload, headers=headers)
+            send_headers = dict(headers or {})
+            if payload:
+                send_headers["Content-Type"] = "application/json"
+            conn.request(method, path, body=payload, headers=send_headers)
             resp = conn.getresponse()
             raw = resp.read()
             try:
@@ -115,9 +127,13 @@ class GolClient:
         frame_stride: int | None = None,
         deadline_seconds: float | None = None,
         params: dict | None = None,
+        traceparent: str | None = None,
     ) -> dict:
         """``Broker.Publish`` over the wire: soup spec or board upload
-        (a numpy array or raw PGM bytes, shipped base64 in the POST)."""
+        (a numpy array or raw PGM bytes, shipped base64 in the POST).
+        ``traceparent`` (ISSUE 15) rides as the W3C header — the
+        gateway joins (or starts) the distributed trace and answers
+        with ``trace_id`` in the receipt."""
         p = dict(params or {})
         for key, val in (
             ("width", width), ("height", height), ("turns", turns),
@@ -141,7 +157,8 @@ class GolClient:
                 doc["frame_stride"] = frame_stride
         if deadline_seconds is not None:
             doc["deadline_seconds"] = deadline_seconds
-        return self._request("POST", "/v1/sessions", doc)
+        headers = {"traceparent": traceparent} if traceparent else None
+        return self._request("POST", "/v1/sessions", doc, headers=headers)
 
     def sessions(self) -> dict:
         return self._request("GET", "/v1/sessions")
@@ -163,6 +180,24 @@ class GolClient:
         if timeout is not None:
             path += f"?timeout={timeout:g}"
         return self._request("POST", path)
+
+    def traces(
+        self,
+        trace_id: str | None = None,
+        tenant: str | None = None,
+        limit: int | None = None,
+    ) -> dict:
+        """``GET /traces`` (ISSUE 15): one trace by id (or prefix), or
+        the recent retained ring, optionally tenant-filtered."""
+        qs = []
+        if trace_id:
+            qs.append(f"trace_id={trace_id}")
+        if tenant:
+            qs.append(f"tenant={tenant}")
+        if limit is not None:
+            qs.append(f"limit={limit}")
+        path = "/traces" + ("?" + "&".join(qs) if qs else "")
+        return self._request("GET", path)
 
     def health(self) -> dict:
         try:
@@ -304,6 +339,63 @@ class SpectatorStream:
         self.close()
 
 
+# -- trace pretty-printer (ISSUE 15) -------------------------------------------
+
+def render_trace(trace: dict) -> str:
+    """A human timeline of one ``gol-trace-v1`` dict: spans sorted and
+    indented by parent links, ms offsets/durations, SLI marks, and the
+    always-retained events — the two-terminal debugging story
+    (``gol_client.py URL trace <tenant>`` against a remote pod)."""
+    out = [
+        f"trace {trace['trace_id']}  tenant={trace.get('tenant')}  "
+        f"status={trace.get('status')}"
+        + (f"  flagged={trace['flagged']}" if trace.get("flagged") else "")
+        + (f"  error={trace['error']}" if trace.get("error") else "")
+    ]
+    spans = sorted(trace.get("spans", ()), key=lambda s: s["t0_ns"])
+    children: dict = {}
+    for s in spans:
+        children.setdefault(s.get("parent_id"), []).append(s)
+    by_id = {s["span_id"]: s for s in spans}
+    depth = {}
+    for s in spans:
+        d, p = 0, s.get("parent_id")
+        while p in by_id and d < 16:
+            d += 1
+            p = by_id[p].get("parent_id")
+        depth[s["span_id"]] = d
+    for s in spans:
+        labels = " ".join(
+            f"{k}={v}"
+            for k, v in (s.get("labels") or {}).items()
+            if v is not None and k != "links"
+        )
+        out.append(
+            f"  {s['t0_ns'] / 1e6:10.3f}ms  {s['dur_ns'] / 1e6:9.3f}ms  "
+            f"{'  ' * depth[s['span_id']]}{s['name']}"
+            + (f"  [{labels}]" if labels else "")
+        )
+    for ev in trace.get("events", ()):
+        labels = " ".join(
+            f"{k}={v}" for k, v in (ev.get("labels") or {}).items()
+        )
+        out.append(
+            f"  {ev['t_ns'] / 1e6:10.3f}ms          !  {ev['name']}"
+            + (f"  [{labels}]" if labels else "")
+        )
+    marks = trace.get("marks") or {}
+    if marks:
+        out.append(
+            "  marks: "
+            + "  ".join(
+                f"{k}={v / 1e6:.3f}ms" for k, v in sorted(marks.items())
+            )
+        )
+    if trace.get("dropped_spans"):
+        out.append(f"  ({trace['dropped_spans']} later spans dropped by the cap)")
+    return "\n".join(out)
+
+
 # -- CLI -----------------------------------------------------------------------
 
 def _render(buf: np.ndarray, max_cols: int = 96) -> str:
@@ -338,6 +430,11 @@ def main(argv=None) -> int:
                           help="frame-mode session: spectators may attach")
     p_submit.add_argument("--viewport", default=None, metavar="Y0,X0,VH,VW")
     p_submit.add_argument("--checkpoint-every-turns", type=int, default=None)
+    p_submit.add_argument("--trace", action="store_true",
+                          help="send a W3C traceparent (sampled) so the "
+                          "pod retains this request's trace; prints the "
+                          "trace id — fetch the timeline later with the "
+                          "'trace' verb")
 
     for verb in ("state", "pause", "resume", "quit"):
         p = sub.add_parser(verb)
@@ -350,6 +447,15 @@ def main(argv=None) -> int:
     p_events = sub.add_parser("events", help="attach as a controller")
     p_events.add_argument("tenant")
     p_events.add_argument("--since", type=int, default=0)
+
+    p_trace = sub.add_parser(
+        "trace", help="fetch + pretty-print a request timeline from /traces"
+    )
+    p_trace.add_argument("target",
+                         help="a tenant name, or a trace id (or prefix)")
+    p_trace.add_argument("--json", action="store_true",
+                         help="raw gol-trace-v1 JSON instead of the "
+                         "rendered timeline")
 
     p_watch = sub.add_parser("watch", help="attach as a spectator")
     p_watch.add_argument("tenant")
@@ -386,6 +492,16 @@ def _run_verb(client: GolClient, args) -> int:
         viewport = None
         if args.viewport:
             viewport = [int(v) for v in args.viewport.split(",")]
+        traceparent = None
+        if args.trace:
+            # A locally-minted W3C traceparent with the sampled flag:
+            # the pod adopts the id AND retains the trace regardless of
+            # its head-sampling rate (the caller asked).
+            import secrets
+
+            traceparent = (
+                f"00-{secrets.token_hex(16)}-{secrets.token_hex(8)}-01"
+            )
         doc = client.submit(
             args.tenant,
             width=args.width or args.size,
@@ -397,8 +513,39 @@ def _run_verb(client: GolClient, args) -> int:
             spectate=args.spectate,
             viewport=viewport,
             params=params,
+            traceparent=traceparent,
         )
         print(json.dumps(doc, indent=2))
+        if args.trace and doc.get("trace_id"):
+            print(
+                f"trace id: {doc['trace_id']}\n"
+                f"timeline: gol_client.py {args.url} trace "
+                f"{doc['trace_id'][:8]}",
+                file=sys.stderr,
+            )
+        return 0
+    if args.verb == "trace":
+        # An all-hex target of >= 8 chars is TRIED as a trace id first;
+        # a miss falls back to the tenant lookup (tenant names may be
+        # legitimately all-hex — 'deadbeef' is a valid tenant).
+        t = args.target
+        doc = None
+        if len(t) >= 8 and all(c in "0123456789abcdef" for c in t.lower()):
+            try:
+                doc = client.traces(trace_id=t.lower())
+            except GatewayError as e:
+                if e.status != 404:
+                    raise
+        if doc is None:
+            doc = client.traces(tenant=t, limit=1)
+        if "traces" in doc:
+            if not doc["traces"]:
+                print(f"no retained trace for {t!r} (still running, or "
+                      "head-sampled out — submit with --trace)",
+                      file=sys.stderr)
+                return 1
+            doc = doc["traces"][0]
+        print(json.dumps(doc, indent=2) if args.json else render_trace(doc))
         return 0
     if args.verb in ("state", "pause", "resume", "quit"):
         print(json.dumps(getattr(client, args.verb)(args.tenant), indent=2))

@@ -1,0 +1,223 @@
+"""Render a request trace (``gol-trace-v1``) to Chrome Trace Event JSON
+(ISSUE 15) — loadable in Perfetto / ``chrome://tracing``.
+
+Input forms:
+
+- a trace JSON file (one ``gol-trace-v1`` dict, or a ``/traces``
+  payload holding several — pick one with ``--trace-id``),
+- ``--url http://pod:PORT`` to fetch from a live pod's ``/traces``
+  endpoint (gateway or telemetry server; combine with ``--trace-id`` /
+  ``--tenant``),
+- a flight record (``flight-*.json``): its ``trace_id`` stamp selects
+  the correlated trace from ``--url`` or a ``--traces FILE`` dump — the
+  postmortem-to-timeline join.
+
+Usage:
+    python tools/trace_export.py trace.json -o chrome.json
+    python tools/trace_export.py --url http://127.0.0.1:9191 --tenant alice -o chrome.json
+    python tools/trace_export.py out/flight-123.json --url http://127.0.0.1:9191
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+TRACE_SCHEMA = "gol-trace-v1"
+FLIGHT_SCHEMA = "gol-flight-v1"
+
+
+def to_chrome(trace: dict) -> dict:
+    """One ``gol-trace-v1`` dict → a Chrome Trace Event document
+    (``{"traceEvents": [...], ...}``).  Spans become complete ("X")
+    events with microsecond timestamps relative to the trace start;
+    always-retained events become instants ("i"); SLI marks become
+    instants too, so time-to-first-dispatch/-frame read straight off
+    the timeline."""
+    if trace.get("schema") != TRACE_SCHEMA:
+        raise ValueError(
+            f"not a {TRACE_SCHEMA} record (schema={trace.get('schema')!r})"
+        )
+    pid = 1
+    events: list[dict] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "args": {
+                "name": f"trace {trace['trace_id'][:8]} "
+                f"tenant={trace.get('tenant')} status={trace.get('status')}"
+            },
+        }
+    ]
+    for span in trace.get("spans", ()):
+        labels = {
+            k: v
+            for k, v in (span.get("labels") or {}).items()
+            if v is not None
+        }
+        events.append(
+            {
+                "name": span["name"],
+                "cat": "gol",
+                "ph": "X",
+                "ts": span["t0_ns"] / 1000.0,
+                "dur": max(span["dur_ns"], 1) / 1000.0,
+                "pid": pid,
+                "tid": 1,
+                "args": labels,
+            }
+        )
+    for ev in trace.get("events", ()):
+        events.append(
+            {
+                "name": ev["name"],
+                "cat": "gol.event",
+                "ph": "i",
+                "s": "p",
+                "ts": ev["t_ns"] / 1000.0,
+                "pid": pid,
+                "tid": 1,
+                "args": dict(ev.get("labels") or {}),
+            }
+        )
+    for name, t_ns in (trace.get("marks") or {}).items():
+        events.append(
+            {
+                "name": f"mark:{name}",
+                "cat": "gol.sli",
+                "ph": "i",
+                "s": "p",
+                "ts": t_ns / 1000.0,
+                "pid": pid,
+                "tid": 1,
+                "args": {},
+            }
+        )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "trace_id": trace["trace_id"],
+            "tenant": trace.get("tenant"),
+            "status": trace.get("status"),
+            "flagged": trace.get("flagged"),
+            "t0_unix": trace.get("t0_unix"),
+            "dropped_spans": trace.get("dropped_spans", 0),
+        },
+    }
+
+
+def _fetch_url(url: str, query: str) -> dict:
+    import http.client
+    from urllib.parse import urlsplit
+
+    split = urlsplit(url if "//" in url else f"//{url}")
+    conn = http.client.HTTPConnection(
+        split.hostname or "127.0.0.1", split.port or 80, timeout=30
+    )
+    try:
+        conn.request("GET", f"/traces{query}")
+        resp = conn.getresponse()
+        body = resp.read()
+        if resp.status != 200:
+            raise RuntimeError(f"GET /traces{query}: HTTP {resp.status} {body[:200]!r}")
+        return json.loads(body)
+    finally:
+        conn.close()
+
+
+def _pick(doc: dict, trace_id: str | None, tenant: str | None) -> dict:
+    """One trace out of a single-trace dict or a /traces payload."""
+    if doc.get("schema") == TRACE_SCHEMA:
+        return doc
+    traces = doc.get("traces")
+    if not isinstance(traces, list) or not traces:
+        raise RuntimeError("no traces in input (is the ring empty?)")
+    if trace_id:
+        hits = [t for t in traces if t["trace_id"].startswith(trace_id)]
+        if not hits:
+            raise RuntimeError(f"no trace matching id {trace_id!r}")
+        return hits[0]
+    if tenant:
+        hits = [t for t in traces if t.get("tenant") == tenant]
+        if not hits:
+            raise RuntimeError(f"no trace for tenant {tenant!r}")
+        return hits[0]
+    return traces[0]  # newest first
+
+
+def resolve_trace(args) -> dict:
+    """The input-resolution ladder (see module doc)."""
+    trace_id, tenant = args.trace_id, args.tenant
+    file_doc = None
+    if args.input:
+        file_doc = json.loads(Path(args.input).read_text())
+        if file_doc.get("schema") == FLIGHT_SCHEMA:
+            # A flight record: its trace_id stamp names the correlated
+            # trace; the trace itself comes from --url/--traces.
+            trace_id = file_doc.get("trace_id")
+            if not trace_id:
+                raise RuntimeError(
+                    f"{args.input} carries no trace_id (untraced run, or "
+                    "a pre-tracing flight record)"
+                )
+            file_doc = None
+            if args.traces:
+                file_doc = json.loads(Path(args.traces).read_text())
+    if file_doc is None and args.url:
+        query = f"?trace_id={trace_id}" if trace_id else (
+            f"?tenant={tenant}" if tenant else ""
+        )
+        file_doc = _fetch_url(args.url, query)
+    if file_doc is None:
+        raise RuntimeError(
+            "nothing to read: pass a trace/flight JSON file, --url, or "
+            "--traces"
+        )
+    return _pick(file_doc, trace_id, tenant)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("input", nargs="?", default=None,
+                    help="a gol-trace-v1 / /traces-payload JSON file, or "
+                    "a flight-*.json to correlate")
+    ap.add_argument("--url", default=None, metavar="http://host:port",
+                    help="fetch from a live pod's /traces endpoint")
+    ap.add_argument("--traces", default=None, metavar="FILE",
+                    help="a saved /traces payload to resolve a flight "
+                    "record's trace_id against (offline correlation)")
+    ap.add_argument("--trace-id", default=None,
+                    help="select one trace by id (or unique prefix)")
+    ap.add_argument("--tenant", default=None,
+                    help="select the newest trace for this tenant")
+    ap.add_argument("-o", "--out", default=None, metavar="FILE",
+                    help="output path (default: stdout)")
+    args = ap.parse_args(argv)
+    try:
+        trace = resolve_trace(args)
+        doc = to_chrome(trace)
+    except (OSError, ValueError, RuntimeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    text = json.dumps(doc)
+    if args.out:
+        Path(args.out).write_text(text)
+        print(
+            f"wrote {len(doc['traceEvents'])} events for trace "
+            f"{trace['trace_id'][:8]} -> {args.out} (open in Perfetto or "
+            "chrome://tracing)",
+            file=sys.stderr,
+        )
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
