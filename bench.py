@@ -1952,6 +1952,26 @@ def main():
         "time-to-recover (MTTR) as a lint-checked stats block.  Prints "
         "one JSON line and exits.",
     )
+    ap.add_argument(
+        "--timecomp",
+        action="store_true",
+        help="time-compression mode (ISSUE 16): interleaved dense vs "
+        "compressed runs of the same ash-dominated board — the dense "
+        "arm's controller-path rate is the COMPUTED gens/s, the "
+        "compressed arm's wall-clock over delivered turns is the "
+        "EFFECTIVE gens/s, and the headline row carries both (the "
+        "stats lint refuses an 'effective' unit without them).  "
+        "Prints one lint-checked JSON line and exits "
+        "(BENCH_TIMECOMP artifact).",
+    )
+    ap.add_argument(
+        "--timecomp-turns",
+        type=int,
+        default=2 * 10**8,
+        metavar="T",
+        help="fast-forward horizon for --timecomp (delivered turns per "
+        "compressed rep)",
+    )
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -1985,6 +2005,19 @@ def main():
         # The metrics-snapshot lint (ISSUE 4): same contract as the stats
         # lint above — a malformed embedded snapshot fails the run rather
         # than shipping a broken artifact.
+        obs_metrics.require_embedded_metrics(record)
+        print(json.dumps(record))
+        return
+
+    if args.timecomp:
+        record = bench_timecomp(
+            size if size <= 1024 else 256,
+            ff_turns=args.timecomp_turns,
+            dense_budget=3.0,
+            reps=max(args.reps, 3),
+        )
+        record["platform"] = dev.platform
+        measure.require_headline_stats(record)
         obs_metrics.require_embedded_metrics(record)
         print(json.dumps(record))
         return
@@ -2228,6 +2261,162 @@ def bench_tracing_overhead(
     }
 
 
+def timecomp_board(size: int):
+    """An ash-dominated board for the time-compression arms: a lattice of
+    blocks and blinkers (settled from turn 0) with one T-tetromino in a
+    cleared centre — it burns to a traffic light (four blinkers) within
+    ~10 generations, no escaping gliders, leaving the whole board inside
+    Conway's period-6 ash census.  Deterministic by construction, so the
+    dense and compressed arms run the identical workload."""
+    import numpy as np
+
+    b = np.zeros((size, size), np.uint8)
+    for y in range(2, size - 8, 16):
+        for x in range(2, size - 8, 16):
+            b[y : y + 2, x : x + 2] = 255  # block
+    for y in range(10, size - 8, 16):
+        for x in range(8, size - 8, 16):
+            b[y, x : x + 3] = 255  # blinker
+    c = size // 2
+    b[c - 16 : c + 16, c - 16 : c + 16] = 0  # clearing for the methuselah
+    b[c, c - 1 : c + 2] = 255  # T-tetromino
+    b[c + 1, c] = 255
+    return b
+
+
+def bench_timecomp(
+    size: int = 256,
+    ff_turns: int = 2 * 10**8,
+    dense_budget: float = 3.0,
+    reps: int = 3,
+    superstep: int = 256,
+) -> dict:
+    """The ISSUE-16 effective-vs-computed record: the identical
+    ash-dominated board measured two ways —
+
+    - **dense** (``time_compression=False``, ``cycle_check=0``): the
+      controller path grinding every generation on device; its steady
+      rate is the COMPUTED gens/s denominator.
+    - **compressed** (``time_compression=True``): a fixed ``ff_turns``
+      run that settles, proves periodicity, passes the exactness guard,
+      and fast-forwards; wall-clock over delivered turns is the
+      EFFECTIVE gens/s numerator.
+
+    The headline row's unit says "effective" — which
+    ``measure.require_headline_stats`` now refuses unless the row also
+    carries ``computed_gens_per_s`` and both integer turn totals, so
+    this record cannot ship the skip rate dressed up as throughput."""
+    import tempfile
+    import threading
+    from pathlib import Path
+
+    from distributed_gol_tpu.engine import pgm as pgm_lib
+    from distributed_gol_tpu.engine.events import EventQueue
+    from distributed_gol_tpu.engine.gol import run
+    from distributed_gol_tpu.engine.params import Params
+    from distributed_gol_tpu.engine.session import Session
+    from distributed_gol_tpu.obs import metrics as obs_metrics
+    from distributed_gol_tpu.utils import measure
+
+    imgdir = Path(tempfile.mkdtemp(prefix="gol_timecomp_"))
+    board = timecomp_board(size)
+    pgm_lib.write_pgm(imgdir / f"{size}x{size}.pgm", board)
+    engine = pick_engine("auto", size)
+
+    def compressed_params(turns: int) -> Params:
+        return Params(
+            turns=turns,
+            image_width=size,
+            image_height=size,
+            images_dir=imgdir,
+            out_dir=tempfile.mkdtemp(prefix="gol_timecomp_out_"),
+            no_vis=True,
+            turn_events="batch",
+            engine=engine,
+            superstep=superstep,
+            time_compression=True,
+        )
+
+    def compressed_rep(turns: int) -> float:
+        events = EventQueue()
+
+        def consume():
+            while True:
+                for e in events.get_many():
+                    if e is None:
+                        return
+
+        consumer = threading.Thread(target=consume, daemon=True)
+        consumer.start()
+        t0 = time.perf_counter()
+        run(compressed_params(turns), events, None, session=Session())
+        wall = time.perf_counter() - t0
+        consumer.join(timeout=120)
+        return wall
+
+    # Warm the compressed path's jits (probe, guard, cycle counts) so the
+    # timed reps measure the tier, not compilation.
+    compressed_rep(min(ff_turns, 10**6))
+
+    dense_overrides = {
+        "soup_density": None,
+        "images_dir": imgdir,
+        "superstep": superstep,
+    }
+    dense_rates, eff_rates = [], []
+    snap_delta = None
+    skipped = computed_dispatched = 0
+    for _ in range(max(1, reps)):
+        # Interleaved arms (the bench_faults methodology): rig drift hits
+        # dense and compressed reps alike.
+        gps, _ = bench_controller_path(
+            size,
+            budget_seconds=dense_budget,
+            superstep=0,  # explicit superstep rides params_overrides
+            params_overrides=dense_overrides,
+        )
+        if gps > 0:
+            dense_rates.append(gps)
+        before = obs_metrics.REGISTRY.snapshot()
+        wall = compressed_rep(ff_turns)
+        snap_delta = obs_metrics.REGISTRY.snapshot().delta(before)
+        eff_rates.append(ff_turns / wall)
+        counters = snap_delta.to_dict().get("counters", {})
+        skipped = int(counters.get("timecomp.skipped_turns", 0))
+        computed_dispatched = ff_turns - skipped
+    if not dense_rates:
+        return {"error": "dense arm produced no rate", "size": size}
+    dense = measure.summarize(dense_rates)
+    eff = measure.summarize(eff_rates)
+    counters = snap_delta.to_dict().get("counters", {}) if snap_delta else {}
+    record = {
+        "metric": f"gol_timecomp_{size}x{size}_{engine}",
+        "unit": "effective_generations/sec",
+        "value": round(eff["median"], 2),
+        **eff,
+        "computed_gens_per_s": round(dense["median"], 2),
+        "effective_turns": int(ff_turns),
+        "computed_turns": int(computed_dispatched),
+        "speedup": round(eff["median"] / dense["median"], 2),
+        "dense": {
+            "metric": f"gol_timecomp_{size}x{size}_{engine}_dense",
+            "unit": "generations/sec",
+            "value": round(dense["median"], 2),
+            **dense,
+        },
+        "timecomp_counters": {
+            k: v for k, v in counters.items() if k.startswith("timecomp.")
+        },
+        "metrics": snap_delta.to_dict() if snap_delta else None,
+    }
+    log(
+        f"  timecomp {size}x{size}: effective {eff['median']:,.0f} gens/s "
+        f"vs computed {dense['median']:,.0f} gens/s "
+        f"({record['speedup']}x, {skipped} turns skipped)"
+    )
+    return record
+
+
 def pilot_record(dev) -> dict:
     """``--pilot``: the whole record shape — engine row with quiet stats,
     controller-path row, bit-identity — at toy scale (256², fixed shallow
@@ -2281,6 +2470,12 @@ def pilot_record(dev) -> dict:
     # interleaved, asserted within the rep spread by tier-1.
     record["tracing_overhead"] = bench_tracing_overhead(
         size, budget_seconds=2.0, reps=3
+    )
+    # Time-compression arm (ISSUE 16): effective-vs-computed on the
+    # ash-dominated pilot board, pilot-sized (10^7 fast-forward turns,
+    # 2 reps) — tier-1 asserts the row shape and the >=10x floor.
+    record["timecomp"] = bench_timecomp(
+        size, ff_turns=10**7, dense_budget=1.5, reps=2
     )
     ok = verify_engine(size, engine, turns=16)
     if ok is not None:

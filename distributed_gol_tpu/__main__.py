@@ -118,6 +118,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="probe for whole-board period-6 stability every N "
                          "headless dispatches; once proved, the remaining "
                          "turns fast-forward exactly (0 disables)")
+    ap.add_argument("--time-compression", action="store_true",
+                    help="temporal-compression tier (docs/API.md \"Time "
+                         "compression\"): once the board is proved settled, "
+                         "fast-forward through time in ash-period chunks "
+                         "with zero device launches — exact, guarded by an "
+                         "independent-stencil re-derivation; requires a "
+                         "rule with a known ash period (B3/S23, B36/S23)")
+    ap.add_argument("--timecomp-cache-slots", type=int, default=256,
+                    metavar="N",
+                    help="bounded LRU slots for the time-compression ash "
+                         "cache (per-phase alive counts of settled boards)")
     ap.add_argument("--soup", type=float, default=None, metavar="DENSITY",
                     help="start from a seeded random soup of this density "
                          "instead of images/WxH.pgm (huge boards need no "
@@ -262,6 +273,8 @@ def params_from_args(args) -> Params:
         skip_stable=args.skip_stable,
         skip_tile_cap=args.skip_tile_cap,
         cycle_check=args.cycle_check,
+        time_compression=args.time_compression,
+        timecomp_cache_slots=args.timecomp_cache_slots,
         soup_density=args.soup,
         soup_seed=args.soup_seed,
         retry_limit=args.retry_limit,

@@ -140,6 +140,27 @@ class Backend:
                             "the board is mostly ash this is slower",
                             stacklevel=2,
                         )
+                if skip_engages and not pallas_packed.skip_covers_rule(
+                    params.rule
+                ):
+                    # Rule-derived stability policy (ISSUE 16): the
+                    # kernel's proof window is one ash period of the
+                    # census rules; a rule whose ash period is unknown
+                    # (or does not divide the window) pays the probe
+                    # cost with no prospect of skipping.  Exactness is
+                    # unaffected either way, so an explicit request is
+                    # honoured — with the trade made visible.
+                    import warnings
+
+                    warnings.warn(
+                        f"skip_stable engaged for rule "
+                        f"{params.rule.notation} whose ash period is "
+                        f"{params.rule.ash_period} — the kernel's "
+                        f"period-{pallas_packed.SKIP_PERIOD} stability "
+                        "window cannot cover its settled debris, so "
+                        "tiles are unlikely to ever skip",
+                        stacklevel=2,
+                    )
                 if skip_engages:
                     # Adaptive kernel with live skip telemetry; cap 0 =
                     # the measured size-aware default (see _skip_superstep).
@@ -917,11 +938,25 @@ class Backend:
         return bool(ok), int(pop), int(fp)
 
     # -- whole-board cycle detection (Params.cycle_check) ----------------------
-    _CYCLE_PERIOD = 6  # lcm(1, 2, 3): still lifes, blinkers, pulsars
+    # Legacy probe depth for rules with no established ash census: 6 =
+    # lcm(1, 2, 3) (still lifes, blinkers, pulsars).  Rules with a known
+    # census derive the depth from LifeRule.ash_period instead — see
+    # ``cycle_period``.
+    _CYCLE_PERIOD = 6
+
+    @property
+    def cycle_period(self) -> int:
+        """The whole-board periodicity probe depth: the rule's ash period
+        (``LifeRule.ash_period``, ISSUE 16 — B3/S23 and B36/S23 both 6)
+        when known, else the legacy ``_CYCLE_PERIOD`` fallback.  The
+        probe VERIFIES ``step(board, p) == board`` on device, so any
+        depth is exact — a rule-matched depth just maximises how much
+        settled ash can pass it."""
+        return self.params.rule.ash_period or self._CYCLE_PERIOD
 
     def cycle_probe_async(self, board: jax.Array) -> jax.Array:
         """Issue (without waiting) the whole-board periodicity check: an
-        on-device bool, true iff advancing ``_CYCLE_PERIOD`` generations
+        on-device bool, true iff advancing ``cycle_period`` generations
         reproduces ``board`` exactly.  Deterministic dynamics then pin
         every future state to one of the cycle's phases, which is what
         licenses the controller's fast-forward.  The equality reduces
@@ -933,16 +968,16 @@ class Backend:
             @jax.jit
             def fn(b):
                 return jnp.array_equal(
-                    self._device_superstep(b, self._CYCLE_PERIOD), b
+                    self._device_superstep(b, self.cycle_period), b
                 )
 
             self._viewer_fns["cycle_probe"] = fn
         return fn(board)
 
     def cycle_counts(self, board: jax.Array) -> np.ndarray:
-        """Alive counts of the ``_CYCLE_PERIOD`` cycle phases: entry i is
+        """Alive counts of the ``cycle_period`` cycle phases: entry i is
         the count after i+1 generations from ``board``.  Only called once
-        a probe has proved the cycle, so these six numbers are the alive
+        a probe has proved the cycle, so these numbers are the alive
         counts of every remaining turn of the run."""
         fn = self._viewer_fns.get("cycle_counts")
         if fn is None:
@@ -950,7 +985,7 @@ class Backend:
             @jax.jit
             def fn(b):
                 counts = []
-                for _ in range(self._CYCLE_PERIOD):
+                for _ in range(self.cycle_period):
                     b = self._device_superstep(b, 1)
                     counts.append(stencil.alive_count(b))
                 return jnp.stack(counts)

@@ -154,6 +154,36 @@ class Params:
     # Boards with travelling patterns (gliders) simply never pass the
     # probe and pay only its ~6 generations per N dispatches.
     cycle_check: int = 8
+    # Temporal-compression tier (ISSUE 16; ROADMAP item 2): fast-forward
+    # settled boards through TIME, not just space.  Off (default) the
+    # engine behaves byte-for-byte as before.  On, headless runs gain
+    # three rungs above the superstep dispatch, all exact:
+    #   1. whole-board host-side skip — once the board is PROVED within
+    #      the rule's ash period p (cycle probe + an independent
+    #      roll-stencil guard), the remaining turns advance in p·2^k
+    #      chunks with zero device launches, counts replayed from a
+    #      one-period capture;
+    #   2. periodic-region memoization — a bounded process-wide cache
+    #      (engine/timecomp.py) keyed by the settled board's device
+    #      fingerprint remembers period + per-phase alive counts, so
+    #      recurring ash is recognized without refetching the board;
+    #   3. hybrid frontier gating — while the activity bitmap still
+    #      shows active stripes the megakernel runs (its in-kernel
+    #      adaptive skip already elides settled stripes spatially) and
+    #      cycle probes are deferred; the fast-forwarded interval is
+    #      re-validated by the SDC roll-stencil probe at the next real
+    #      dispatch boundary, falling back to dense replay from the
+    #      last verified turn on any mismatch (never silent corruption).
+    # Requires a rule with a known ash period (LifeRule.ash_period —
+    # B3/S23, B36/S23); unknown-period rules get a one-time warning and
+    # run dense.  Checkpoint sidecars record computed vs effective turns
+    # so resumed runs report honest progress.  See docs/API.md "Time
+    # compression".
+    time_compression: bool = False
+    # Bounded slot count of the process-wide timecomp memo cache (rung
+    # 2); least-recently-used entries are evicted past this.  Only read
+    # when time_compression is on.
+    timecomp_cache_slots: int = 256
     # AliveCellsCount cadence in seconds (reference: 2000 ms ticker,
     # gol/distributor.go:228); configurable so tests can run fast.
     ticker_period: float = 2.0
@@ -354,6 +384,8 @@ class Params:
             )
         if self.cycle_check < 0:
             raise ValueError("cycle_check must be >= 0 (0 disables)")
+        if self.timecomp_cache_slots < 1:
+            raise ValueError("timecomp_cache_slots must be >= 1")
         if self.ticker_period <= 0:
             raise ValueError("ticker_period must be positive")
         if self.max_dispatch_seconds <= 0:

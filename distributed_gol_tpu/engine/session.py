@@ -66,6 +66,14 @@ class Checkpoint:
     # consulted for resume.
     run_id: str | None = None
     tenant: str | None = None
+    # Checkpoint truthfulness under time compression (ISSUE 16): how many
+    # generations the parking run actually DISPATCHED (``computed_turns``)
+    # vs how many it delivered (``effective_turns`` — equals ``turn``).
+    # Only time-compressed runs write them (None stays off the sidecar,
+    # keeping default-off runs byte-identical); resume feeds them back to
+    # the controller so a resumed run's own sidecars stay cumulative.
+    computed_turns: int | None = None
+    effective_turns: int | None = None
 
 
 class Session:
@@ -98,16 +106,22 @@ class Session:
         world: np.ndarray | None = None,
         turn: int = 0,
         rule: str | None = None,
+        computed_turns: int | None = None,
+        effective_turns: int | None = None,
     ):
         """Set/clear the paused flag; with a world attached this is the 'q'
         checkpoint call (stubs.PauseCall carries World/Turn/Dimension,
         stubs/stubs.go:31-36).  ``rule`` records the rule notation so a
-        resume under a different rule is refused (see Checkpoint)."""
+        resume under a different rule is refused (see Checkpoint);
+        ``computed_turns``/``effective_turns`` record the parking run's
+        time-compression split (see Checkpoint, ISSUE 16)."""
         with self._lock:
             self._paused = paused
             if paused and world is not None:
                 self._checkpoint = Checkpoint(
-                    np.asarray(world, dtype=np.uint8), turn, rule
+                    np.asarray(world, dtype=np.uint8), turn, rule,
+                    computed_turns=computed_turns,
+                    effective_turns=effective_turns,
                 )
                 self._ckpt_name = "checkpoint"
                 self._persist()
@@ -122,6 +136,8 @@ class Session:
         metrics: dict | None = None,
         run_id: str | None = None,
         tenant: str | None = None,
+        computed_turns: int | None = None,
+        effective_turns: int | None = None,
     ):
         """Park a periodic (crash-recovery) checkpoint: the same resumable
         state a 'q' detach leaves, under a rotated ``checkpoint-<turn>``
@@ -134,7 +150,7 @@ class Session:
             self._paused = True
             self._checkpoint = Checkpoint(
                 np.asarray(world, dtype=np.uint8), turn, rule, metrics,
-                run_id, tenant,
+                run_id, tenant, computed_turns, effective_turns,
             )
             self._ckpt_name = f"checkpoint-{turn:012d}"
             try:
@@ -231,7 +247,13 @@ class Session:
             world = self._load_world(path, meta)
             if world is None:
                 continue  # torn/unreadable pair: fall back to an older one
-            return Checkpoint(world, int(meta["turn"]), mrule)
+            return Checkpoint(
+                world,
+                int(meta["turn"]),
+                mrule,
+                computed_turns=meta.get("computed_turns"),
+                effective_turns=meta.get("effective_turns"),
+            )
         return None
 
     # -- Broker.Quit (broker/broker.go:182-189) --------------------------------
@@ -336,6 +358,14 @@ class Session:
             meta["run_id"] = self._checkpoint.run_id
         if self._checkpoint.tenant is not None:
             meta["tenant"] = self._checkpoint.tenant
+        if self._checkpoint.computed_turns is not None:
+            # Checkpoint truthfulness (ISSUE 16): a time-compressed run's
+            # sidecar must distinguish dispatched work from delivered
+            # turns.  Consulted at resume (the split stays cumulative),
+            # absent on dense runs (byte-identity when the tier is off).
+            meta["computed_turns"] = self._checkpoint.computed_turns
+        if self._checkpoint.effective_turns is not None:
+            meta["effective_turns"] = self._checkpoint.effective_turns
         self._write_json(self._meta_path, meta)
 
     @staticmethod

@@ -56,12 +56,47 @@ class LifeRule:
         s = "".join(str(n) for n in sorted(self.survive))
         return f"B{b}/S{s}"
 
+    @property
+    def ash_period(self) -> int | None:
+        """The rule's *ash period*: a period every common settled-debris
+        oscillation divides, or ``None`` when no such period is known
+        for this rule.
+
+        This is the one number the engine's whole temporal story hangs
+        off — the frontier kernels' stability-proof window
+        (``ops/pallas_packed`` proves a tile's window reproduces itself
+        after this many generations before eliding it), the whole-board
+        cycle probe (``Backend.cycle_probe_async``), and the
+        time-compression tier (``engine/timecomp``) all use it.  Every
+        consumer VERIFIES periodicity on device before acting (the
+        period is a probe depth, never an assumption), so a wrong entry
+        here cannot corrupt results — but an unknown period means the
+        probes have no principled depth to use, and features that lean
+        on ash periodicity (``Params.time_compression``) refuse to
+        engage rather than probe blind.
+        """
+        return _ASH_PERIODS.get((self.birth, self.survive))
+
     def __str__(self) -> str:
         return f"{self.name} ({self.notation})"
 
 
 def _rule(name: str, birth: tuple[int, ...], survive: tuple[int, ...]) -> LifeRule:
     return LifeRule(name, frozenset(birth), frozenset(survive))
+
+
+#: Known ash periods, keyed by (birth, survive) so notation aliases of
+#: the same rule resolve identically.  B3/S23 and B36/S23: settled
+#: debris is still lifes (period 1), blinkers/beacons/toads (period 2)
+#: and pulsars (period 3) — lcm(1, 2, 3) = 6, the constant the frontier
+#: kernels have proved stability against since PR 3 (now derived from
+#: here; see ``LifeRule.ash_period``).  Rules absent from this table
+#: have ash_period None: their settled-debris census is not established,
+#: so period-reliant features refuse rather than guess.
+_ASH_PERIODS: dict[tuple[frozenset[int], frozenset[int]], int] = {
+    (frozenset({3}), frozenset({2, 3})): 6,  # conway  B3/S23
+    (frozenset({3, 6}), frozenset({2, 3})): 6,  # highlife B36/S23
+}
 
 
 # The reference's rule (server/server.go:33-53) and a zoo of well-known

@@ -261,9 +261,32 @@ def default_skip_cap(h: int) -> int:
     """The measured-optimal adaptive tile cap for an ``h``-row board (or
     per-device strip) — what ``skip_tile_cap in (0, None)`` resolves to."""
     return _SKIP_TILE_CAP_TALL if h >= _TALL_ROWS else _SKIP_TILE_CAP
-# Stability period the adaptive kernel proves per launch: 6 = lcm(2, 3)
-# covers still lifes + period-2 oscillators + pulsars (see _kernel).
+# Stability window the adaptive kernel proves per launch.  The proof is
+# rule-agnostic and EXACT for any rule (a tile is skipped only after its
+# halo-extended window is shown to reproduce itself after this many
+# generations); the window is WORTHWHILE only for rules whose ash period
+# (``LifeRule.ash_period``) divides it — for the supported census rules
+# (B3/S23, B36/S23: still lifes + period-2 oscillators + pulsars,
+# ash_period 6) the window is exactly one ash period.  The value is
+# baked into the compiled launch-depth arithmetic below, so it is a
+# kernel constant; ``skip_covers_rule`` is how policy layers ask whether
+# it lines up with a given rule's ash.
 _SKIP_PERIOD = 6
+
+#: Public face of the kernel's stability window (ISSUE 16): the depth
+#: quantum adaptive launches are rounded to, and the period the
+#: activity bitmap's "inactive" verdict is relative to.
+SKIP_PERIOD = _SKIP_PERIOD
+
+
+def skip_covers_rule(rule) -> bool:
+    """Whether the adaptive kernel's stability window covers ``rule``'s
+    settled debris: its ash period is known and divides the window.
+    False (unknown or non-dividing period) means tiles of common ash
+    would never prove stable — the skip stays exact but pays its probe
+    cost for nothing, which the Backend warns about."""
+    period = rule.ash_period
+    return period is not None and _SKIP_PERIOD % period == 0
 
 
 @functools.lru_cache(maxsize=None)

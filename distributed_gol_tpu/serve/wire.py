@@ -180,7 +180,17 @@ _PARAM_FIELDS = {
     "sdc_check_every_turns": int,
     "ticker_period": float,
     "cycle_check": int,
+    "time_compression": lambda v: _coerce_bool(v, "time_compression"),
+    "timecomp_cache_slots": int,
 }
+
+
+def _coerce_bool(v, field: str) -> bool:
+    """JSON booleans only — ``bool("false")`` is True, so a string here
+    is a client bug the wire must reject, not silently enable."""
+    if isinstance(v, bool):
+        return v
+    raise TypeError(f"{field} must be a JSON boolean, got {type(v).__name__}")
 
 #: Spec keys outside the Params whitelist.
 _SPEC_KEYS = {"params", "board_b64", "soup", "spectate", "viewport",

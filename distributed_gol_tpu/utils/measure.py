@@ -192,6 +192,27 @@ def _check_row(row: dict, path: str, problems: list[str]) -> None:
                 f"{path}: rates length {len(rates) if isinstance(rates, (list, tuple)) else 'n/a'}"
                 f" != reps {reps}"
             )
+    if "effective" in str(row.get("unit", "")):
+        # Time-compression honesty (ISSUE 16): a row claiming EFFECTIVE
+        # throughput (generations delivered, not dispatched) must also
+        # publish the computed side — the dispatched-generations rate and
+        # both turn totals — or the headline is a dressed-up skip count.
+        cgs = row.get("computed_gens_per_s")
+        if (
+            not isinstance(cgs, (int, float))
+            or not math.isfinite(cgs)
+            or cgs <= 0
+        ):
+            problems.append(
+                f"{path}: effective-rate row lacks a positive "
+                f"computed_gens_per_s ({cgs!r})"
+            )
+        for fld in ("effective_turns", "computed_turns"):
+            v = row.get(fld)
+            if not isinstance(v, int) or v < 0:
+                problems.append(
+                    f"{path}: effective-rate row lacks integer {fld} ({v!r})"
+                )
 
 
 def check_headline_stats(record, path: str = "$") -> list[str]:

@@ -1073,6 +1073,11 @@ class TestCohortKey:
             {"turns": TURNS * 2},
             {"engine": "packed"},
             {"image_width": 32},
+            # Time compression (ISSUE 16) changes the dispatch schedule
+            # (probe deferral + zero-launch fast-forward), so a
+            # compressed and a dense tenant must never share a launch.
+            {"time_compression": True},
+            {"timecomp_cache_slots": 8},
         ],
         ids=lambda o: next(iter(o)),
     )

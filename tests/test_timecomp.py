@@ -1,0 +1,451 @@
+"""Time-compression tier (ISSUE 16): fast-forward settled boards through
+TIME, not just space — exactly.
+
+The contract under test: with ``Params.time_compression`` on, a run that
+settles into ash is delivered in ``p·2^k``-generation zero-launch chunks
+(rung 1), its per-phase counts memoized process-wide (rung 2, the
+:class:`AshCache`), with every fast-forwarded interval entered and exited
+through the independent SDC roll-stencil guard — and the result is
+BIT-IDENTICAL to the dense oracle across engines, meshes, checkpoint/
+resume, and supervisor restarts.  With the knob off (the default), the
+tier must be byte-for-byte absent: no counters, no sidecar fields.
+"""
+
+import json
+import queue
+import warnings
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import distributed_gol_tpu as gol
+from distributed_gol_tpu.engine import pgm
+from distributed_gol_tpu.engine import timecomp as timecomp_lib
+from distributed_gol_tpu.engine.backend import Backend
+from distributed_gol_tpu.engine.events import (
+    CycleDetected,
+    DispatchError,
+    FinalTurnComplete,
+    TurnComplete,
+    TurnsCompleted,
+)
+from distributed_gol_tpu.engine.session import Session
+from distributed_gol_tpu.models.life import CONWAY, parse_rule
+from distributed_gol_tpu.obs import flight as flight_lib
+from distributed_gol_tpu.obs import metrics as obs_metrics
+from distributed_gol_tpu.testing.faults import Fault, FaultInjectionBackend, FaultPlan
+
+from tests.oracle import oracle_run
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: The ash board's methuselah (a T-tetromino) burns to a traffic light by
+#: generation ~10; 36 is the first multiple of 6 safely past settling, so
+#: board(t) == board(36 + (t - 36) % 6) for every t >= 36.
+SETTLE = 36
+
+
+def ash_board(size: int) -> np.ndarray:
+    """A lattice of blocks and blinkers with one T-tetromino in a cleared
+    centre: genuinely active at t=0 (the probe must NOT pass early),
+    settled into whole-board period-<=6 ash well before ``SETTLE``, and
+    glider-free (an escaping glider on the torus would never settle)."""
+    b = np.zeros((size, size), np.uint8)
+    for y in range(2, size - 8, 16):
+        for x in range(2, size - 8, 16):
+            b[y : y + 2, x : x + 2] = 255  # block
+    for y in range(10, size - 8, 16):
+        for x in range(8, size - 8, 16):
+            b[y, x : x + 3] = 255  # blinker
+    c = size // 2
+    b[c - 16 : c + 16, c - 16 : c + 16] = 0
+    b[c, c - 1 : c + 2] = 255  # T-tetromino
+    b[c + 1, c] = 255
+    return b
+
+
+def expected_final(board: np.ndarray, turns: int) -> np.ndarray:
+    """The dense oracle's board at ``turns``, computed through the settled
+    board's periodicity (NumPy cannot run 10^9 generations directly; it
+    can prove the period and land on the same phase)."""
+    assert turns >= SETTLE
+    out = oracle_run(board, SETTLE + (turns - SETTLE) % 6)
+    # The reduction is only valid if the board really is periodic by
+    # SETTLE — assert it rather than assume it.
+    assert np.array_equal(out, oracle_run(out, 6))
+    return out
+
+
+def write_board(images_dir, board):
+    images_dir.mkdir(parents=True, exist_ok=True)
+    h, w = board.shape
+    pgm.write_pgm(images_dir / f"{w}x{h}.pgm", board)
+
+
+def make_params(tmp_path, size, **kw):
+    defaults = dict(
+        turns=10**9,
+        image_width=size,
+        image_height=size,
+        images_dir=tmp_path / "images",
+        out_dir=tmp_path,
+        engine="roll",
+        superstep=4,
+        cycle_check=2,
+        time_compression=True,
+    )
+    defaults.update(kw)
+    return gol.Params(**defaults)
+
+
+def drain(events, keep_turn_completes=True):
+    out = []
+    while (e := events.get(timeout=120)) is not None:
+        if keep_turn_completes or not isinstance(e, TurnComplete):
+            out.append(e)
+    return out
+
+
+def alive_set(board):
+    ys, xs = np.nonzero(board)
+    return {(int(x), int(y)) for y, x in zip(ys, xs)}
+
+
+def run_compressed(params, *, session=None, keys=None, backend=None):
+    """One compressed run; returns (event stream, timecomp counter delta)."""
+    events: queue.Queue = queue.Queue()
+    before = obs_metrics.REGISTRY.snapshot()
+    gol.run(params, events, keys, session=session, backend=backend)
+    delta = obs_metrics.REGISTRY.snapshot().delta(before).to_dict()["counters"]
+    return drain(events), {
+        k: v for k, v in delta.items() if k.startswith("timecomp.")
+    }
+
+
+# -- the oracle matrix (tentpole acceptance) -----------------------------------
+
+# Full engine x mesh cross at 256^2 plus one 512^2 row; the +0..+6 turn
+# offsets land every residue mod 6, so all six cycle phases are exit-
+# guarded somewhere in the matrix.  pallas-packed on (1,1) at 256^2 is
+# below the kernel's tile floor and records its packed fallback — same
+# controller seam, same exactness contract; (2,1) runs the real sharded
+# kernel (interpret-mode on this CPU rig).
+MATRIX = [
+    (256, "roll", (1, 1), 10**9 + 0),
+    (256, "roll", (2, 1), 10**9 + 1),
+    (256, "packed", (1, 1), 10**9 + 2),
+    (256, "packed", (2, 1), 10**9 + 3),
+    (256, "pallas-packed", (1, 1), 10**9 + 4),
+    (256, "pallas-packed", (2, 1), 10**9 + 5),
+    (512, "pallas-packed", (2, 1), 10**9 + 6),
+]
+
+
+@pytest.mark.parametrize(
+    "size,engine,mesh,turns",
+    MATRIX,
+    ids=[f"{s}-{e}-{m[0]}x{m[1]}" for s, e, m, _ in MATRIX],
+)
+def test_compressed_matches_dense_oracle(tmp_path, size, engine, mesh, turns):
+    board = ash_board(size)
+    write_board(tmp_path / "images", board)
+    params = make_params(
+        tmp_path,
+        size,
+        turns=turns,
+        engine=engine,
+        mesh_shape=mesh,
+        turn_events="batch",
+    )
+    stream, tc = run_compressed(params)
+
+    cycles = [e for e in stream if isinstance(e, CycleDetected)]
+    assert len(cycles) == 1 and cycles[0].period == 6
+    # The skip did the work: billions of turns, a handful of dispatches.
+    assert tc["timecomp.skipped_turns"] > turns - 10_000
+    assert tc["timecomp.skips"] >= 1
+    # Entry + exit guard both ran, neither mismatched.
+    assert tc["timecomp.guard_checks"] >= 1
+    assert tc.get("timecomp.guard_mismatches", 0) == 0
+
+    # Batch stream is contiguous 1..turns.
+    ranges = [
+        (e.first_turn, e.completed_turns)
+        for e in stream
+        if isinstance(e, TurnsCompleted)
+    ]
+    assert ranges[0][0] == 1 and ranges[-1][1] == turns
+    for (_, l0), (f1, _) in zip(ranges, ranges[1:]):
+        assert f1 == l0 + 1
+
+    expected = expected_final(board, turns)
+    final = [e for e in stream if isinstance(e, FinalTurnComplete)][0]
+    assert final.completed_turns == turns
+    assert set(final.alive) == alive_set(expected)
+    out = pgm.read_pgm(tmp_path / f"{size}x{size}x{turns}.pgm")
+    assert np.array_equal(out, expected), (
+        f"{engine} {mesh}: compressed final board differs from dense oracle"
+    )
+
+
+def test_per_turn_stream_stays_dense(tmp_path):
+    """Per-turn mode under compression still emits every TurnComplete
+    1..turns — compression changes launches, never the event contract."""
+    size, turns = 256, 60_000
+    board = ash_board(size)
+    write_board(tmp_path / "images", board)
+    params = make_params(tmp_path, size, turns=turns)
+    events: queue.Queue = queue.Queue()
+    gol.run(params, events)
+    stream = drain(events)
+    assert any(isinstance(e, CycleDetected) for e in stream)
+    tcs = [e.completed_turns for e in stream if isinstance(e, TurnComplete)]
+    assert tcs == list(range(1, turns + 1))
+    final = [e for e in stream if isinstance(e, FinalTurnComplete)][0]
+    expected = expected_final(board, turns)
+    assert final.completed_turns == turns
+    assert set(final.alive) == alive_set(expected)
+
+
+# -- checkpoint/resume truthfulness --------------------------------------------
+
+def test_detach_sidecar_splits_computed_from_effective(tmp_path):
+    """'q' during per-turn fast-forward parks a checkpoint whose sidecar
+    distinguishes dispatched work (computed_turns) from delivered turns
+    (effective_turns); the resumed run restores the split and lands on
+    the dense oracle."""
+    # 10**6 keeps ~15 fast-forward chunk boundaries (key polls) after
+    # detection while the per-turn queue traffic stays tier-1-cheap.
+    size, turns = 256, 10**6
+    board = ash_board(size)
+    write_board(tmp_path / "images", board)
+    ckpt_dir = tmp_path / "ckpts"
+    session = Session(ckpt_dir)
+    params = make_params(tmp_path, size, turns=turns)
+    events: queue.Queue = queue.Queue()
+    keys: queue.Queue = queue.Queue()
+    t = gol.start(params, events, keys, session)
+    saw_cycle = False
+    while (e := events.get(timeout=120)) is not None:
+        if isinstance(e, CycleDetected) and not saw_cycle:
+            saw_cycle = True
+            keys.put("q")
+    t.join(timeout=120)
+    assert saw_cycle
+
+    meta = json.loads((ckpt_dir / "checkpoint.json").read_text())
+    assert meta["paused"] is True
+    assert meta["effective_turns"] == meta["turn"]
+    assert 0 < meta["computed_turns"] < meta["effective_turns"]
+    # The dispatched side is bounded by the settle horizon (plus probe
+    # cadence slack), not by the billions delivered.
+    assert meta["computed_turns"] < 10_000
+    # The parked world is the exact phase board for the detach turn.
+    world = pgm.read_pgm(ckpt_dir / "checkpoint.pgm")
+    assert np.array_equal(world, expected_final(board, meta["turn"]))
+
+    # Resume from disk: the rest of the run compresses and the final
+    # board is the oracle's.
+    events2: queue.Queue = queue.Queue()
+    resumed = Session(ckpt_dir)
+    before = obs_metrics.REGISTRY.snapshot()
+    gol.run(params, events2, session=resumed)
+    delta = obs_metrics.REGISTRY.snapshot().delta(before).to_dict()["counters"]
+    stream = drain(events2, keep_turn_completes=False)
+    final = [e for e in stream if isinstance(e, FinalTurnComplete)][0]
+    assert final.completed_turns == turns
+    assert set(final.alive) == alive_set(expected_final(board, turns))
+    assert delta["timecomp.skipped_turns"] > 0
+
+
+def test_default_off_runs_dense_with_no_tier_footprint(tmp_path):
+    """The byte-identity pin: with time_compression off (the default) the
+    tier must leave NO trace — no timecomp counters registered against
+    the run, no sidecar fields in the detach checkpoint, and the legacy
+    cycle fast-forward still delivers the exact board."""
+    size, turns = 256, 10**7
+    board = ash_board(size)
+    write_board(tmp_path / "images", board)
+    ckpt_dir = tmp_path / "ckpts"
+    params = make_params(tmp_path, size, turns=turns, time_compression=False)
+    assert timecomp_lib.maybe_create(params, None, None) is None
+    session = Session(ckpt_dir)
+    events: queue.Queue = queue.Queue()
+    keys: queue.Queue = queue.Queue()
+    before = obs_metrics.REGISTRY.snapshot()
+    t = gol.start(params, events, keys, session)
+    saw_cycle = False
+    while (e := events.get(timeout=120)) is not None:
+        # The pre-existing whole-board fast-forward still runs — detach
+        # mid-emission exactly like the compressed twin of this test.
+        if isinstance(e, CycleDetected) and not saw_cycle:
+            saw_cycle = True
+            keys.put("q")
+    t.join(timeout=120)
+    assert saw_cycle
+    delta = obs_metrics.REGISTRY.snapshot().delta(before).to_dict()["counters"]
+    assert not any(k.startswith("timecomp.") for k in delta), delta
+    # The 'q' sidecar of a dense run is byte-for-byte the pre-PR-16
+    # shape: no effective-vs-computed split fields.
+    meta = json.loads((ckpt_dir / "checkpoint.json").read_text())
+    assert meta["paused"] is True and meta["turn"] > 0
+    assert "computed_turns" not in meta
+    assert "effective_turns" not in meta
+    world = pgm.read_pgm(ckpt_dir / "checkpoint.pgm")
+    assert np.array_equal(world, expected_final(board, meta["turn"]))
+
+
+# -- supervisor restart --------------------------------------------------------
+
+def test_supervisor_restart_preserves_exactness(tmp_path):
+    """A terminal fault burst during the dense phase forces a supervisor
+    rollback + backend rebuild; the fresh controller re-proves the ash
+    through its own guard and the compressed run still lands
+    bit-identically on the dense oracle."""
+    size, turns = 256, 10**9 + 1
+    board = ash_board(size)
+    write_board(tmp_path / "images", board)
+    params = make_params(
+        tmp_path,
+        size,
+        turns=turns,
+        engine="packed",
+        turn_events="batch",
+        checkpoint_every_turns=4,
+        restart_limit=2,
+    )
+    plan = FaultPlan([Fault(2, "issue"), Fault(3, "issue")])
+
+    def factory(p, attempt):
+        backend = Backend(p)
+        return FaultInjectionBackend(backend, plan) if attempt == 0 else backend
+
+    events: queue.Queue = queue.Queue()
+    before = obs_metrics.REGISTRY.snapshot()
+    gol.run(params, events, backend_factory=factory)
+    delta = obs_metrics.REGISTRY.snapshot().delta(before).to_dict()["counters"]
+    stream = drain(events)
+    errors = [e for e in stream if isinstance(e, DispatchError)]
+    assert [e.will_retry for e in errors] == [True, False]
+    assert delta["supervisor.restarts"] == 1
+    assert delta["timecomp.skipped_turns"] > turns - 10_000
+    final = [e for e in stream if isinstance(e, FinalTurnComplete)][0]
+    assert final.completed_turns == turns
+    assert set(final.alive) == alive_set(expected_final(board, turns))
+
+
+# -- rung 2: the ash cache -----------------------------------------------------
+
+class TestAshCache:
+    def test_lru_eviction_and_counters(self):
+        cache = timecomp_lib.AshCache(slots=2)
+        e = timecomp_lib.AshEntry(1, (7,))
+        cache.put(("a",), e)
+        cache.put(("b",), e)
+        assert cache.get(("a",)) is e  # refreshes 'a': 'b' is now LRU
+        cache.put(("c",), e)  # evicts 'b'
+        assert len(cache) == 2 and cache.evictions == 1
+        assert cache.get(("b",)) is None
+        assert cache.get(("a",)) is e and cache.get(("c",)) is e
+        assert cache.hits == 3 and cache.misses == 1
+
+    def test_put_honours_smallest_requested_bound(self):
+        cache = timecomp_lib.AshCache(slots=8)
+        e = timecomp_lib.AshEntry(1, (0,))
+        for i in range(5):
+            cache.put((i,), e)
+        assert len(cache) == 5
+        cache.put((5,), e, slots=2)  # a stricter caller shrinks the bound
+        assert len(cache) == 2 and cache.evictions == 4
+
+    def test_entry_validates_phase_count_length(self):
+        with pytest.raises(ValueError):
+            timecomp_lib.AshEntry(6, (1, 2, 3))
+
+    def test_collision_cross_check_recaptures(self, tmp_path):
+        """A cache hit whose stored counts disagree with the board's own
+        popcount is a fingerprint collision: dropped, recaptured, counted
+        as a miss — never trusted into output."""
+        params = make_params(tmp_path, 16)
+        tc = timecomp_lib.maybe_create(
+            params,
+            obs_metrics.registry_for(True),
+            flight_lib.FlightRecorder(16),
+        )
+        assert tc is not None and tc.period == 6
+        key = tc.cache_key(fingerprint=0xDEAD, popcount=7)
+        # Poison the cache: right key, wrong counts (counts[p-1] != pop).
+        timecomp_lib.CACHE.put(key, timecomp_lib.AshEntry(6, (9,) * 6))
+        captured = tc.resolve_counts(key, popcount=7, capture=lambda: [7] * 6)
+        assert captured == [7] * 6
+        # The poisoned entry was replaced by the fresh capture...
+        entry = timecomp_lib.CACHE.get(key)
+        assert entry is not None and entry.counts == (7,) * 6
+        # ...and a subsequent agreeing hit is served from cache.
+        assert tc.resolve_counts(
+            key, popcount=7, capture=lambda: pytest.fail("must not recapture")
+        ) == [7] * 6
+
+
+def test_cache_recognizes_ash_across_runs(tmp_path):
+    """Rung 2 end-to-end: the SECOND run of the same settled board is
+    recognized from the process-wide cache by its device-computed
+    identity — a hit, zero misses — without refetching board bytes."""
+    size = 256
+    board = ash_board(size)
+    write_board(tmp_path / "images", board)
+    timecomp_lib.CACHE.clear()
+    params = make_params(tmp_path, size, turns=10**6, turn_events="batch")
+    _, tc1 = run_compressed(params)
+    assert tc1["timecomp.cache_misses"] >= 1
+    _, tc2 = run_compressed(params)
+    assert tc2["timecomp.cache_hits"] >= 1
+    assert tc2.get("timecomp.cache_misses", 0) == 0
+
+
+# -- satellites ----------------------------------------------------------------
+
+def test_ash_period_is_rule_data_not_an_assumption():
+    assert CONWAY.ash_period == 6
+    assert parse_rule("B36/S23").ash_period == 6  # highlife, by contents
+    assert parse_rule("B2/S23").ash_period is None
+    # Backend probe depth comes from the rule (legacy 6 when unknown).
+    p = gol.Params(turns=8, image_width=16, image_height=16)
+    assert Backend(p).cycle_period == 6
+
+
+def test_unknown_rule_warns_once_and_runs_dense(tmp_path):
+    rule = parse_rule("B2/S23")
+    params = make_params(tmp_path, 16, rule=rule)
+    with timecomp_lib._warned_lock:
+        timecomp_lib._warned_rules.discard(rule.notation)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert timecomp_lib.maybe_create(params, None, None) is None
+        assert timecomp_lib.maybe_create(params, None, None) is None
+    scoped = [x for x in w if issubclass(x.category, RuntimeWarning)]
+    assert len(scoped) == 1
+    assert "no known ash period" in str(scoped[0].message)
+    assert rule.notation in str(scoped[0].message)
+
+
+def test_committed_timecomp_artifact_parses_and_self_gates():
+    """The recorded BENCH_TIMECOMP_PR16.json is lint-clean, carries the
+    effective-vs-computed split the stats lint demands of any
+    'effective'-unit row, clears the 10x acceptance floor, and survives
+    the bench gate against itself."""
+    from distributed_gol_tpu.utils import measure
+    from tools import bench_gate
+
+    record = json.loads((REPO / "BENCH_TIMECOMP_PR16.json").read_text())
+    assert measure.check_headline_stats(record) == []
+    assert obs_metrics.check_embedded_metrics(record) == []
+    assert "effective" in record["unit"]
+    assert record["computed_turns"] < record["effective_turns"]
+    assert record["speedup"] >= 10
+    assert record["dense"]["median"] > 0
+    regressions, _ = bench_gate.compare(record, record)
+    assert regressions == []
+    # Both headline rows (effective + dense) are gateable.
+    assert len(bench_gate.headline_rows(record)) >= 2

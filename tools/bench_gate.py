@@ -26,6 +26,13 @@ Usage:
     python bench.py --pilot > fresh.json
     python tools/bench_gate.py fresh.json BENCH_PILOT_PR3.json
     python tools/bench_gate.py fresh.json baseline.json --margin 0.1
+    python bench.py --timecomp > fresh.json
+    python tools/bench_gate.py fresh.json BENCH_TIMECOMP_PR16.json
+
+The time-compression artifact (ISSUE 16) gates on BOTH sides of its
+record: the effective-rate headline row and its nested dense sub-row
+each carry a ``metric`` name, so a regression in either the skip
+machinery or the underlying dispatch rate trips the gate independently.
 """
 
 from __future__ import annotations

@@ -167,6 +167,30 @@ def _d_slo_resolved(r):
     )
 
 
+def _d_timecomp_skip(r):
+    return (
+        f"time-compression skip: turns {r.get('first', '?')}.."
+        f"{r.get('last', '?')} ({r.get('turns', '?')} generations) "
+        "delivered with zero device launches"
+    )
+
+
+def _d_timecomp_guard_mismatch(r):
+    return (
+        f"time-compression GUARD MISMATCH at turn {r.get('turn', '?')}: "
+        "independent-stencil re-derivation disagrees — falling back to "
+        "dense replay from the last verified turn"
+    )
+
+
+def _d_timecomp_dense_replay(r):
+    return (
+        f"time-compression dense replay from turn {r.get('turn', '?')}: "
+        "interval recomputed by real dispatches (exactness guard refused "
+        "the fast-forward)"
+    )
+
+
 _DESCRIBE = {
     "restart": _d_restart,
     "supervisor_exhausted": _d_supervisor_exhausted,
@@ -181,6 +205,9 @@ _DESCRIBE = {
     "preempt_save_skipped": _d_preempt_save_skipped,
     "slo_alert": _d_slo_alert,
     "slo_resolved": _d_slo_resolved,
+    "timecomp_skip": _d_timecomp_skip,
+    "timecomp_guard_mismatch": _d_timecomp_guard_mismatch,
+    "timecomp_dense_replay": _d_timecomp_dense_replay,
 }
 
 
