@@ -1364,6 +1364,261 @@ def bench_gateway(
     return record
 
 
+def bench_federation(reps: int = 3, ops: int = 20, size: int = 64) -> dict:
+    """ISSUE 17: the federation tier's two cost questions, interleaved
+    per rep (``utils/measure.py`` discipline — a rig phase change cannot
+    masquerade as broker overhead OR as failover latency):
+
+    - **Placement overhead**: ``GET state`` straight at the owning pod's
+      gateway vs through the broker's proxy hop, same loopback rig —
+      the steady-state price of fronting the fleet.
+    - **Failover MTTR**: a REAL subprocess pod (the only honest SIGKILL
+      target) owns a checkpointing session; per rep the pod is
+      SIGKILLed and the clock runs from the kill to the first resolved
+      dispatch past the adopted checkpoint turn on the surviving pod —
+      probe detection + condemnation + durable re-adoption + resume,
+      end to end.  Thresholds are dialed tight (probe 0.1 s, 2 misses)
+      so the record measures the machinery, not the default timers; the
+      ``detect`` share is recorded beside the headline.
+
+    The victim pod runs ``JAX_PLATFORMS=cpu`` (the bench process owns
+    any accelerator) — the engine work is a 64² roll board, so the MTTR
+    is broker/checkpoint machinery, not device time.
+    """
+    import os
+    import subprocess
+    import tempfile
+    import threading
+    from pathlib import Path
+
+    from distributed_gol_tpu.obs import metrics as obs_metrics
+    from distributed_gol_tpu.serve import (
+        Broker,
+        BrokerConfig,
+        GatewayServer,
+        ServeConfig,
+        ServePlane,
+    )
+    from distributed_gol_tpu.serve.broker import scan_resumable
+    from distributed_gol_tpu.utils import measure
+    from tools.gol_client import GolClient
+
+    out_root = Path(tempfile.mkdtemp(prefix="gol_bench_federation_"))
+    reg = obs_metrics.REGISTRY
+    repo = Path(__file__).resolve().parent
+
+    def spec(tenant: str, checkpoint_every: int = 0) -> dict:
+        params = {
+            "width": size, "height": size, "turns": 10**9,
+            "engine": "roll", "superstep": 4, "cycle_check": 0,
+            "ticker_period": 60.0,
+        }
+        if checkpoint_every:
+            params["checkpoint_every_turns"] = checkpoint_every
+        return {
+            "tenant": tenant,
+            "params": params,
+            "soup": {"density": 0.3, "seed": 7},
+        }
+
+    def start_pod(root: Path) -> tuple[subprocess.Popen, str]:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "distributed_gol_tpu", "serve",
+                "--gateway-port", "0",
+                "--checkpoint-root", str(root),
+                "--telemetry-sample-seconds", "0.1",
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
+            env=env,
+            text=True,
+            cwd=str(repo),
+        )
+        lines: list[str] = []
+        threading.Thread(
+            target=lambda: lines.extend(proc.stderr), daemon=True
+        ).start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            for ln in list(lines):
+                if "gateway: " in ln and "/v1/sessions" in ln:
+                    url = ln.split("gateway: ", 1)[1].split(
+                        "/v1/sessions", 1
+                    )[0]
+                    return proc, url
+            if proc.poll() is not None:
+                break
+            time.sleep(0.05)
+        proc.kill()
+        proc.wait(timeout=10)
+        raise RuntimeError("subprocess pod never printed its gateway URL")
+
+    def wait_until(predicate, timeout: float, what: str):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            got = predicate()
+            if got:
+                return got
+            time.sleep(0.02)
+        raise RuntimeError(f"bench_federation: timed out on {what}")
+
+    # -- steady-state rig: one pod, one broker, one long-lived session ------
+    plane = ServePlane(
+        ServeConfig(max_sessions=2), checkpoint_root=out_root / "steady"
+    )
+    gateway = GatewayServer(plane, port=0)
+    broker = Broker(
+        [gateway.url],
+        BrokerConfig(probe_interval_seconds=0.1),
+        port=0,
+    )
+    direct = GolClient(gateway.url)
+    brokered = GolClient(broker.url)
+
+    def failover_rep(rep: int) -> tuple[float, float]:
+        """One kill cycle; returns (mttr_s, detect_s)."""
+        root = out_root / f"mttr-{rep}"
+        tenant = f"mttr-{rep}"
+        proc, pod_a = start_pod(root)
+        plane_b = ServePlane(
+            ServeConfig(max_sessions=4, max_total_cells=300_000),
+            checkpoint_root=root,
+        )
+        gw_b = GatewayServer(plane_b, port=0)
+        fleet = Broker(
+            [pod_a, gw_b.url],
+            BrokerConfig(
+                probe_interval_seconds=0.1,
+                probe_miss_threshold=2,
+                checkpoint_root=root,
+            ),
+            port=0,
+        )
+        try:
+            wait_until(
+                lambda: all(p["ready"] for p in fleet.pod_states()),
+                30, "fleet ready",
+            )
+            GolClient(fleet.url)._request(
+                "POST", "/v1/sessions", spec(tenant, checkpoint_every=16)
+            )
+            assert fleet.placement(tenant) == pod_a, (
+                "victim pod did not win placement"
+            )
+            wait_until(
+                lambda: scan_resumable(root).get(tenant, {}).get("turn", 0)
+                >= 16,
+                60, "a durable checkpoint on the victim",
+            )
+            proc.kill()  # SIGKILL — the pod_down chaos semantics
+            t0 = time.perf_counter()
+            adopted_turn = scan_resumable(root)[tenant]["turn"]
+            detect = wait_until(
+                lambda: (
+                    time.perf_counter() - t0
+                    if any(
+                        p["condemned"] for p in fleet.pod_states()
+                    )
+                    else None
+                ),
+                30, "condemnation",
+            )
+            mttr = wait_until(
+                lambda: (
+                    time.perf_counter() - t0
+                    if (h := plane_b.handle(tenant)) is not None
+                    and h.last_turn > adopted_turn
+                    else None
+                ),
+                60, "first resolved dispatch on the survivor",
+            )
+            GolClient(gw_b.url).quit(tenant)
+            handle = plane_b.handle(tenant)
+            if handle is not None:
+                handle.wait(timeout=60)
+            return mttr, detect
+        finally:
+            fleet.close()
+            gw_b.close()
+            plane_b.close()
+            proc.kill()
+            proc.wait(timeout=10)
+
+    try:
+        ctl = "fed-ctl"
+        wait_until(
+            lambda: all(p["ready"] for p in broker.pod_states()),
+            30, "steady-state broker ready",
+        )
+        brokered._request("POST", "/v1/sessions", spec(ctl))
+        direct_rates, broker_rates = [], []
+        mttrs, detects = [], []
+        for rep in range(max(1, reps)):
+            t0 = time.perf_counter()
+            for _ in range(ops):
+                direct.state(ctl)
+            direct_rates.append(ops / (time.perf_counter() - t0))
+            t0 = time.perf_counter()
+            for _ in range(ops):
+                brokered.state(ctl)
+            broker_rates.append(ops / (time.perf_counter() - t0))
+            mttr, detect = failover_rep(rep)
+            mttrs.append(mttr)
+            detects.append(detect)
+        brokered.quit(ctl)
+        h = plane.handle(ctl)
+        if h is not None:
+            h.wait(timeout=60)
+    finally:
+        broker.close()
+        gateway.close()
+        plane.close()
+
+    record = {
+        "bench": "federation",
+        "size": size,
+        "ops_per_rep": ops,
+        "control": {
+            "direct": {
+                "metric": "gol_federation_control_direct",
+                "unit": "ops/s",
+                **measure.summarize(direct_rates),
+            },
+            "brokered": {
+                "metric": "gol_federation_control_broker",
+                "unit": "ops/s",
+                **measure.summarize(broker_rates),
+            },
+            "broker_hop_ms": (
+                1e3 / measure.median(broker_rates)
+                - 1e3 / measure.median(direct_rates)
+            ),
+        },
+        "failover": {
+            "mttr": {
+                "metric": "gol_federation_failover_mttr",
+                "unit": "seconds",
+                **measure.summarize(mttrs),
+            },
+            "detect_s": measure.median(detects),
+            "probe_interval_s": 0.1,
+            "probe_miss_threshold": 2,
+            "checkpoint_every_turns": 16,
+        },
+        "metrics": reg.snapshot(include_lazy=False).to_dict(),
+    }
+    log(
+        f"  federation: control {measure.median(direct_rates):,.0f} ops/s "
+        f"direct vs {measure.median(broker_rates):,.0f} brokered "
+        f"(hop +{record['control']['broker_hop_ms']:.2f} ms); failover "
+        f"MTTR {measure.median(mttrs):.3f} s "
+        f"(detect {measure.median(detects):.3f} s) over {len(mttrs)} kills"
+    )
+    return record
+
+
 def _bench_serve_impl(
     n_max: int,
     size: int,
@@ -1939,6 +2194,17 @@ def main():
         help="wire spectator count for --gateway",
     )
     ap.add_argument(
+        "--federation",
+        action="store_true",
+        help="federation-broker mode (ISSUE 17): interleaved per-rep "
+        "A/B of direct vs brokered control ops (the placement-proxy "
+        "hop) beside a failover-MTTR arm — a real subprocess pod is "
+        "SIGKILLed each rep and the clock runs from the kill to the "
+        "first resolved dispatch past the adopted checkpoint turn on "
+        "the surviving pod.  Prints one lint-checked JSON line and "
+        "exits (BENCH_FEDERATION artifact).",
+    )
+    ap.add_argument(
         "--faults",
         metavar="PLAN",
         default=None,
@@ -2082,6 +2348,16 @@ def main():
             spectators=args.gateway_spectators,
             reps=max(args.reps, 5),
         )
+        measure.require_headline_stats(record)
+        obs_metrics.require_embedded_metrics(record)
+        print(json.dumps(record))
+        return
+
+    if args.federation:
+        # The broker never touches a device and the victim pod is its
+        # own (cpu) process — board size is fixed small by design.
+        record = bench_federation(reps=max(args.reps, 3))
+        record["platform"] = dev.platform
         measure.require_headline_stats(record)
         obs_metrics.require_embedded_metrics(record)
         print(json.dumps(record))

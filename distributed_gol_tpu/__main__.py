@@ -588,12 +588,100 @@ def serve_main(argv) -> int:
     return 1 if bad else 0
 
 
+def broker_main(argv) -> int:
+    """The ``broker`` subcommand (ISSUE 17): front N gateway pods with
+    the health-probed federation tier — tenant placement by live
+    capacity, pod condemnation on probe misses, checkpoint-driven
+    failover and live migration (docs/API.md "Federation").  The broker
+    process never touches a device: importable and runnable on a
+    machine with no accelerator at all."""
+    import time
+
+    from distributed_gol_tpu.serve.broker import Broker, BrokerConfig
+
+    ap = argparse.ArgumentParser(
+        prog="distributed_gol_tpu broker",
+        description="pod-federation broker: health-probed placement, "
+        "failover, live migration over N serving pods",
+    )
+    ap.add_argument("--pod", action="append", default=[], metavar="URL",
+                    help="one pod gateway endpoint (repeatable), e.g. "
+                    "http://127.0.0.1:9191 — the URL a pod's serve "
+                    "--gateway-port printed")
+    ap.add_argument("--port", type=int, default=0,
+                    help="broker bind port (0 = ephemeral; the bound "
+                    "URL is printed to stderr and published as the "
+                    "broker.endpoint info label)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--checkpoint-root", default=None, metavar="DIR",
+                    help="the SHARED checkpoint root every pod mounts — "
+                    "what failover scans for adoptable durable state")
+    ap.add_argument("--probe-interval", type=float, default=0.5,
+                    help="health-probe cadence per pod (seconds)")
+    ap.add_argument("--probe-timeout", type=float, default=2.0,
+                    help="per-probe answer budget (seconds)")
+    ap.add_argument("--probe-miss-threshold", type=int, default=3,
+                    help="consecutive misses that condemn a pod")
+    ap.add_argument("--rejoin-threshold", type=int, default=2,
+                    help="consecutive healthy probes that readmit a "
+                    "condemned pod to the placement ring")
+    ap.add_argument("--no-failover", action="store_true",
+                    help="condemn-and-route-around only: leave a dead "
+                    "pod's tenants for an operator (POST /v1/recover)")
+    ap.add_argument("--recover", action="store_true",
+                    help="at startup, sweep the shared root for orphaned "
+                    "resumable checkpoints no live pod claims and "
+                    "readopt them onto the fleet")
+    args = ap.parse_args(argv)
+    if not args.pod:
+        ap.error("a broker needs at least one --pod URL")
+    try:
+        config = BrokerConfig(
+            probe_interval_seconds=args.probe_interval,
+            probe_timeout_seconds=args.probe_timeout,
+            probe_miss_threshold=args.probe_miss_threshold,
+            rejoin_threshold=args.rejoin_threshold,
+            checkpoint_root=args.checkpoint_root,
+            failover=not args.no_failover,
+        )
+    except ValueError as e:
+        ap.error(str(e))
+    broker = Broker(args.pod, config, port=args.port, host=args.host)
+    print(
+        f"broker: {broker.url}/v1/sessions fronting {len(args.pod)} "
+        f"pod(s) (fleet: {broker.url}/v1/pods; drive with "
+        f"tools/gol_client.py {broker.url})",
+        file=sys.stderr,
+    )
+    try:
+        if args.recover:
+            broker.probe_once()  # placement needs at least one health
+            import json as json_mod
+            import urllib.request
+
+            req = urllib.request.Request(
+                broker.url + "/v1/recover", method="POST"
+            )
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                out = json_mod.loads(resp.read())
+            print(f"recover: {out}", file=sys.stderr)
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        broker.close()
+    return 0
+
+
 def main(argv=None) -> int:
     honour_env_platforms()
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "serve":
         return serve_main(argv[1:])
+    if argv and argv[0] == "broker":
+        return broker_main(argv[1:])
     ap = build_parser()
     args = ap.parse_args(argv)
     try:

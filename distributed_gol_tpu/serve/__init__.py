@@ -15,12 +15,18 @@ from distributed_gol_tpu.serve.admission import (
     ServeConfig,
 )
 from distributed_gol_tpu.serve.batcher import CohortBatcher, cohort_key
+from distributed_gol_tpu.serve.broker import Broker, BrokerConfig
 from distributed_gol_tpu.serve.frames import FramePlane, FrameSubscriber
 from distributed_gol_tpu.serve.gateway import (
     GatewayServer,
     serve_plane_gateway,
 )
 from distributed_gol_tpu.serve.plane import ServePlane, SessionHandle
+from distributed_gol_tpu.serve.podclient import (
+    PodClient,
+    PodHTTPError,
+    PodUnreachable,
+)
 from distributed_gol_tpu.serve.telemetry import (
     TelemetryServer,
     serve_plane_telemetry,
@@ -29,10 +35,15 @@ from distributed_gol_tpu.serve.telemetry import (
 __all__ = [
     "AdmissionController",
     "AdmissionRejected",
+    "Broker",
+    "BrokerConfig",
     "CohortBatcher",
     "FramePlane",
     "FrameSubscriber",
     "GatewayServer",
+    "PodClient",
+    "PodHTTPError",
+    "PodUnreachable",
     "ServeConfig",
     "ServePlane",
     "SessionHandle",

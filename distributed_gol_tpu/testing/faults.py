@@ -50,6 +50,18 @@ Fault kinds:
   the recovery the elastic ladder is asserted against.
   JSON-schedulable like ``corrupt``:
   ``{"at": 2, "kind": "device_down", "device": 3}``.
+- ``pod_down`` — a dead (or partitioned) POD, not a device: the ISSUE 17
+  federation chaos kind.  ``at`` is a TURN threshold, not a dispatch
+  index (pod chaos is scripted against observed session progress — the
+  broker tier has no dispatch counter to index by); ``device`` names the
+  pod (an index into the chaos driver's pod list); ``seconds == 0`` is a
+  SIGKILL (permanent death — the failover leg's trigger), ``seconds > 0``
+  a SIGSTOP/SIGCONT partition that heals after that long (the
+  condemned-then-recovered rejoin leg).  Driven by :class:`PodChaos`
+  against real child pod processes; handing a pod_down-bearing plan to
+  :class:`FaultInjectionBackend` (the dispatch seam) is a test-harness
+  bug and is rejected at construction, exactly like ``flood``.
+  JSON-schedulable: ``{"at": 12, "kind": "pod_down", "device": 0}``.
 - ``flood`` — a misbehaving TENANT, not a misbehaving device: at step
   ``at`` of a scripted submission schedule, ``cells`` back-to-back
   session submissions are fired at the serving plane's admission seam
@@ -87,6 +99,7 @@ import numpy as np
 
 FAULT_KINDS = (
     "issue", "resolve", "latency", "hang", "corrupt", "flood", "device_down",
+    "pod_down",
 )
 
 # Injected hangs self-release after this long if nothing (watchdog, test
@@ -262,6 +275,11 @@ class FaultInjectionBackend:
             raise ValueError(
                 "flood faults target the serving plane's admission seam "
                 "(testing.faults.FloodTenant), not the dispatch seam"
+            )
+        if any(f.kind == "pod_down" for f in plan.faults):
+            raise ValueError(
+                "pod_down faults target child pod processes "
+                "(testing.faults.PodChaos), not the dispatch seam"
             )
         self._inner = inner
         self.plan = plan
@@ -439,3 +457,132 @@ class FloodTenant:
         for _, verdict in self.outcomes:
             tally[verdict] += 1
         return tally
+
+
+class PodChaos:
+    """The ``pod_down`` fault kind's driver (ISSUE 17): kill or
+    partition real child pod processes at scripted TURN thresholds.
+
+    ``pods`` is an ordered list of process handles (anything with
+    ``pid`` and ``poll()`` — ``subprocess.Popen`` is the intended
+    shape); a fault's ``device`` field indexes into it.  ``turn_fn``
+    reports the watched session's observed progress (typically a
+    closure over a broker/gateway state poll); :meth:`maybe_fire` is
+    the deterministic seam — tests call it with each observed turn, or
+    :meth:`watch` polls ``turn_fn`` from a daemon thread at a bounded
+    cadence for end-to-end runs.
+
+    Firing semantics per fault, once each, in ``at`` order:
+
+    - ``seconds == 0``: ``SIGKILL`` — permanent pod death, no shutdown
+      hooks, no drain: the ONLY durable state left is what the pod's
+      sessions had already checkpointed (sidecars persist paused=True,
+      so a kill mid-run leaves adoptable state — exactly what the
+      broker's failover leg is asserted against).
+    - ``seconds > 0``: ``SIGSTOP`` now, ``SIGCONT`` after ``seconds``
+      (a timer thread) — a network-partition stand-in: the pod stops
+      answering probes, gets condemned, then heals and rejoins.
+
+    ``fired`` lists the faults that struck, ``(fault, turn)`` pairs —
+    the chaos-matrix assertion surface, like ``injected`` on the
+    dispatch harness."""
+
+    def __init__(self, pods: Sequence, plan: FaultPlan, turn_fn=None):
+        for f in plan.faults:
+            if f.kind != "pod_down":
+                continue
+            if f.device >= len(pods):
+                raise ValueError(
+                    f"pod_down fault names pod {f.device} but only "
+                    f"{len(pods)} pod(s) were handed to PodChaos"
+                )
+        self.pods = list(pods)
+        self.plan = plan
+        self.turn_fn = turn_fn
+        self.fired: list[tuple[Fault, int]] = []
+        self._pending = sorted(
+            (f for f in plan.faults if f.kind == "pod_down"),
+            key=lambda f: f.at,
+        )
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._timers: list[threading.Timer] = []
+
+    @property
+    def done(self) -> bool:
+        with self._lock:
+            return not self._pending
+
+    def maybe_fire(self, turn: int) -> list[Fault]:
+        """Fire every still-pending fault whose threshold has arrived
+        (``turn >= at``); returns the faults that struck this call."""
+        struck: list[Fault] = []
+        with self._lock:
+            while self._pending and turn >= self._pending[0].at:
+                struck.append(self._pending.pop(0))
+        for fault in struck:
+            self._strike(fault, turn)
+        return struck
+
+    def _strike(self, fault: Fault, turn: int) -> None:
+        import os
+        import signal
+
+        pod = self.pods[fault.device]
+        if pod.poll() is not None:
+            return  # already dead: a double-kill is a no-op, not a crash
+        if fault.seconds == 0:
+            os.kill(pod.pid, signal.SIGKILL)
+        else:
+            os.kill(pod.pid, signal.SIGSTOP)
+            timer = threading.Timer(
+                fault.seconds, self._heal, args=(pod,)
+            )
+            timer.daemon = True
+            timer.start()
+            self._timers.append(timer)
+        self.fired.append((fault, turn))
+
+    def _heal(self, pod) -> None:
+        import os
+        import signal
+
+        if pod.poll() is None:
+            try:
+                os.kill(pod.pid, signal.SIGCONT)
+            except ProcessLookupError:
+                pass
+
+    def watch(self, interval: float = 0.1) -> threading.Thread:
+        """Poll ``turn_fn`` from a daemon thread until every scripted
+        fault fired (or :meth:`stop`).  A ``turn_fn`` error is treated
+        as turn-unknown (no fire), never a crash — mid-failover the
+        watched tenant is legitimately unreachable for a beat."""
+        if self.turn_fn is None:
+            raise ValueError("watch() needs a turn_fn")
+
+        def loop():
+            while not self._stop.is_set() and not self.done:
+                try:
+                    turn = self.turn_fn()
+                except Exception:  # noqa: BLE001 — unreachable mid-failover
+                    turn = None
+                if turn is not None:
+                    self.maybe_fire(int(turn))
+                self._stop.wait(interval)
+
+        thread = threading.Thread(
+            target=loop, name="gol-pod-chaos", daemon=True
+        )
+        thread.start()
+        return thread
+
+    def stop(self) -> None:
+        """Halt the watcher and heal any still-partitioned pod (test
+        teardown must not leak a SIGSTOPped child)."""
+        self._stop.set()
+        for timer in self._timers:
+            timer.cancel()
+        for fault, _ in self.fired:
+            if fault.seconds > 0:
+                self._heal(self.pods[fault.device])

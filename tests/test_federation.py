@@ -1,0 +1,766 @@
+"""Pod federation chaos matrix (ISSUE 17).
+
+The broker tier's robustness legs, asserted hermetically on CPU over
+real loopback sockets:
+
+- **SIGKILL failover**: a REAL subprocess pod is SIGKILLed mid-run by
+  the ``pod_down`` chaos driver; the broker's prober condemns it, the
+  stranded tenant is re-adopted on a survivor from its newest intact
+  durable checkpoint and runs to completion BIT-IDENTICAL to a
+  fault-free oracle; the healthy pod's own tenant is undisturbed; the
+  failover is truthful in ``broker.failovers`` + the flight ring, and
+  the broker-side and pod-side spans share one trace id (one trace
+  across the hop).
+- **Drain migration under load**: ``POST /v1/migrate {"pod": ...}``
+  drains a pod while a tenant is computing — parked residents re-adopt
+  on the survivor (resumed bit-identical), the shed queued admission
+  spills to the survivor as a fresh submission, and new placements
+  route away from the draining pod.
+- **Condemn/rejoin + honest Retry-After**: a toggleable stub pod is
+  condemned after the miss threshold and rejoins after the healthy
+  streak; rejections carry Retry-After from real pod hints when pods
+  answered, and from fleet headroom when none could; the client's
+  bounded 429 backoff loop (``--retries``) lands the retried submit.
+- **Broker restart**: placements are soft state — a fresh broker
+  re-discovers residents from the pods' own session lists, and
+  ``POST /v1/recover`` re-adopts an orphaned checkpoint no live pod
+  claims, resumed exactly to its parked turn.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from distributed_gol_tpu.obs import metrics as obs_metrics
+from distributed_gol_tpu.serve import (
+    GatewayServer,
+    ServeConfig,
+    ServePlane,
+)
+from distributed_gol_tpu.serve import wire
+from distributed_gol_tpu.serve.broker import (
+    Broker,
+    BrokerConfig,
+    scan_resumable,
+)
+from distributed_gol_tpu.serve.httpd import StdlibHTTPServer, read_body
+from distributed_gol_tpu.serve.podclient import backoff_delay
+from distributed_gol_tpu.testing.faults import (
+    Fault,
+    FaultInjectionBackend,
+    FaultPlan,
+    PodChaos,
+)
+from tools.gol_client import GatewayError, GolClient
+
+W = H = 32
+SUPERSTEP = 4
+
+
+def spec_doc(turns: int, seed: int, checkpoint_every: int = 0) -> dict:
+    """One wire session spec (no tenant key — POST adds it)."""
+    params = {
+        "width": W, "height": H, "turns": turns, "engine": "roll",
+        "superstep": SUPERSTEP, "cycle_check": 0, "ticker_period": 60.0,
+    }
+    if checkpoint_every:
+        params["checkpoint_every_turns"] = checkpoint_every
+    return {"params": params, "soup": {"density": 0.3, "seed": seed}}
+
+
+def submit_via(client: GolClient, tenant: str, spec: dict) -> dict:
+    return client._request(
+        "POST", "/v1/sessions", {"tenant": tenant, **json.loads(json.dumps(spec))}
+    )
+
+
+def wait_for(predicate, timeout: float, what: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = predicate()
+        if got:
+            return got
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def broker_state(client: GolClient, tenant: str) -> dict | None:
+    """A state poll that tolerates the mid-failover gap (no placement /
+    pod unreachable for a beat)."""
+    try:
+        return client.state(tenant)
+    except (GatewayError, OSError):
+        return None
+
+
+def oracle_final(tmp_path: Path, tenant: str, spec: dict) -> np.ndarray:
+    """Fault-free oracle: the same spec through an undisturbed plane."""
+    params, _ = wire.params_from_spec(
+        tenant, json.loads(json.dumps(spec)), root=tmp_path / "oracle-up"
+    )
+    with ServePlane(
+        ServeConfig(max_sessions=1),
+        checkpoint_root=tmp_path / f"oracle-{tenant}",
+    ) as plane:
+        handle = plane.submit(tenant, params)
+        assert handle.wait(timeout=120)
+        assert handle.status == "completed"
+        return np.asarray(handle.final)
+
+
+def counter(name: str) -> float:
+    return (
+        obs_metrics.REGISTRY.snapshot().to_dict()["counters"].get(name, 0)
+    )
+
+
+# -- satellite units -----------------------------------------------------------
+
+
+class TestBackoffDelay:
+    def test_pr2_shape(self):
+        assert backoff_delay(1, 0.05, 1.0) == pytest.approx(0.05)
+        assert backoff_delay(2, 0.05, 1.0) == pytest.approx(0.1)
+        assert backoff_delay(3, 0.05, 1.0) == pytest.approx(0.2)
+
+    def test_capped(self):
+        assert backoff_delay(30, 0.05, 1.0) == 1.0
+
+
+class TestPodDownFaultKind:
+    def test_schedulable_like_device_down(self):
+        plan = FaultPlan.from_json(
+            '{"faults": [{"at": 12, "kind": "pod_down", "device": 1}]}'
+        )
+        (fault,) = plan.faults
+        assert (fault.at, fault.kind, fault.device) == (12, "pod_down", 1)
+
+    def test_dispatch_harness_refuses_pod_down(self):
+        plan = FaultPlan([Fault(0, "pod_down")])
+        with pytest.raises(ValueError, match="pod_down"):
+            FaultInjectionBackend(object(), plan)
+
+    def test_chaos_driver_validates_pod_index(self):
+        with pytest.raises(ValueError, match="only 1 pod"):
+            PodChaos([object()], FaultPlan([Fault(0, "pod_down", device=3)]))
+
+    def test_sigkill_and_partition_against_real_children(self):
+        procs = [
+            subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+            for _ in range(2)
+        ]
+        try:
+            chaos = PodChaos(
+                procs,
+                FaultPlan([
+                    Fault(10, "pod_down", device=0),  # SIGKILL
+                    Fault(20, "pod_down", device=1, seconds=2.0),  # partition
+                ]),
+            )
+            assert chaos.maybe_fire(5) == []
+            struck = chaos.maybe_fire(25)  # both thresholds passed
+            assert len(struck) == 2 and chaos.done
+            wait_for(lambda: procs[0].poll() is not None, 10, "SIGKILL")
+            # The partitioned pod is stopped now and heals afterwards.
+            # (Poll, don't one-shot: on a loaded rig the process-table
+            # read can land after the SIGCONT timer.)
+            wait_for(
+                lambda: Path(f"/proc/{procs[1].pid}/stat")
+                .read_text().split()[2] == "T",
+                10, "partition should SIGSTOP",
+            )
+            wait_for(
+                lambda: Path(f"/proc/{procs[1].pid}/stat")
+                .read_text().split()[2] != "T",
+                10, "partition heal",
+            )
+            assert procs[1].poll() is None
+            assert [f.at for f, _ in chaos.fired] == [10, 20]
+            assert chaos.maybe_fire(99) == []  # nothing left to fire
+            chaos.stop()
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                p.wait(timeout=10)
+
+
+class TestFleetView:
+    def test_render_fleet_rows(self):
+        cur = {
+            "t": 10.0,
+            "health": {
+                "broker": True, "ready": True, "pods_ready": 1,
+                "pods_condemned": 1, "placements": 2,
+                "resident_sessions": 2, "queued_sessions": 1,
+                "resident_cells": 2048,
+                "pods": [
+                    {"endpoint": "http://a:1", "status": "ready",
+                     "condemned": False, "resident_sessions": 2,
+                     "queued_sessions": 1, "resident_cells": 2048,
+                     "effective_total_cells": 4096,
+                     "slo_alerting": ["latency"],
+                     "placed": ["alice", "bob"]},
+                    {"endpoint": "http://b:2", "status": "condemned",
+                     "condemned": True, "misses": 2,
+                     "resident_sessions": 0, "queued_sessions": 0,
+                     "resident_cells": 0},
+                ],
+            },
+        }
+        prev = json.loads(json.dumps(cur))
+        prev["t"] = 9.0
+        prev["health"]["pods"][0]["resident_cells"] = 1024
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+        from pod_top import render_fleet
+
+        out = render_fleet(cur, prev)
+        assert "http://a:1" in out and "http://b:2" in out
+        assert "condemned(2)" in out
+        assert "!latency" in out
+        assert "alice,bob" in out
+        assert "2,048/4,096 (50%)" in out
+        assert "1,024" in out  # cells/s from the two scrapes
+
+
+class TestBrokerConfigValidation:
+    def test_bad_thresholds_refused(self):
+        with pytest.raises(ValueError):
+            BrokerConfig(probe_miss_threshold=0)
+        with pytest.raises(ValueError):
+            BrokerConfig(probe_interval_seconds=0)
+
+
+# -- a toggleable stub pod (condemn/rejoin row; no jax) ------------------------
+
+
+class StubPod(StdlibHTTPServer):
+    """A pod-shaped HTTP server the test scripts: health toggles, and
+    POST /v1/sessions answers from a scripted queue."""
+
+    thread_name = "gol-stub-pod"
+
+    def __init__(self):
+        self.healthy = True
+        self.posts = 0
+        self.scripted: list[tuple[int, dict]] = []
+        super().__init__(port=0)
+
+    def handle(self, request, method, path, query):
+        if path == "/healthz" and method == "GET":
+            if not self.healthy:
+                request._send_json(503, {"error": "down"})
+                return True
+            request._send_json(200, {
+                "ready": True, "live": True, "degraded": False,
+                "draining": False, "devices_lost": 0,
+                "resident_sessions": 0, "queued_sessions": 0,
+                "resident_cells": 0,
+                "capacity": {"effective_total_cells": 1_000_000},
+                "slo": {"alerting": []}, "tenants": {},
+            })
+            return True
+        if path == "/v1/sessions" and method == "GET":
+            request._send_json(200, {"sessions": {}})
+            return True
+        if path == "/v1/sessions" and method == "POST":
+            doc = json.loads(read_body(request) or b"{}")
+            self.posts += 1
+            code, body = (
+                self.scripted.pop(0)
+                if self.scripted
+                else (201, {"tenant": doc.get("tenant"), "status": "running"})
+            )
+            headers = []
+            if code == 429 and "retry_after" in body:
+                headers = [("Retry-After", f"{body['retry_after']:g}")]
+            request._send_json(code, body, headers=headers)
+            return True
+        return False
+
+
+class TestCondemnRejoin:
+    def test_condemned_pod_rejoins_and_retry_after_is_honest(self, tmp_path):
+        stub = StubPod()
+        config = BrokerConfig(
+            probe_interval_seconds=60.0,  # probes are driven by hand
+            probe_miss_threshold=2,
+            rejoin_threshold=2,
+            checkpoint_root=tmp_path,
+            retry_after_seconds=1.0,
+        )
+        broker = Broker([stub.url], config=config)
+        client = GolClient(broker.url)
+        try:
+            broker.probe_once()
+            base_condemned = counter("broker.pods_condemned")
+            base_rejoined = counter("broker.pods_rejoined")
+
+            # A pod 429 hint propagates verbatim through the broker.
+            stub.scripted.append(
+                (429, {"error": "shed", "retry_after": 2.5})
+            )
+            with pytest.raises(GatewayError) as ei:
+                submit_via(client, "t1", spec_doc(100, 1))
+            assert ei.value.status == 429
+            assert ei.value.retry_after == pytest.approx(2.5)
+
+            # The client's bounded backoff loop lands the retried POST.
+            stub.scripted.append(
+                (429, {"error": "shed", "retry_after": 0.01})
+            )
+            posts_before = stub.posts
+            retrier = GolClient(broker.url, retries=2)
+            receipt = submit_via(retrier, "t2", spec_doc(100, 2))
+            assert receipt["pod"] == stub.url
+            assert stub.posts == posts_before + 2
+
+            # Miss-threshold condemnation mirrors the device blacklist.
+            stub.healthy = False
+            broker.probe_once()
+            broker.probe_once()
+            states = broker.pod_states()
+            assert states[0]["condemned"] and states[0]["misses"] == 2
+            assert counter("broker.pods_condemned") == base_condemned + 1
+            kinds = [r["kind"] for r in broker.flight.records()]
+            assert "pod_condemned" in kinds
+            # With no answering pod the Retry-After hint comes from the
+            # fleet's own recovery horizon, not a made-up constant.
+            with pytest.raises(GatewayError) as ei:
+                submit_via(client, "t3", spec_doc(100, 3))
+            assert ei.value.status == 429
+            horizon = config.probe_interval_seconds * (
+                config.probe_miss_threshold + config.rejoin_threshold
+            )
+            assert ei.value.retry_after == pytest.approx(
+                max(config.retry_after_seconds, horizon)
+            )
+
+            # A healthy streak past the threshold rejoins the pod.
+            stub.healthy = True
+            broker.probe_once()
+            assert broker.pod_states()[0]["condemned"]  # streak of 1
+            broker.probe_once()
+            assert not broker.pod_states()[0]["condemned"]
+            assert counter("broker.pods_rejoined") == base_rejoined + 1
+            assert "pod_rejoined" in [
+                r["kind"] for r in broker.flight.records()
+            ]
+            receipt = submit_via(client, "t4", spec_doc(100, 4))
+            assert receipt["pod"] == stub.url
+            assert broker.placement("t4") == stub.url
+        finally:
+            broker.close()
+            stub.close()
+
+
+# -- SIGKILL failover (subprocess pod + survivor) ------------------------------
+
+
+def start_subprocess_pod(root: Path) -> tuple[subprocess.Popen, str]:
+    """A REAL pod process (``serve --gateway-port 0``) on the shared
+    checkpoint root; returns (proc, gateway url) once the banner names
+    the bound endpoint."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "distributed_gol_tpu", "serve",
+            "--gateway-port", "0",
+            "--checkpoint-root", str(root),
+            "--telemetry-sample-seconds", "0.1",
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        env=env,
+        text=True,
+        cwd=str(Path(__file__).resolve().parent.parent),
+    )
+    lines: list[str] = []
+
+    def pump():
+        for line in proc.stderr:
+            lines.append(line)
+
+    threading.Thread(target=pump, daemon=True).start()
+    try:
+        url = wait_for(
+            lambda: next(
+                (
+                    ln.split("gateway: ", 1)[1].split("/v1/sessions", 1)[0]
+                    for ln in list(lines)
+                    if "gateway: " in ln and "/v1/sessions" in ln
+                ),
+                None,
+            ),
+            timeout=120,
+            what="subprocess pod gateway banner",
+        )
+    except BaseException:
+        proc.kill()
+        proc.wait(timeout=10)
+        raise
+    return proc, url
+
+
+class TestSigkillFailover:
+    def test_pod_sigkill_mid_run_fails_over_bit_identical(self, tmp_path):
+        root = tmp_path / "ckpt"
+        alice_spec = spec_doc(20_000, seed=5, checkpoint_every=16)
+        bob_spec = spec_doc(12_000, seed=9)
+
+        proc, pod_a = start_subprocess_pod(root)
+        plane_b = ServePlane(
+            ServeConfig(
+                max_sessions=4,
+                max_total_cells=300_000,  # A's bigger headroom wins placement
+                telemetry_sample_seconds=0.1,
+            ),
+            checkpoint_root=root,
+        )
+        gw_b = GatewayServer(plane_b, port=0)
+        broker = None
+        chaos = None
+        try:
+            # The survivor's own tenant, submitted before the broker
+            # exists — discovery must pick it up.
+            bob_params, _ = wire.params_from_spec(
+                "bob", json.loads(json.dumps(bob_spec)), root=tmp_path / "up"
+            )
+            bob_handle = plane_b.submit("bob", bob_params)
+
+            base_failovers = counter("broker.failovers")
+            base_condemned = counter("broker.pods_condemned")
+            broker = Broker(
+                [pod_a, gw_b.url],
+                BrokerConfig(
+                    probe_interval_seconds=0.1,
+                    probe_miss_threshold=2,
+                    checkpoint_root=root,
+                ),
+            )
+            client = GolClient(broker.url)
+            assert broker.placement("bob") == gw_b.url  # re-discovered
+            wait_for(
+                lambda: all(
+                    p["ready"] and p["status"] == "ready"
+                    for p in broker.pod_states()
+                ),
+                30, "both pods probed ready",
+            )
+
+            receipt = submit_via(client, "alice", alice_spec)
+            assert receipt["pod"] == pod_a, "headroom placement: A first"
+            assert receipt["broker_trace_id"]
+
+            # The chaos driver SIGKILLs the pod once alice crosses the
+            # scripted turn threshold — mid-run, no drain, no shutdown
+            # hooks.
+            chaos = PodChaos(
+                [proc],
+                FaultPlan([Fault(32, "pod_down", device=0)]),
+                turn_fn=lambda: (broker_state(client, "alice") or {}).get(
+                    "turn"
+                ),
+            )
+            chaos.watch(interval=0.05)
+            wait_for(lambda: chaos.done, 60, "scripted SIGKILL")
+            (fault, fired_turn) = chaos.fired[0]
+            assert fault.kind == "pod_down" and fired_turn >= 32
+            wait_for(lambda: proc.poll() is not None, 10, "pod death")
+
+            # Prober condemns; failover re-adopts alice on the survivor.
+            wait_for(
+                lambda: broker.placement("alice") == gw_b.url,
+                60, "failover placement",
+            )
+            assert counter("broker.pods_condemned") == base_condemned + 1
+            assert counter("broker.failovers") == base_failovers + 1
+            records = broker.flight.records()
+            condemned = [r for r in records if r["kind"] == "pod_condemned"]
+            assert condemned and condemned[0]["pod"] == pod_a
+            assert "alice" in condemned[0]["stranded"]
+            failover = [r for r in records if r["kind"] == "failover"][0]
+            assert failover["tenant"] == "alice"
+            assert failover["from_pod"] == pod_a
+            assert failover["to_pod"] == gw_b.url
+            assert failover["checkpoint_turn"] > 0
+            assert failover["checkpoint_turn"] % 16 == 0
+
+            st = wait_for(
+                lambda: (
+                    (s := broker_state(client, "alice"))
+                    and s["status"] in ("completed", "failed")
+                    and s
+                ),
+                120, "alice completion on the survivor",
+            )
+            assert st["status"] == "completed" and st["turn"] == 20_000
+            assert st["pod"] == gw_b.url
+
+            # Bit-identical to the fault-free oracle: the resumed run
+            # replayed from the newest intact durable checkpoint.
+            final = np.asarray(plane_b.handle("alice").final)
+            assert np.array_equal(
+                final, oracle_final(tmp_path, "alice", alice_spec)
+            )
+
+            # The healthy pod's tenant was undisturbed throughout.
+            assert bob_handle.wait(timeout=120)
+            assert bob_handle.status == "completed"
+            assert np.array_equal(
+                np.asarray(bob_handle.final),
+                oracle_final(tmp_path, "bob", bob_spec),
+            )
+
+            # One trace across the hop: the flagged broker-side failover
+            # trace and the pod-side request trace share the trace id.
+            doc = client._request("GET", "/traces?limit=200")
+            same_id = [
+                t for t in doc["traces"]
+                if t["trace_id"] == failover["trace_id"]
+            ]
+            names = {
+                s["name"] for t in same_id for s in t.get("spans", ())
+            }
+            assert "gol.broker.place" in names, "broker-side spans retained"
+            assert "gol.admission" in names, "pod-side spans share the id"
+        finally:
+            if chaos is not None:
+                chaos.stop()
+            if broker is not None:
+                broker.close()
+            gw_b.close()
+            plane_b.close()
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=10)
+
+
+# -- drain migration under load ------------------------------------------------
+
+
+class TestDrainMigration:
+    def test_pod_drain_migrates_parked_and_spills_queued(self, tmp_path):
+        root = tmp_path / "ckpt"
+        plane_a = ServePlane(
+            ServeConfig(
+                max_sessions=2, max_queued=4, telemetry_sample_seconds=0.1
+            ),
+            checkpoint_root=root,
+        )
+        gw_a = GatewayServer(plane_a, port=0)
+        plane_b = ServePlane(
+            ServeConfig(
+                max_sessions=4,
+                max_total_cells=300_000,
+                telemetry_sample_seconds=0.1,
+            ),
+            checkpoint_root=root,
+        )
+        gw_b = GatewayServer(plane_b, port=0)
+        broker = Broker(
+            [gw_a.url, gw_b.url],
+            BrokerConfig(
+                probe_interval_seconds=0.1,
+                probe_miss_threshold=3,
+                checkpoint_root=root,
+            ),
+        )
+        client = GolClient(broker.url)
+        dave_spec = spec_doc(2_000, seed=11)
+        erin_spec = spec_doc(2_000, seed=12)
+        try:
+            wait_for(
+                lambda: all(p["ready"] for p in broker.pod_states()),
+                30, "pods probed",
+            )
+            base_migrations = counter("broker.migrations")
+            # carol computes THROUGH the drain (the load); dave parks
+            # paused; erin waits in A's admission queue.
+            assert submit_via(
+                client, "carol", spec_doc(200_000, seed=10)
+            )["pod"] == gw_a.url
+            assert submit_via(client, "dave", dave_spec)["pod"] == gw_a.url
+            wait_for(
+                lambda: (broker_state(client, "dave") or {}).get("turn", 0)
+                > 0,
+                30, "dave progress",
+            )
+            client.pause("dave")
+            erin = submit_via(client, "erin", erin_spec)
+            assert erin["pod"] == gw_a.url and erin["status"] == "queued"
+            wait_for(
+                lambda: (broker_state(client, "carol") or {}).get("turn", 0)
+                > 0,
+                30, "carol progress",
+            )
+
+            out = client._request("POST", "/v1/migrate", {"pod": gw_a.url})
+            assert out["migrated"] == ["carol", "dave"]
+            assert out["spilled"] == ["erin"]
+            assert out["lost"] == []
+            for tenant in ("carol", "dave", "erin"):
+                assert broker.placement(tenant) == gw_b.url
+            assert counter("broker.migrations") == base_migrations + 3
+            records = broker.flight.records()
+            kinds = [
+                r["kind"] for r in records
+                if r["kind"] in ("migration", "spill")
+            ]
+            assert sorted(kinds) == ["migration", "migration", "spill"]
+            spill = [r for r in records if r["kind"] == "spill"][0]
+            assert spill["tenant"] == "erin"
+            carol_rec = [
+                r for r in records
+                if r["kind"] == "migration" and r["tenant"] == "carol"
+            ][0]
+            assert carol_rec["turn"] > 0  # drained mid-compute
+
+            # The drained pod routes away once the next probe sees it.
+            wait_for(
+                lambda: broker.pod_states()[0]["status"] == "draining",
+                30, "probe observes the drained pod",
+            )
+            frank = submit_via(client, "frank", spec_doc(400, seed=13))
+            assert frank["pod"] == gw_b.url
+
+            # Migrated sessions finish on B, bit-identical to fault-free
+            # oracles; the under-load tenant keeps computing past its
+            # drain turn.
+            for tenant, spec in (("dave", dave_spec), ("erin", erin_spec)):
+                st = wait_for(
+                    lambda t=tenant: (
+                        (s := broker_state(client, t))
+                        and s["status"] == "completed"
+                        and s
+                    ),
+                    120, f"{tenant} completion on B",
+                )
+                assert st["turn"] == 2_000
+                assert np.array_equal(
+                    np.asarray(plane_b.handle(tenant).final),
+                    oracle_final(tmp_path, tenant, spec),
+                )
+            wait_for(
+                lambda: (broker_state(client, "carol") or {}).get("turn", 0)
+                > carol_rec["turn"],
+                60, "carol computing again on B",
+            )
+            client.quit("carol")
+        finally:
+            broker.close()
+            gw_a.close()
+            gw_b.close()
+            plane_a.close()
+            plane_b.close()
+
+
+# -- broker restart re-discovery + orphan recovery -----------------------------
+
+
+class TestBrokerRestart:
+    def test_restarted_broker_rediscovers_and_recovers_orphans(
+        self, tmp_path
+    ):
+        root = tmp_path / "ckpt"
+        plane_a = ServePlane(
+            ServeConfig(max_sessions=4, telemetry_sample_seconds=0.1),
+            checkpoint_root=root,
+        )
+        gw_a = GatewayServer(plane_a, port=0)
+        cfg = BrokerConfig(
+            probe_interval_seconds=0.1,
+            probe_miss_threshold=3,
+            checkpoint_root=root,
+        )
+        broker1 = Broker([gw_a.url], cfg)
+        client1 = GolClient(broker1.url)
+        oscar_spec = spec_doc(200_000, seed=21, checkpoint_every=16)
+        try:
+            wait_for(
+                lambda: all(p["ready"] for p in broker1.pod_states()),
+                30, "pod probed",
+            )
+            submit_via(client1, "tina", spec_doc(200_000, seed=20))
+            wait_for(
+                lambda: (broker_state(client1, "tina") or {}).get("turn", 0)
+                > 0,
+                30, "tina progress",
+            )
+        finally:
+            broker1.close()  # the broker dies; the pod keeps computing
+
+        # An orphan: a second pod parks a resumable checkpoint on the
+        # shared root and is gone before any broker sees it.
+        oscar_params, _ = wire.params_from_spec(
+            "oscar", json.loads(json.dumps(oscar_spec)), root=tmp_path / "up"
+        )
+        with ServePlane(
+            ServeConfig(max_sessions=2), checkpoint_root=root
+        ) as plane_c:
+            plane_c.submit("oscar", oscar_params)
+            wait_for(
+                lambda: (plane_c.handle("oscar").last_turn or 0) > 32,
+                60, "oscar progress",
+            )
+            receipt = plane_c.drain(timeout=60)
+            assert receipt["oscar"]["resumable"]
+        parked = scan_resumable(root)["oscar"]
+        assert parked["turn"] > 0
+
+        base_failovers = counter("broker.failovers")
+        broker2 = Broker([gw_a.url], cfg)
+        client2 = GolClient(broker2.url)
+        try:
+            # Soft state rebuilt from the pod's own session list.
+            assert broker2.placement("tina") == gw_a.url
+            assert "discover" in [
+                r["kind"] for r in broker2.flight.records()
+            ]
+            wait_for(
+                lambda: all(p["ready"] for p in broker2.pod_states()),
+                30, "restarted broker probes the pod",
+            )
+
+            out = client2._request("POST", "/v1/recover", {})
+            assert out["adopted"] == ["oscar"] and out["lost"] == []
+            assert broker2.placement("oscar") == gw_a.url
+            assert counter("broker.failovers") == base_failovers + 1
+            failover = [
+                r for r in broker2.flight.records()
+                if r["kind"] == "failover"
+            ][0]
+            assert failover["from_pod"] is None
+            assert failover["checkpoint_turn"] == parked["turn"]
+
+            # The sidecar-reconstructed spec resumes to EXACTLY the
+            # parked turn: no lost work, no invented work — and the
+            # board is bit-identical to a fault-free run to that turn.
+            st = wait_for(
+                lambda: (
+                    (s := broker_state(client2, "oscar"))
+                    and s["status"] == "completed"
+                    and s
+                ),
+                120, "oscar re-adopted to the parked turn",
+            )
+            assert st["turn"] == parked["turn"]
+            to_turn = json.loads(json.dumps(oscar_spec))
+            to_turn["params"]["turns"] = parked["turn"]
+            assert np.array_equal(
+                np.asarray(plane_a.handle("oscar").final),
+                oracle_final(tmp_path, "oscar", to_turn),
+            )
+            client2.quit("tina")
+        finally:
+            broker2.close()
+            gw_a.close()
+            plane_a.close()

@@ -28,11 +28,18 @@ Usage:
     python tools/bench_gate.py fresh.json baseline.json --margin 0.1
     python bench.py --timecomp > fresh.json
     python tools/bench_gate.py fresh.json BENCH_TIMECOMP_PR16.json
+    python bench.py --federation > fresh.json
+    python tools/bench_gate.py fresh.json BENCH_FEDERATION_PR17.json
 
 The time-compression artifact (ISSUE 16) gates on BOTH sides of its
 record: the effective-rate headline row and its nested dense sub-row
 each carry a ``metric`` name, so a regression in either the skip
 machinery or the underlying dispatch rate trips the gate independently.
+
+The federation artifact (ISSUE 17) gates three rows the same way:
+``gol_federation_control_direct`` / ``gol_federation_control_broker``
+(ops/s — regress DOWN) and ``gol_federation_failover_mttr`` (seconds —
+regresses UP: a slower kill-to-first-dispatch recovery trips the gate).
 """
 
 from __future__ import annotations

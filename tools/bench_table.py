@@ -73,6 +73,12 @@ def main():
                     "FramePlane, and the N-spectator fetches/frame pin")
     ap.add_argument("--gateway-spectators", type=int, default=8,
                     metavar="N", help="wire spectator count for --gateway")
+    ap.add_argument("--federation", action="store_true",
+                    help="also run bench.bench_federation (ISSUE 17) and "
+                    "render the broker rows: direct vs brokered control "
+                    "ops/s (the placement-proxy hop) and the failover-"
+                    "MTTR row (SIGKILL -> first resolved dispatch on "
+                    "the adopting pod)")
     ap.add_argument("--sharded-meshes", metavar="LIST", default=None,
                     help="also run bench.bench_sharded per mesh (comma "
                     "list of NY[xNX] specs, e.g. '8,4x2,2x4') at the "
@@ -156,6 +162,13 @@ def main():
         rec = bench_gateway(spectators=args.gateway_spectators)
         _lint_serve(rec)
         print_gateway_table(rec)
+
+    if args.federation:
+        from bench import bench_federation
+
+        rec = bench_federation()
+        _lint_serve(rec)
+        print_federation_table(rec)
 
     if args.serve and args.batched:
         from bench import bench_serve_batched
@@ -312,6 +325,39 @@ def print_gateway_table(rec: dict) -> None:
         f"\n{rec['spectators']} wire spectators on one {rec['size']}² run: "
         f"{fr['fetches_per_frame']:.2f} device fetches/frame; wire byte "
         f"overhead x{fr['wire_overhead_ratio']:.2f} vs in-process"
+    )
+
+
+def print_federation_table(rec: dict) -> None:
+    """Render a ``bench.bench_federation`` record (ISSUE 17) as
+    markdown: the direct-vs-brokered control A/B (what the placement
+    proxy hop costs at steady state) and the failover-MTTR row — each
+    rep a real SIGKILLed pod, the clock stopped at the first resolved
+    dispatch past the adopted checkpoint turn on the survivor."""
+    ctl = rec["control"]
+    fo = rec["failover"]
+    print()
+    print("| Federation arm | median | spread | reps |")
+    print("|---|---|---|---|")
+    print(
+        f"| control direct-to-pod | {ctl['direct']['median']:,.0f} ops/s | "
+        f"{ctl['direct']['spread']:.1%} | {ctl['direct']['reps']} |"
+    )
+    print(
+        f"| control via broker | {ctl['brokered']['median']:,.0f} ops/s "
+        f"(hop +{ctl['broker_hop_ms']:.2f} ms) | "
+        f"{ctl['brokered']['spread']:.1%} | {ctl['brokered']['reps']} |"
+    )
+    mttr = fo["mttr"]
+    print(
+        f"| failover MTTR | {mttr['median']:.3f} s "
+        f"(detect {fo['detect_s']:.3f} s) | {mttr['spread']:.1%} | "
+        f"{mttr['reps']} |"
+    )
+    print(
+        f"\nprobe {fo['probe_interval_s']} s x "
+        f"{fo['probe_miss_threshold']} misses; checkpoint every "
+        f"{fo['checkpoint_every_turns']} turns; one SIGKILLed pod per rep"
     )
 
 

@@ -74,17 +74,33 @@ class GatewayError(RuntimeError):
 
 
 class GolClient:
-    """One pod's gateway, as an object.  ``base_url`` is the gateway
-    endpoint (``http://host:port``)."""
+    """One pod's gateway (or a federation broker — the wire contract
+    is the same), as an object.  ``base_url`` is the endpoint
+    (``http://host:port``).
 
-    def __init__(self, base_url: str, timeout: float = 60.0):
+    ``retries`` (ISSUE 17 satellite) arms the bounded 429 backoff
+    loop: a shed POST is retried up to that many times, sleeping the
+    server's ``Retry-After`` hint when it sent one (capped at
+    ``retry_sleep_cap``) and the deterministic PR-2 backoff curve
+    (``serve.podclient.backoff_delay``) when it did not — honest
+    backpressure honored client-side instead of hammered through."""
+
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 60.0,
+        retries: int = 0,
+        retry_sleep_cap: float = 5.0,
+    ):
         split = urlsplit(base_url if "//" in base_url else f"//{base_url}")
         self.host = split.hostname or "127.0.0.1"
         self.port = split.port or 80
         self.timeout = timeout
+        self.retries = max(0, retries)
+        self.retry_sleep_cap = retry_sleep_cap
 
     # -- REST ------------------------------------------------------------------
-    def _request(
+    def _request_once(
         self,
         method: str,
         path: str,
@@ -107,10 +123,76 @@ class GolClient:
             except ValueError:
                 doc = {"raw": raw.decode(errors="replace")}
             if resp.status >= 400:
-                raise GatewayError(resp.status, doc)
+                err = GatewayError(resp.status, doc)
+                if err.retry_after is None:
+                    # The header is authoritative when the body carried
+                    # no hint (proxies may strip bodies, never headers).
+                    hdr = resp.getheader("Retry-After")
+                    if hdr is not None:
+                        try:
+                            err.retry_after = float(hdr)
+                        except ValueError:
+                            pass
+                raise err
             return doc
         finally:
             conn.close()
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: dict | None = None,
+        headers: dict | None = None,
+    ):
+        from distributed_gol_tpu.serve.podclient import backoff_delay
+
+        attempt = 0
+        while True:
+            try:
+                return self._request_once(method, path, body, headers)
+            except GatewayError as e:
+                if e.status != 429 or attempt >= self.retries:
+                    raise
+                attempt += 1
+                hint = e.retry_after
+                delay = (
+                    float(hint)
+                    if isinstance(hint, (int, float)) and hint > 0
+                    else backoff_delay(attempt, 0.05, self.retry_sleep_cap)
+                )
+                time.sleep(min(delay, self.retry_sleep_cap))
+
+    # -- federation (ISSUE 17 satellite) ---------------------------------------
+    def placement(self, tenant: str) -> dict:
+        """Broker-only: ``GET /v1/sessions/<t>/placement`` — which pod
+        owns the tenant right now."""
+        return self._request("GET", f"/v1/sessions/{tenant}/placement")
+
+    def follow(self, tenant: str) -> "GolClient":
+        """The ``--broker`` mode's hop: ask the broker for the tenant's
+        owning pod and return a client bound to it — WebSocket legs
+        (events/frames) attach pod-direct because the broker proxies
+        control, not streams.  Against a plain gateway (no placement
+        route) this returns ``self``, so broker mode is safe to leave
+        on."""
+        try:
+            doc = self.placement(tenant)
+        except GatewayError as e:
+            if e.status in (404, 405) and not (
+                isinstance(e.body, dict) and "pod" in e.body
+            ):
+                return self
+            raise
+        pod = doc.get("pod")
+        if not pod:
+            return self
+        return GolClient(
+            pod,
+            timeout=self.timeout,
+            retries=self.retries,
+            retry_sleep_cap=self.retry_sleep_cap,
+        )
 
     def submit(
         self,
@@ -411,6 +493,13 @@ def _render(buf: np.ndarray, max_cols: int = 96) -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("url", help="gateway base URL, e.g. http://127.0.0.1:9191")
+    ap.add_argument("--retries", type=int, default=0, metavar="N",
+                    help="on 429, honor Retry-After and retry up to N "
+                    "times (bounded backoff when no hint was sent)")
+    ap.add_argument("--broker", action="store_true",
+                    help="URL is a federation broker: control verbs go "
+                    "through it; events/watch resolve the tenant's "
+                    "owning pod via /placement and attach pod-direct")
     sub = ap.add_subparsers(dest="verb", required=True)
 
     p_submit = sub.add_parser("submit", help="Broker.Publish: start a session")
@@ -466,7 +555,7 @@ def main(argv=None) -> int:
                          help="stats lines only, no board render")
 
     args = ap.parse_args(argv)
-    client = GolClient(args.url)
+    client = GolClient(args.url, retries=args.retries)
     try:
         return _run_verb(client, args)
     except GatewayError as e:
@@ -560,6 +649,8 @@ def _run_verb(client: GolClient, args) -> int:
         print(json.dumps(client.drain(args.timeout), indent=2))
         return 0
     if args.verb == "events":
+        if getattr(args, "broker", False):
+            client = client.follow(args.tenant)
         with client.controller(args.tenant, since=args.since) as stream:
             try:
                 while True:
@@ -570,6 +661,8 @@ def _run_verb(client: GolClient, args) -> int:
             except (WsClosed, KeyboardInterrupt):
                 return 0
     if args.verb == "watch":
+        if getattr(args, "broker", False):
+            client = client.follow(args.tenant)
         rect = None
         if args.rect:
             rect = [int(v) for v in args.rect.split(",")]

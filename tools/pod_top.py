@@ -1,16 +1,22 @@
-"""Live pod dashboard over the telemetry endpoints (ISSUE 12).
+"""Live pod dashboard over the telemetry endpoints (ISSUE 12), plus the
+fleet view over a federation broker (ISSUE 17).
 
 ``top`` for a serving pod: a refresh loop against ``/healthz`` + ``/slo``
 (``serve --telemetry-port``, or ``gol.run(..., telemetry_port=...)``)
 with one row per tenant — status, gens/s (computed client-side from
 consecutive scrapes), p99 resolve latency, restarts, and error-budget
-burn.  Pure stdlib; rendering is a pure function of two scrapes so it is
+burn.  Pointed at a broker (``python -m distributed_gol_tpu broker``)
+the same scrape autodetects the fleet health body (``"broker": true``)
+and renders one row per POD instead — ready/degraded/draining/condemned,
+resident/queued, cell headroom, and which SLO objectives are burning.
+Pure stdlib; rendering is a pure function of two scrapes so it is
 unit-testable without a pod.
 
 Usage:
     python tools/pod_top.py http://127.0.0.1:9090
     python tools/pod_top.py http://127.0.0.1:9090 --interval 2
     python tools/pod_top.py http://127.0.0.1:9090 --once   # one frame, no loop
+    python tools/pod_top.py http://127.0.0.1:9300 --fleet  # broker fleet view
 """
 
 from __future__ import annotations
@@ -172,14 +178,74 @@ def render_frame(cur: dict, prev: dict | None = None) -> str:
     return "\n".join(lines)
 
 
+def _fmt_cells(used: float | None, cap: float | None) -> str:
+    if not cap:
+        return f"{used or 0:,.0f}"
+    return f"{used or 0:,.0f}/{cap:,.0f} ({(used or 0) / cap:.0%})"
+
+
+def render_fleet(cur: dict, prev: dict | None = None) -> str:
+    """One fleet frame from a broker scrape (``/healthz`` with
+    ``"broker": true``): the aggregate line, then one row per pod.
+    Pure function — the test surface, like :func:`render_frame`."""
+    health = cur["health"]
+    lines = [
+        f"fleet {'ready' if health.get('ready') else 'NOT-READY'} | "
+        f"pods {health.get('pods_ready', 0)}/{len(health.get('pods', ()))}"
+        f" ready, {health.get('pods_condemned', 0)} condemned | "
+        f"placements {health.get('placements', 0)} | "
+        f"resident {health.get('resident_sessions', 0)} "
+        f"queued {health.get('queued_sessions', 0)} "
+        f"cells {health.get('resident_cells', 0):,}"
+    ]
+    dt = (cur["t"] - prev["t"]) if prev else 0.0
+    prev_pods = {
+        p.get("endpoint"): p
+        for p in ((prev or {}).get("health", {}).get("pods") or ())
+    }
+    lines.append(
+        f"{'POD':<24} {'STATUS':<10} {'RES':>4} {'QUE':>4} "
+        f"{'CELLS/S':>8} {'CELLS':<22} {'BURN':<14} TENANTS"
+    )
+    for pod in health.get("pods", ()):
+        endpoint = pod.get("endpoint", "?")
+        status = pod.get("status", "?")
+        if pod.get("condemned"):
+            status = f"condemned({pod.get('misses', 0)})"
+        rate = None
+        before = prev_pods.get(endpoint)
+        if before is not None and dt > 0:
+            rate = (
+                pod.get("resident_cells", 0)
+                - before.get("resident_cells", 0)
+            ) / dt
+        burning = pod.get("slo_alerting") or []
+        placed = pod.get("placed") or []
+        lines.append(
+            f"{endpoint:<24} {status:<10} "
+            f"{pod.get('resident_sessions', 0):>4} "
+            f"{pod.get('queued_sessions', 0):>4} "
+            f"{_fmt_rate(rate):>8} "
+            f"{_fmt_cells(pod.get('resident_cells'), pod.get('effective_total_cells')):<22} "
+            f"{('!' + ','.join(burning)) if burning else '-':<14} "
+            + (",".join(placed) if placed else "-")
+        )
+    if not health.get("pods"):
+        lines.append("(no pods)")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("url", help="pod telemetry base URL, e.g. "
-                                "http://127.0.0.1:9090")
+                                "http://127.0.0.1:9090 (or a broker URL)")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="refresh period in seconds")
     ap.add_argument("--once", action="store_true",
                     help="print one frame and exit (no screen clearing)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="force the broker fleet view (autodetected from "
+                    "the health body otherwise)")
     args = ap.parse_args(argv)
 
     prev = None
@@ -190,7 +256,8 @@ def main(argv=None) -> int:
             except (urllib.error.URLError, OSError, ValueError) as e:
                 print(f"{args.url}: unreachable ({e})", file=sys.stderr)
                 return 1
-            frame = render_frame(cur, prev)
+            fleet = args.fleet or bool(cur["health"].get("broker"))
+            frame = (render_fleet if fleet else render_frame)(cur, prev)
             if args.once:
                 print(frame)
                 return 0
