@@ -35,7 +35,12 @@ Robustness legs (the tentpole's three):
    capacity fraction) and deprioritises pods whose SLO burn is
    alerting; broker rejections carry an honest ``Retry-After`` derived
    from fleet headroom (pod-provided hints when the pods answered,
-   the condemnation-recovery horizon when they did not).
+   the condemnation-recovery horizon when they did not).  A healed
+   pod is **reconciled before readmission**: a SIGSTOP-partitioned
+   pod resumes running its old sessions the instant it thaws, so any
+   resident whose placement moved to a survivor during the outage is
+   quit on the healed pod first — the single-writer invariant on
+   ``root/<tenant>`` survives partition heal, not just pod death.
 
 Cross-pod tracing (ISSUE 15, one level up): a submission's inbound
 W3C ``traceparent`` starts the broker's request trace, and the broker
@@ -133,6 +138,10 @@ class PodState:
     health: dict | None = None
     health_age: float = 0.0  # monotonic stamp of the last good probe
     resident: set = field(default_factory=set)
+    #: True while a failover worker is still re-placing this pod's
+    #: stranded tenants; rejoin is deferred until it finishes so the
+    #: heal-time reconcile sees the final placement map.
+    failover_inflight: bool = False
 
     @property
     def status(self) -> str:
@@ -258,6 +267,7 @@ class Broker(StdlibHTTPServer):
         self._m_failovers = reg.counter("broker.failovers")
         self._m_failovers_lost = reg.counter("broker.failovers_lost")
         self._m_migrations = reg.counter("broker.migrations")
+        self._m_rejoin_quits = reg.counter("broker.rejoin_quits")
         self._g_pods_ready = reg.gauge("broker.pods_ready")
         self.flight = FlightRecorder(self.config.flight_depth)
         self._lock = threading.Lock()
@@ -283,6 +293,7 @@ class Broker(StdlibHTTPServer):
         #: failover/migration re-submits (the pod's wire.py re-derives
         #: everything else, including the shared-root out_dir).
         self._specs: dict[str, dict] = {}
+        self._failover_threads: list[threading.Thread] = []
         self._closed = threading.Event()
         self._probe_wake = threading.Event()
         super().__init__(port=port, host=host, registry=reg,
@@ -300,6 +311,10 @@ class Broker(StdlibHTTPServer):
         self._probe_wake.set()
         super().close()
         self._prober.join(timeout=5)
+        with self._lock:
+            pending = list(self._failover_threads)
+        for worker in pending:
+            worker.join(timeout=5)
 
     def _discover(self) -> None:
         """Broker-restart re-discovery: the placement map is soft state,
@@ -335,7 +350,6 @@ class Broker(StdlibHTTPServer):
         """One probe cycle over every pod (also callable directly —
         tests and the bench drive condemnation deterministically without
         racing the wall-clock loop)."""
-        ready = 0
         for pod in self._pods:
             if self._closed.is_set():
                 return
@@ -357,28 +371,97 @@ class Broker(StdlibHTTPServer):
                 if condemn:
                     self._on_condemned(pod)
                 continue
-            rejoin = False
+            rejoin_due = False
             with self._lock:
                 pod.misses = 0
                 pod.health = health
                 pod.health_age = time.monotonic()
                 if pod.condemned:
                     pod.healthy_streak += 1
-                    if pod.healthy_streak >= self.config.rejoin_threshold:
-                        pod.condemned = False
-                        pod.healthy_streak = 0
-                        rejoin = True
-                if not pod.condemned and health.get("ready"):
-                    ready += 1
-            if rejoin:
+                    # Rejoin waits for the failover worker: reconcile
+                    # must see where the stranded tenants LANDED.
+                    rejoin_due = (
+                        pod.healthy_streak >= self.config.rejoin_threshold
+                        and not pod.failover_inflight
+                    )
+            if rejoin_due and self._reconcile_rejoin(pod):
+                with self._lock:
+                    pod.condemned = False
+                    pod.healthy_streak = 0
                 self._m_rejoined.inc()
                 self.flight.record("pod_rejoined", pod=pod.endpoint)
+        with self._lock:
+            ready = sum(
+                1 for p in self._pods
+                if not p.condemned
+                and p.misses == 0
+                and (p.health or {}).get("ready")
+            )
         self._g_pods_ready.set(ready)
+
+    def _reconcile_rejoin(self, pod: PodState) -> bool:
+        """The split-brain guard on partition heal: while the pod was
+        condemned its residents failed over to survivors, but a
+        partitioned (not dead) pod kept RUNNING them — readmitting it
+        as-is would leave two pods writing the same shared
+        ``root/<tenant>`` checkpoint directory, breaking the
+        single-writer invariant the bit-identical resume guarantee
+        rests on.  So before the ring takes the pod back: quit every
+        session it still holds whose placement now points at a
+        DIFFERENT pod (counted in ``broker.rejoin_quits``), and
+        re-adopt into the books any live session nobody else owns (the
+        pod carried it through the partition — failover had lost it).
+        Returns False — rejoin deferred to the next healthy probe, the
+        streak intact — when the pod cannot answer or a stale quit
+        fails."""
+        try:
+            doc = pod.client.sessions()
+        except (PodUnreachable, PodHTTPError):
+            return False
+        ok = True
+        for tenant, row in sorted((doc.get("sessions") or {}).items()):
+            status = row.get("status")
+            if status in _TERMINAL and not row.get("resumable"):
+                continue
+            with self._lock:
+                owner = self._placements.get(tenant)
+                readopt = owner is None
+                if readopt:
+                    self._placements[tenant] = pod
+                    pod.resident.add(tenant)
+            if readopt:
+                self.flight.record(
+                    "rejoin_readopt", tenant=tenant, pod=pod.endpoint
+                )
+                continue
+            if owner is pod or status in _TERMINAL:
+                continue  # rightful resident / parked (not writing)
+            try:
+                pod.client.control(tenant, "quit")
+            except PodHTTPError as e:
+                if e.status != 404:  # gone already = already not writing
+                    ok = False
+                    continue
+            except PodUnreachable:
+                ok = False
+                continue
+            self._m_rejoin_quits.inc()
+            self.flight.record(
+                "rejoin_quit",
+                tenant=tenant,
+                pod=pod.endpoint,
+                owner=owner.endpoint,
+            )
+        return ok
 
     def _on_condemned(self, pod: PodState) -> None:
         """A pod crossed the miss threshold: record it, then fail its
-        residents over to the survivors (config-gated — a broker run
-        as a pure balancer can leave adoption to operators)."""
+        residents over to the survivors on a worker thread — each
+        re-submission is a bounded-timeout HTTP sweep, and the prober
+        must keep its cadence (health data going stale behind a slow
+        failover would delay condemning OTHER failing pods).
+        Config-gated: a broker run as a pure balancer can leave
+        adoption to operators."""
         self._m_condemned.inc()
         with self._lock:
             stranded = sorted(pod.resident)
@@ -388,59 +471,91 @@ class Broker(StdlibHTTPServer):
             misses=pod.misses,
             stranded=stranded,
         )
-        if self.config.failover:
-            self._failover(pod, stranded)
+        if not self.config.failover or not stranded:
+            return
+        worker = threading.Thread(
+            target=self._failover,
+            args=(pod, stranded),
+            name="gol-broker-failover",
+            daemon=True,
+        )
+        with self._lock:
+            pod.failover_inflight = True
+            self._failover_threads = [
+                t for t in self._failover_threads if t.is_alive()
+            ]
+            self._failover_threads.append(worker)
+        worker.start()
 
     # -- leg 1: failover -------------------------------------------------------
     def _failover(self, dead: PodState, tenants) -> None:
         """Re-adopt a dead pod's residents on the survivors, newest
         durable checkpoint first.  Each tenant's re-submission is one
         flagged trace (``gol.broker.failover``) so the postmortem
-        timeline is retained regardless of sampling."""
-        if not tenants:
-            return
-        orphans = scan_resumable(self.config.checkpoint_root)
-        for tenant in tenants:
-            info = orphans.get(tenant)
-            doc = self._respec(tenant, info)
-            if doc is None:
-                self._m_failovers_lost.inc()
-                self.flight.record(
-                    "failover_lost",
-                    tenant=tenant,
-                    pod=dead.endpoint,
-                    reason="no spec and no resumable checkpoint",
+        timeline is retained regardless of sampling.  A tenant that
+        cannot be re-placed is dropped from the placement map — the
+        client's next ``/placement`` or state poll gets an honest 404
+        instead of 502s against the condemned endpoint (its spec is
+        kept, so ``/v1/recover`` or a fresh submit restores it)."""
+        try:
+            orphans = scan_resumable(self.config.checkpoint_root)
+            for tenant in tenants:
+                info = orphans.get(tenant)
+                doc = self._respec(tenant, info)
+                if doc is None:
+                    self._m_failovers_lost.inc()
+                    self._drop_placement(tenant, dead)
+                    self.flight.record(
+                        "failover_lost",
+                        tenant=tenant,
+                        pod=dead.endpoint,
+                        reason="no spec and no resumable checkpoint",
+                    )
+                    continue
+                trace = tracing.TRACER.start_trace(
+                    "gol.broker.failover", tenant=tenant
                 )
-                continue
-            trace = tracing.TRACER.start_trace(
-                "gol.broker.failover", tenant=tenant
-            )
-            trace.flag("failover")
-            target, receipt, err = self._place(
-                tenant, doc, trace, exclude=(dead,)
-            )
-            if target is None:
-                tracing.TRACER.end_trace(trace, status="failed", error=err)
-                self._m_failovers_lost.inc()
-                self.flight.record(
-                    "failover_lost",
-                    tenant=tenant,
-                    pod=dead.endpoint,
-                    reason=err or "no adoptive pod",
+                trace.flag("failover")
+                target, receipt, err, _ = self._place(
+                    tenant, doc, trace, exclude=(dead,)
                 )
-                continue
-            tracing.TRACER.end_trace(trace, status="ok")
-            self._m_failovers.inc()
-            self.flight.record(
-                "failover",
-                tenant=tenant,
-                from_pod=dead.endpoint,
-                to_pod=target.endpoint,
-                checkpoint_turn=info["turn"] if info else None,
-                trace_id=trace.trace_id,
-            )
+                if target is None:
+                    tracing.TRACER.end_trace(
+                        trace, status="failed", error=err
+                    )
+                    self._m_failovers_lost.inc()
+                    self._drop_placement(tenant, dead)
+                    self.flight.record(
+                        "failover_lost",
+                        tenant=tenant,
+                        pod=dead.endpoint,
+                        reason=err or "no adoptive pod",
+                    )
+                    continue
+                tracing.TRACER.end_trace(trace, status="ok")
+                self._m_failovers.inc()
+                self.flight.record(
+                    "failover",
+                    tenant=tenant,
+                    from_pod=dead.endpoint,
+                    to_pod=target.endpoint,
+                    checkpoint_turn=info["turn"] if info else None,
+                    trace_id=trace.trace_id,
+                )
+            with self._lock:
+                dead.resident.clear()
+        finally:
+            with self._lock:
+                dead.failover_inflight = False
+
+    def _drop_placement(self, tenant: str, pod: PodState) -> None:
+        """Forget a placement that still points at ``pod`` — the
+        tenant could not be re-placed, and stale books would keep
+        proxying its control plane into a dead endpoint."""
         with self._lock:
-            dead.resident.clear()
+            if self._placements.get(tenant) is pod:
+                del self._placements[tenant]
+            pod.resident.discard(tenant)
 
     def _respec(self, tenant: str, info: dict | None) -> dict | None:
         """The spec failover re-submits: the client's original doc when
@@ -479,7 +594,12 @@ class Broker(StdlibHTTPServer):
     ) -> tuple[int, dict]:
         """Single-session migration: quit on the source parks the
         durable checkpoint; the readopt POST on the target resumes it
-        bit-identical (reshard-on-restore absorbs mesh mismatch)."""
+        bit-identical (reshard-on-restore absorbs mesh mismatch).  The
+        quit is only issued once a plausible target exists (don't stop
+        a healthy session just to discover the fleet is full), and a
+        placement that still fails rolls back — the spec is re-submitted
+        to the SOURCE pod, which readopts its own parked checkpoint, so
+        the tenant is never left stopped with a stale placement."""
         with self._lock:
             source = self._placements.get(tenant)
             doc = self._specs.get(tenant)
@@ -487,8 +607,15 @@ class Broker(StdlibHTTPServer):
             return 404, {"error": f"no placement for {tenant!r}"}
         if doc is None:
             return 409, {"error": f"no stored spec for {tenant!r}"}
-        if to is not None and self._pod_by_endpoint(to) is None:
-            return 404, {"error": f"unknown target pod {to!r}"}
+        target_only = None
+        if to is not None:
+            target_only = self._pod_by_endpoint(to)
+            if target_only is None:
+                return 404, {"error": f"unknown target pod {to!r}"}
+            if target_only.condemned:
+                return 409, {"error": f"target pod {to!r} is condemned"}
+        elif not self._candidates(exclude=(source,)):
+            return 503, {"error": "no admitting target pod in the ring"}
         try:
             source.client.control(tenant, "quit")
         except (PodUnreachable, PodHTTPError) as e:
@@ -500,14 +627,25 @@ class Broker(StdlibHTTPServer):
             "gol.broker.migration", tenant=tenant
         )
         trace.flag("migration")
-        target, receipt, err = self._place(
+        target, receipt, err, _ = self._place(
             tenant, dict(doc), trace,
             exclude=(source,) if to is None else (),
-            only=self._pod_by_endpoint(to),
+            only=target_only,
         )
         if target is None:
             tracing.TRACER.end_trace(trace, status="failed", error=err)
-            return 502, {"error": err or "no target pod"}
+            restored = self._restore_to_source(source, tenant, doc)
+            self.flight.record(
+                "migration_failed",
+                tenant=tenant,
+                from_pod=source.endpoint,
+                restored=restored,
+                error=err,
+            )
+            return 502, {
+                "error": err or "no target pod",
+                "restored": restored,
+            }
         tracing.TRACER.end_trace(trace, status="ok")
         self._m_migrations.inc()
         self.flight.record(
@@ -525,6 +663,22 @@ class Broker(StdlibHTTPServer):
             "turn": parked.get("turn"),
             "receipt": receipt,
         }
+
+    def _restore_to_source(
+        self, source: PodState, tenant: str, doc: dict
+    ) -> bool:
+        """Failed-migration rollback: the tenant is already quit and
+        its parked checkpoint sits on the shared root, so re-submitting
+        the spec to the source pod resumes it exactly where the aborted
+        migration stopped it.  If even that fails the placement is
+        dropped — an honest 404 beats books pointing at a stopped
+        session."""
+        try:
+            source.client.submit(dict(doc))
+        except (PodUnreachable, PodHTTPError):
+            self._drop_placement(tenant, source)
+            return False
+        return True
 
     def _migrate_pod(self, endpoint: str, to: str | None) -> tuple[int, dict]:
         """Whole-pod migration: drain the source (its receipt lists
@@ -555,13 +709,15 @@ class Broker(StdlibHTTPServer):
                 "gol.broker.migration", tenant=tenant
             )
             trace.flag("migration")
-            target, _, err = self._place(
+            target, _, err, _ = self._place(
                 tenant, dict(doc), trace,
                 exclude=(source,) if to is None else (),
                 only=self._pod_by_endpoint(to),
             )
             if target is None:
                 tracing.TRACER.end_trace(trace, status="failed", error=err)
+                # Honest books: the drained source no longer runs it.
+                self._drop_placement(tenant, source)
                 lost.append(tenant)
                 continue
             tracing.TRACER.end_trace(trace, status="ok")
@@ -646,14 +802,17 @@ class Broker(StdlibHTTPServer):
         exclude=(),
         only: PodState | None = None,
         hints: list | None = None,
-    ) -> tuple[PodState | None, dict | None, str | None]:
+    ) -> tuple[PodState | None, dict | None, str | None, tuple | None]:
         """Try candidates in placement order; a pod that sheds (429) or
         closes admissions (503) spills the submission to the next one
         (its ``retry_after`` hint collected into ``hints`` — the honest
         input to the broker's own Retry-After).  Returns
-        ``(pod, receipt, None)`` or ``(None, None, why)``; a permanent
-        pod answer (400/404/409) aborts the sweep — every other pod
-        would refuse the same spec the same way."""
+        ``(pod, receipt, None, None)`` or ``(None, None, why,
+        permanent)``; a permanent pod answer (400/404/409) aborts the
+        sweep — every other pod would refuse the same spec the same
+        way — and comes back as ``permanent = (status, body)`` so the
+        caller can relay the pod's verdict verbatim instead of masking
+        a bad spec as a retryable 429."""
         t0 = tracing.clock_ns()
         pods = [only] if only is not None else self._candidates(exclude)
         trace.record_span(
@@ -679,7 +838,11 @@ class Broker(StdlibHTTPServer):
                     if hints is not None and e.retry_after is not None:
                         hints.append(e.retry_after)
                     continue  # shed/draining: spill to the next pod
-                return None, None, last_err
+                body = dict(e.body) if isinstance(e.body, dict) else {
+                    "error": str(e.body)
+                }
+                body["pod"] = pod.endpoint
+                return None, None, last_err, (e.status, body)
             trace.record_span(
                 "gol.broker.forward",
                 f0,
@@ -694,8 +857,8 @@ class Broker(StdlibHTTPServer):
                 self._placements[tenant] = pod
                 self._specs[tenant] = dict(doc)
                 pod.resident.add(tenant)
-            return pod, receipt, None
-        return None, None, last_err
+            return pod, receipt, None, None
+        return None, None, last_err, None
 
     def _fleet_retry_after(self, hints) -> float:
         """Honest backpressure: the largest pod-provided 429 hint when
@@ -786,10 +949,19 @@ class Broker(StdlibHTTPServer):
             ("traceparent", trace.traceparent()),
         ]
         hints: list = []
-        pod, receipt, err = self._place(tenant, doc, trace, hints=hints)
+        pod, receipt, err, permanent = self._place(
+            tenant, doc, trace, hints=hints
+        )
         if pod is None:
             tracing.TRACER.end_trace(trace, status="rejected", error=err)
             self._m_rejected.inc()
+            if permanent is not None:
+                # A pod REFUSED the spec (bad spec, duplicate tenant…):
+                # relay its status and body verbatim — retrying would
+                # meet the same answer, so no Retry-After theatre.
+                status, body = permanent
+                request._send_json(status, body, headers=headers)
+                return True
             retry_after = self._fleet_retry_after(hints)
             request._send_json(
                 429,
@@ -903,7 +1075,7 @@ class Broker(StdlibHTTPServer):
                 "gol.broker.failover", tenant=tenant
             )
             trace.flag("recover")
-            pod, _, err = self._place(tenant, doc, trace)
+            pod, _, err, _ = self._place(tenant, doc, trace)
             if pod is None:
                 tracing.TRACER.end_trace(trace, status="failed", error=err)
                 lost.append(tenant)

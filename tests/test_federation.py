@@ -29,6 +29,7 @@ real loopback sockets:
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -242,8 +243,9 @@ class TestBrokerConfigValidation:
 
 
 class StubPod(StdlibHTTPServer):
-    """A pod-shaped HTTP server the test scripts: health toggles, and
-    POST /v1/sessions answers from a scripted queue."""
+    """A pod-shaped HTTP server the test scripts: health toggles,
+    POST /v1/sessions answers from a scripted queue, session control
+    is recorded, and per-tenant state answers from ``state_doc``."""
 
     thread_name = "gol-stub-pod"
 
@@ -251,6 +253,8 @@ class StubPod(StdlibHTTPServer):
         self.healthy = True
         self.posts = 0
         self.scripted: list[tuple[int, dict]] = []
+        self.controls: list[str] = []
+        self.state_doc: dict = {"status": "running"}
         super().__init__(port=0)
 
     def handle(self, request, method, path, query):
@@ -282,6 +286,13 @@ class StubPod(StdlibHTTPServer):
             if code == 429 and "retry_after" in body:
                 headers = [("Retry-After", f"{body['retry_after']:g}")]
             request._send_json(code, body, headers=headers)
+            return True
+        if path.startswith("/v1/sessions/") and method == "GET":
+            request._send_json(200, dict(self.state_doc))
+            return True
+        if path.startswith("/v1/sessions/") and method == "POST":
+            self.controls.append(path.rsplit("/", 1)[-1])
+            request._send_json(200, {"ok": True})
             return True
         return False
 
@@ -359,6 +370,100 @@ class TestCondemnRejoin:
         finally:
             broker.close()
             stub.close()
+
+
+class TestPermanentRejectionRelay:
+    def test_pod_4xx_relays_verbatim_not_429(self, tmp_path):
+        """A pod that REFUSES a spec (409 duplicate, 400 bad spec) is
+        a permanent verdict: the broker relays the pod's status and
+        body instead of masking it as a retryable 429 — and the
+        client's --retries loop therefore does NOT sleep and re-send
+        the same doomed spec."""
+        stub = StubPod()
+        broker = Broker(
+            [stub.url],
+            BrokerConfig(
+                probe_interval_seconds=60.0, checkpoint_root=tmp_path
+            ),
+        )
+        try:
+            broker.probe_once()
+            stub.scripted.append((409, {"error": "tenant exists"}))
+            posts_before = stub.posts
+            client = GolClient(broker.url, retries=3)
+            with pytest.raises(GatewayError) as ei:
+                submit_via(client, "dup", spec_doc(100, 1))
+            assert ei.value.status == 409
+            assert ei.value.body["error"] == "tenant exists"
+            assert ei.value.body["pod"] == stub.url
+            assert stub.posts == posts_before + 1, "no client retry loop"
+        finally:
+            broker.close()
+            stub.close()
+
+
+class TestMigrationGuards:
+    def test_migrate_refuses_before_quit_when_no_target(self, tmp_path):
+        """With no admitting target in the ring the migrate answers
+        503 WITHOUT quitting the source — a healthy session is never
+        stopped just to discover the fleet is full."""
+        stub = StubPod()
+        broker = Broker(
+            [stub.url],
+            BrokerConfig(
+                probe_interval_seconds=60.0, checkpoint_root=tmp_path
+            ),
+        )
+        client = GolClient(broker.url)
+        try:
+            broker.probe_once()
+            assert submit_via(client, "t1", spec_doc(100, 1))
+            with pytest.raises(GatewayError) as ei:
+                client._request("POST", "/v1/migrate", {"tenant": "t1"})
+            assert ei.value.status == 503
+            assert stub.controls == [], "source must not be quit"
+            assert broker.placement("t1") == stub.url
+        finally:
+            broker.close()
+            stub.close()
+
+    def test_failed_placement_restores_the_source(self, tmp_path):
+        """If placement fails AFTER the source was quit (the target
+        filled up in the race window), the spec is re-submitted to the
+        source — the parked checkpoint resumes where the aborted
+        migration stopped it, and the placement stays honest."""
+        stub_a, stub_b = StubPod(), StubPod()
+        stub_a.state_doc = {"status": "parked", "resumable": True}
+        broker = Broker(
+            [stub_a.url, stub_b.url],
+            BrokerConfig(
+                probe_interval_seconds=60.0, checkpoint_root=tmp_path
+            ),
+        )
+        client = GolClient(broker.url)
+        try:
+            broker.probe_once()
+            assert submit_via(client, "t1", spec_doc(100, 1))["pod"] == (
+                stub_a.url
+            )
+            stub_b.scripted.append((503, {"error": "draining"}))
+            with pytest.raises(GatewayError) as ei:
+                client._request(
+                    "POST", "/v1/migrate",
+                    {"tenant": "t1", "to": stub_b.url},
+                )
+            assert ei.value.status == 502
+            assert ei.value.body["restored"] is True
+            assert stub_a.controls == ["quit"]
+            assert stub_a.posts == 2, "initial submit + rollback submit"
+            assert broker.placement("t1") == stub_a.url
+            assert "migration_failed" in [
+                r["kind"] for r in broker.flight.records()
+            ]
+        finally:
+            broker.close()
+            stub_a.close()
+            stub_b.close()
 
 
 # -- SIGKILL failover (subprocess pod + survivor) ------------------------------
@@ -535,6 +640,131 @@ class TestSigkillFailover:
         finally:
             if chaos is not None:
                 chaos.stop()
+            if broker is not None:
+                broker.close()
+            gw_b.close()
+            plane_b.close()
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=10)
+
+
+# -- SIGSTOP partition heal (the split-brain row) ------------------------------
+
+
+class TestPartitionHealRejoin:
+    def test_sigstop_partition_heals_without_split_brain(self, tmp_path):
+        """The nastier cousin of SIGKILL: a SIGSTOP-partitioned pod is
+        condemned and its tenant fails over to the survivor — but the
+        pod is NOT dead, and on SIGCONT it resumes running the same
+        tenant a survivor now owns (two writers on root/<tenant>).
+        The broker must quit the stale resident on the healed pod
+        BEFORE readmitting it to the ring."""
+        root = tmp_path / "ckpt"
+        alice_spec = spec_doc(20_000, seed=7, checkpoint_every=16)
+        proc, pod_a = start_subprocess_pod(root)
+        plane_b = ServePlane(
+            ServeConfig(
+                max_sessions=4,
+                max_total_cells=300_000,  # A's bigger headroom wins
+                telemetry_sample_seconds=0.1,
+            ),
+            checkpoint_root=root,
+        )
+        gw_b = GatewayServer(plane_b, port=0)
+        broker = None
+        stopped = False
+        try:
+            base_rejoined = counter("broker.pods_rejoined")
+            base_quits = counter("broker.rejoin_quits")
+            broker = Broker(
+                [pod_a, gw_b.url],
+                BrokerConfig(
+                    probe_interval_seconds=0.1,
+                    probe_timeout_seconds=0.5,
+                    probe_miss_threshold=2,
+                    rejoin_threshold=2,
+                    checkpoint_root=root,
+                ),
+            )
+            client = GolClient(broker.url)
+            wait_for(
+                lambda: all(p["ready"] for p in broker.pod_states()),
+                30, "both pods probed ready",
+            )
+            assert submit_via(client, "alice", alice_spec)["pod"] == pod_a
+            wait_for(
+                lambda: (broker_state(client, "alice") or {}).get("turn", 0)
+                >= 32,
+                60, "alice past her first durable checkpoints",
+            )
+
+            # Partition: the pod freezes but does NOT die — the exact
+            # split-brain shape, because it will resume running alice
+            # the instant it thaws.
+            os.kill(proc.pid, signal.SIGSTOP)
+            stopped = True
+            wait_for(
+                lambda: broker.pod_states()[0]["condemned"],
+                30, "partitioned pod condemned",
+            )
+            wait_for(
+                lambda: broker.placement("alice") == gw_b.url,
+                60, "failover placement onto the survivor",
+            )
+
+            # Heal.  Readmission must be preceded by the reconcile
+            # quit of the healed pod's stale alice.
+            os.kill(proc.pid, signal.SIGCONT)
+            stopped = False
+            wait_for(
+                lambda: not broker.pod_states()[0]["condemned"],
+                30, "pod rejoined after reconcile",
+            )
+            assert counter("broker.pods_rejoined") == base_rejoined + 1
+            assert counter("broker.rejoin_quits") == base_quits + 1
+            records = broker.flight.records()
+            quit_rec = [
+                r for r in records if r["kind"] == "rejoin_quit"
+            ][0]
+            assert quit_rec["tenant"] == "alice"
+            assert quit_rec["pod"] == pod_a
+            assert quit_rec["owner"] == gw_b.url
+            kinds = [r["kind"] for r in records]
+            assert kinds.index("rejoin_quit") < kinds.index("pod_rejoined")
+
+            # One owner: placement still points at the survivor, and
+            # the healed pod's stale alice is parked, not computing.
+            assert broker.placement("alice") == gw_b.url
+            pod_client = GolClient(pod_a)
+            wait_for(
+                lambda: (
+                    pod_client._request("GET", "/v1/sessions")["sessions"]
+                    .get("alice", {}).get("status")
+                    not in ("running", "queued", "paused")
+                ),
+                30, "stale alice stopped on the healed pod",
+            )
+
+            # The survivor's run is undisturbed by the brief overlap:
+            # bit-identical to the fault-free oracle.
+            st = wait_for(
+                lambda: (
+                    (s := broker_state(client, "alice"))
+                    and s["status"] in ("completed", "failed")
+                    and s
+                ),
+                120, "alice completion on the survivor",
+            )
+            assert st["status"] == "completed" and st["turn"] == 20_000
+            assert st["pod"] == gw_b.url
+            assert np.array_equal(
+                np.asarray(plane_b.handle("alice").final),
+                oracle_final(tmp_path, "alice", alice_spec),
+            )
+        finally:
+            if stopped:
+                os.kill(proc.pid, signal.SIGCONT)
             if broker is not None:
                 broker.close()
             gw_b.close()
