@@ -1364,6 +1364,296 @@ def bench_gateway(
     return record
 
 
+def bench_relay(
+    size: int = 256,
+    turns: int = 24,
+    reps: int = 5,
+    fan_clients: int = 256,
+    fan_reps: int = 3,
+    fan_turns: int = 16,
+    fan_size: int = 64,
+) -> dict:
+    """ISSUE 18: the relay tier's two economics questions, interleaved
+    per the ``utils/measure.py`` discipline (the arms of every rep run
+    seconds apart, so a rig phase change cannot masquerade as relay
+    overhead).
+
+    - **Direct vs depth-2 A/B**: one spectator session per arm per
+      rep, watched either directly off the gateway or through a 2-deep
+      relay chain — frames/s over the session wall, and wire
+      bytes/frame (the relay forwards payload bytes verbatim, so the
+      per-frame bytes must match to the ws header).
+    - **Fan-out economics**: ``fan_clients`` (>=256) simulated viewers
+      behind 2 chained relays while the pod holds ONE spectator socket
+      for the whole subtree — egress amplification (client bytes
+      delivered per byte of pod egress into the tree), p99 frame
+      staleness vs a direct-subscriber oracle (first receipt of each
+      turn, relayed minus direct), and the pod-side fetches/frame ==
+      1.00 pin preserved through the tree.
+    """
+    import struct
+    import tempfile
+    import threading
+    import zlib
+    from pathlib import Path
+    from urllib.parse import urlsplit
+
+    from distributed_gol_tpu.obs import metrics as obs_metrics
+    from distributed_gol_tpu.serve import (
+        GatewayServer,
+        RelayServer,
+        ServeConfig,
+        ServePlane,
+    )
+    from distributed_gol_tpu.serve import ws as ws_lib
+    from distributed_gol_tpu.utils import measure
+    from tools.gol_client import GolClient
+
+    out_root = Path(tempfile.mkdtemp(prefix="gol_bench_relay_"))
+    reg = obs_metrics.REGISTRY
+    plane = ServePlane(
+        ServeConfig(max_sessions=2, max_cells_per_session=size * size),
+        checkpoint_root=out_root / "ckpt",
+    )
+    gateway = GatewayServer(plane, port=0)
+    client = GolClient(gateway.url)
+
+    def submit(tenant: str, side: int, n_turns: int) -> None:
+        client.submit(
+            tenant,
+            width=side,
+            height=side,
+            turns=n_turns,
+            soup=0.3,
+            seed=zlib.crc32(tenant.encode()) & 0x7FFFFFFF,
+            spectate=True,
+            viewport=(0, 0, side, side),
+            params={"engine": "roll", "cycle_check": 0,
+                    "ticker_period": 60.0},
+        )
+
+    def drain(base: str, path: str, depth: int, times=None):
+        """Raw spectator drain to 'end': (frames, payload bytes).
+        ``times`` collects the FIRST receipt perf_counter per turn —
+        the staleness clock."""
+        u = urlsplit(base)
+        wsock = ws_lib.client_connect(
+            u.hostname, u.port, f"{path}?queue={depth}", timeout=30
+        )
+        frames = nbytes = 0
+        try:
+            wsock.settimeout(600)
+            while True:
+                op, payload = wsock.recv()
+                if op == ws_lib.OP_TEXT:
+                    msg = json.loads(payload)
+                    if msg.get("type") == "end":
+                        break
+                    continue
+                frames += 1
+                nbytes += len(payload)
+                if times is not None:
+                    (hlen,) = struct.unpack_from(">I", payload)
+                    hdr = json.loads(bytes(payload[4:4 + hlen]))
+                    times.setdefault(hdr["turn"], time.perf_counter())
+        finally:
+            wsock.close()
+        return frames, nbytes
+
+    def chain2(upstream: str, n_turns: int) -> tuple:
+        """A depth-2 relay chain off ``upstream``, tuned for a bench
+        rep: tight resubscribe so a not-yet-submitted session costs
+        milliseconds, caches deep enough that nothing compacts."""
+        kw = dict(
+            cache_deltas=n_turns + 8,
+            queue_depth=n_turns + 2,
+            backoff_initial=0.05,
+            backoff_max=0.1,
+        )
+        r1 = RelayServer(upstream, **kw)
+        r2 = RelayServer(f"{r1.url}/v1/frames", **kw)
+        return r1, r2
+
+    # -- direct vs depth-2 A/B (interleaved arms per rep) --------------------
+    def run_direct(tenant: str) -> dict:
+        t0 = time.perf_counter()
+        submit(tenant, size, turns)
+        frames, nbytes = drain(
+            gateway.url, f"/v1/sessions/{tenant}/frames", turns + 2
+        )
+        return {"wall_s": time.perf_counter() - t0,
+                "frames": frames, "bytes": nbytes}
+
+    def run_depth2(tenant: str) -> dict:
+        t0 = time.perf_counter()
+        submit(tenant, size, turns)
+        r1, r2 = chain2(
+            f"{gateway.url}/v1/sessions/{tenant}/frames", turns
+        )
+        try:
+            frames, nbytes = drain(r2.url, "/v1/frames", turns + 2)
+        finally:
+            r2.close()
+            r1.close()
+        return {"wall_s": time.perf_counter() - t0,
+                "frames": frames, "bytes": nbytes}
+
+    direct_runs, depth2_runs = [], []
+    for rep in range(max(1, reps)):
+        direct_runs.append(run_direct(f"relay-direct-{rep}"))
+        depth2_runs.append(run_depth2(f"relay-depth2-{rep}"))
+
+    def frame_stats(runs, metric):
+        per_frame = [r["bytes"] / r["frames"] for r in runs if r["frames"]]
+        rates = [r["frames"] / r["wall_s"] for r in runs]
+        return {
+            "metric": metric,
+            "unit": "frames/s",
+            **measure.summarize(rates),
+            "bytes_per_frame": measure.median(per_frame),
+            "frames_per_run": runs[0]["frames"],
+        }
+
+    direct_row = frame_stats(direct_runs, "gol_relay_direct_frames")
+    depth2_row = frame_stats(depth2_runs, "gol_relay_depth2_frames")
+
+    # -- fan-out economics (clients first, then the session) -----------------
+    def run_fanout(rep: int) -> dict:
+        tenant = f"relay-fan-{rep}"
+        before = reg.snapshot(include_lazy=False)
+        r1, r2 = chain2(
+            f"{gateway.url}/v1/sessions/{tenant}/frames", fan_turns
+        )
+        results: list = []
+        res_lock = threading.Lock()
+
+        def leaf(relay_url: str) -> None:
+            times: dict = {}
+            nbytes = 0
+            try:
+                _, nbytes = drain(
+                    relay_url, "/v1/frames", fan_turns + 2, times=times
+                )
+            except (ws_lib.WsClosed, OSError, ValueError):
+                pass  # a lost simulated viewer skews nothing but N
+            with res_lock:
+                results.append((times, nbytes))
+
+        threads = [
+            threading.Thread(
+                target=leaf, args=((r1 if i % 2 else r2).url,), daemon=True
+            )
+            for i in range(fan_clients)
+        ]
+        for t in threads:
+            t.start()
+        submit(tenant, fan_size, fan_turns)
+        oracle_times: dict = {}
+        oracle = threading.Thread(
+            target=drain,
+            args=(gateway.url, f"/v1/sessions/{tenant}/frames",
+                  fan_turns + 2, oracle_times),
+            daemon=True,
+        )
+        oracle.start()
+        time.sleep(0.3)  # mid-run: how many sockets does the pod hold?
+        gauges = reg.snapshot(include_lazy=False).to_dict().get("gauges", {})
+        pod_sockets = gauges.get("gateway.spectators")
+        oracle.join(timeout=600)
+        for t in threads:
+            t.join(timeout=600)
+        health1, health2 = r1.health(), r2.health()
+        r2.close()
+        r1.close()
+        delta = reg.snapshot(include_lazy=False).delta(before).to_dict()
+        counters = delta.get("counters", {})
+        samples = [
+            t_recv - oracle_times[turn]
+            for times, _ in results
+            for turn, t_recv in times.items()
+            if turn in oracle_times
+        ]
+        samples.sort()
+        client_bytes = sum(nbytes for _, nbytes in results)
+        publishes = counters.get("frames.publishes", 0)
+        return {
+            "clients": len(results),
+            "staleness_p99_s": (
+                max(samples[int(0.99 * (len(samples) - 1))], 1e-6)
+                if samples else None
+            ),
+            "staleness_samples": len(samples),
+            "client_bytes": client_bytes,
+            "upstream_bytes": health1["bytes_in"],
+            "egress_amplification": (
+                client_bytes / health1["bytes_in"]
+                if health1["bytes_in"] else None
+            ),
+            "pod_spectator_sockets": pod_sockets,
+            "fetches_per_frame": (
+                counters.get("frames.fetches", 0) / publishes
+                if publishes else None
+            ),
+            "cache_serves": (
+                health1["cache_serves"] + health2["cache_serves"]
+            ),
+            "relay_drops": health1["drops"] + health2["drops"],
+        }
+
+    fan_runs = [run_fanout(rep) for rep in range(max(2, fan_reps))]
+    p99s = [r["staleness_p99_s"] for r in fan_runs if r["staleness_p99_s"]]
+    amps = [
+        r["egress_amplification"] for r in fan_runs
+        if r["egress_amplification"]
+    ]
+    fetch_ratio = [
+        r["fetches_per_frame"] for r in fan_runs if r["fetches_per_frame"]
+    ]
+
+    record = {
+        "bench": "relay",
+        "size": size,
+        "turns": turns,
+        "endpoint": gateway.url,
+        "ab": {
+            "direct": direct_row,
+            "depth2": depth2_row,
+            "relay_overhead_ratio": (
+                depth2_row["bytes_per_frame"] / direct_row["bytes_per_frame"]
+            ),
+        },
+        "fanout": {
+            "clients": fan_clients,
+            "relays": 2,
+            "size": fan_size,
+            "turns": fan_turns,
+            "staleness_p99": {
+                "metric": "gol_relay_fanout_staleness_p99",
+                "unit": "seconds",
+                **measure.summarize(p99s),
+            },
+            "egress_amplification": measure.median(amps),
+            "fetches_per_frame": measure.median(fetch_ratio),
+            "pod_spectator_sockets": fan_runs[0]["pod_spectator_sockets"],
+            "runs": fan_runs,
+        },
+        "metrics": reg.snapshot(include_lazy=False).to_dict(),
+    }
+    gateway.close()
+    plane.close()
+    log(
+        f"  relay: depth-2 {depth2_row['median']:.1f} frames/s vs "
+        f"{direct_row['median']:.1f} direct "
+        f"(bytes/frame x{record['ab']['relay_overhead_ratio']:.3f}); "
+        f"fan-out {fan_clients} clients @ "
+        f"x{record['fanout']['egress_amplification']:.0f} egress "
+        f"amplification, p99 staleness "
+        f"{record['fanout']['staleness_p99']['median'] * 1e3:.1f} ms, "
+        f"{record['fanout']['fetches_per_frame']:.2f} fetches/frame"
+    )
+    return record
+
+
 def bench_federation(reps: int = 3, ops: int = 20, size: int = 64) -> dict:
     """ISSUE 17: the federation tier's two cost questions, interleaved
     per rep (``utils/measure.py`` discipline — a rig phase change cannot
@@ -2194,6 +2484,26 @@ def main():
         help="wire spectator count for --gateway",
     )
     ap.add_argument(
+        "--relay",
+        action="store_true",
+        help="spectator-relay mode (ISSUE 18): interleaved direct vs "
+        "depth-2 relay-chain A/B on a live loopback pod (frames/s and "
+        "wire bytes/frame — relays forward payload bytes verbatim) "
+        "plus the fan-out economics arm: >=256 simulated viewers "
+        "behind 2 chained relays on ONE upstream subscription — "
+        "egress amplification, p99 frame staleness vs a direct "
+        "oracle, and the pod fetches/frame == 1.00 pin preserved "
+        "through the tree.  Prints one lint-checked JSON line and "
+        "exits (BENCH_RELAY artifact).",
+    )
+    ap.add_argument(
+        "--relay-clients",
+        type=int,
+        default=256,
+        metavar="N",
+        help="simulated viewer count for --relay's fan-out arm",
+    )
+    ap.add_argument(
         "--federation",
         action="store_true",
         help="federation-broker mode (ISSUE 17): interleaved per-rep "
@@ -2348,6 +2658,20 @@ def main():
             spectators=args.gateway_spectators,
             reps=max(args.reps, 5),
         )
+        measure.require_headline_stats(record)
+        obs_metrics.require_embedded_metrics(record)
+        print(json.dumps(record))
+        return
+
+    if args.relay:
+        # Small boards by design, like --gateway: a relay never touches
+        # a device — its cost is sockets and one memcpy per write.
+        record = bench_relay(
+            size if size <= 1024 else 256,
+            reps=max(args.reps, 5),
+            fan_clients=args.relay_clients,
+        )
+        record["platform"] = dev.platform
         measure.require_headline_stats(record)
         obs_metrics.require_embedded_metrics(record)
         print(json.dumps(record))

@@ -674,6 +674,71 @@ def broker_main(argv) -> int:
     return 0
 
 
+def relay_main(argv) -> int:
+    """The ``relay`` subcommand (ISSUE 18): one node of the spectator
+    broadcast tree — subscribe ONCE to an upstream frame stream (a
+    gateway pod's spectator leg, or another relay) and re-fan it to M
+    downstream WebSocket viewers off the local re-keyframe cache
+    (docs/API.md "Relay tier").  Like the broker, a relay never touches
+    a device: runnable on a machine with no accelerator at all."""
+    import time
+
+    from distributed_gol_tpu.serve.relay import (
+        BACKOFF_MAX,
+        DEFAULT_CACHE_DELTAS,
+        DEFAULT_QUEUE_DEPTH,
+        RelayServer,
+    )
+
+    ap = argparse.ArgumentParser(
+        prog="distributed_gol_tpu relay",
+        description="spectator relay: subscribe once upstream, fan the "
+        "frame stream to M downstream viewers (chainable to any depth)",
+    )
+    ap.add_argument("--upstream", required=True, metavar="URL",
+                    help="the spectator stream to relay: a gateway leg "
+                    "(http://pod/v1/sessions/<t>/frames?rect=...) or "
+                    "another relay (http://relay/v1/frames)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="relay bind port (0 = ephemeral; the bound URL "
+                    "is printed to stderr and published as the "
+                    "relay.endpoint info label)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--cache-deltas", type=int,
+                    default=DEFAULT_CACHE_DELTAS, metavar="N",
+                    help="deltas retained past the cached keyframe "
+                    "before compaction (the late-joiner window)")
+    ap.add_argument("--queue-depth", type=int,
+                    default=DEFAULT_QUEUE_DEPTH, metavar="N",
+                    help="per-viewer bounded queue depth (drop-oldest "
+                    "+ cache resync past it)")
+    ap.add_argument("--backoff-max", type=float, default=BACKOFF_MAX,
+                    help="resubscribe backoff cap, seconds")
+    args = ap.parse_args(argv)
+    relay = RelayServer(
+        args.upstream,
+        port=args.port,
+        host=args.host,
+        cache_deltas=args.cache_deltas,
+        queue_depth=args.queue_depth,
+        backoff_max=args.backoff_max,
+    )
+    print(
+        f"relay: {relay.url}/v1/frames <- {args.upstream} "
+        f"(watch with tools/gol_client.py --relay {relay.url}; "
+        f"chain with --upstream {relay.url}/v1/frames)",
+        file=sys.stderr,
+    )
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        relay.close()
+    return 0
+
+
 def main(argv=None) -> int:
     honour_env_platforms()
     if argv is None:
@@ -682,6 +747,8 @@ def main(argv=None) -> int:
         return serve_main(argv[1:])
     if argv and argv[0] == "broker":
         return broker_main(argv[1:])
+    if argv and argv[0] == "relay":
+        return relay_main(argv[1:])
     ap = build_parser()
     args = ap.parse_args(argv)
     try:

@@ -22,6 +22,7 @@ from distributed_gol_tpu.serve.gateway import (
     serve_plane_gateway,
 )
 from distributed_gol_tpu.serve.plane import ServePlane, SessionHandle
+from distributed_gol_tpu.serve.relay import RelayServer
 from distributed_gol_tpu.serve.podclient import (
     PodClient,
     PodHTTPError,
@@ -44,6 +45,7 @@ __all__ = [
     "PodClient",
     "PodHTTPError",
     "PodUnreachable",
+    "RelayServer",
     "ServeConfig",
     "ServePlane",
     "SessionHandle",

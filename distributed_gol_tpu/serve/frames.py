@@ -61,13 +61,24 @@ class FrameSubscriber:
         self._last = None  # last shipped frame (the delta base)
         self._dropped = False  # a frame was dropped: next ship keyframes
 
-    def _ship(self, turn: int, frame: np.ndarray, rect) -> int:
+    def _needs_keyframe(self, frame: np.ndarray) -> bool:
+        """Whether the next ship must keyframe (un-anchored: first
+        frame, rect change, post-drop) — read by the publisher BEFORE
+        encoding so anchored same-rect subscribers can share one delta
+        encode (the per-distinct-rect dedup)."""
+        last = self._last
+        return last is None or self._dropped or last.shape != frame.shape
+
+    def _ship(self, turn: int, frame: np.ndarray, rect, bands=None) -> int:
         """Enqueue this turn's frame for the spectator — keyframe when
         un-anchored (first frame, rect change, post-drop), else delta
         bands.  ``rect`` is the publisher's SNAPSHOT of this
         subscriber's viewport (taken under the plane lock), so the
         event's rect always labels the content actually shipped even if
-        ``set_viewport`` raced the publish.  Returns payload bytes
+        ``set_viewport`` raced the publish.  ``bands`` is the
+        publisher's shared per-rect delta encoding (computed once per
+        distinct rect); None computes it here — only legal against
+        this subscriber's own ``_last``.  Returns payload bytes
         shipped."""
         last = self._last
         self._last = frame
@@ -76,7 +87,8 @@ class FrameSubscriber:
             ev = FrameReady(turn, frame, rect=rect)
             nbytes = frame.nbytes
         else:
-            bands = frames_lib.delta_bands(last, frame)
+            if bands is None:
+                bands = frames_lib.delta_bands(last, frame)
             ev = FrameDelta(turn, bands=bands, rect=rect)
             nbytes = frames_lib.bands_nbytes(bands)
         while True:
@@ -248,7 +260,17 @@ class FramePlane:
             self._m_bytes_fetched.inc(superset.nbytes)
             by0, bx0, bvh, bvw = rect
             shipped = 0
-            for sub, (sy, sx, svh, svw) in subs:
+            # Group same-rect subscribers: the slice, the contiguous
+            # copy, AND the delta encoding are computed once per
+            # DISTINCT rect, not once per subscriber (the relay-tree
+            # workload is many watchers of one rect).  Sharing one
+            # frame array as every member's ``_last`` is what keeps the
+            # dedup exact next turn: anchored members' delta bases are
+            # the identical object.
+            groups: dict[tuple, list] = {}
+            for sub, srect in subs:
+                groups.setdefault(srect, []).append(sub)
+            for (sy, sx, svh, svw), members in groups.items():
                 # Subscriber offset inside the fetched superset.
                 # Coverage guarantees oy + svh <= bvh whenever bvh < h;
                 # a full-axis superset (bvh == h) is the whole ring
@@ -266,11 +288,26 @@ class FramePlane:
                     if ox + svw <= bvw
                     else (np.arange(svw) + ox) % bvw
                 )
-                view = superset[rows][:, cols]
-                shipped += sub._ship(
-                    turn, np.ascontiguousarray(view), (sy, sx, svh, svw)
-                )
-                self._m_frames.inc()
+                view = np.ascontiguousarray(superset[rows][:, cols])
+                # One encode per distinct delta base — in steady state
+                # exactly one per rect (every anchored member's _last
+                # is last turn's shared array).  The base is kept in
+                # the cache entry so its id cannot be recycled mid-loop.
+                enc: dict[int, tuple] = {}
+                for sub in members:
+                    bands = None
+                    last = sub._last
+                    if not sub._needs_keyframe(view):
+                        hit = enc.get(id(last))
+                        if hit is None:
+                            bands = frames_lib.delta_bands(last, view)
+                            enc[id(last)] = (last, bands)
+                        else:
+                            bands = hit[1]
+                    shipped += sub._ship(
+                        turn, view, (sy, sx, svh, svw), bands=bands
+                    )
+                    self._m_frames.inc()
             self._m_bytes_shipped.inc(shipped)
         return {
             "subscribers": len(subs),

@@ -1,0 +1,513 @@
+"""Spectator relay tier (ISSUE 18) — broadcast-tree frame fan-out.
+
+The FramePlane (ISSUE 11) fans ONE device fetch to N direct
+subscribers, and the gateway (ISSUE 14) puts that stream on the wire —
+but N is bounded by one pod's sockets and egress.  This module is the
+tier that unbounds it: a :class:`RelayServer` is a standalone process
+(stdlib + the existing ``serve/ws.py`` codec and ``serve/httpd.py``
+scaffolding, never a device) that subscribes ONCE to an upstream
+spectator stream — a gateway pod, or ANOTHER relay, so trees chain to
+arbitrary depth — and re-fans the frames to M downstream WebSocket
+clients.  Depth 2–3 of modest fan-out reaches 10⁶ viewers while the
+pod still pays one device fetch and one spectator socket per subtree.
+
+Hot-path contract (the perf_opt):
+
+- **Header-only decode.**  Each upstream binary frame is parsed to its
+  length-prefixed JSON header (``type``/``turn``/``rect``) and no
+  further — payload bytes are never touched, let alone re-encoded.
+- **Single-serialize / multi-write.**  The outgoing WebSocket frame is
+  encoded ONCE per upstream message (``ws.encode_server_frame``) and
+  the same buffer is written to every downstream socket
+  (``WebSocket.send_raw`` over a ``memoryview``) — fan-out cost is M
+  writes, not M serializations.
+- **Re-keyframe cache.**  The last keyframe plus every delta since
+  (bounded at ``cache_deltas``) is retained verbatim; late joiners and
+  drop-recovered clients are served from it LOCALLY — zero upstream
+  round trips, the pod never learns a viewer joined.  When the delta
+  tail would overflow, the cache is *compacted*: the retained frames
+  are folded into one synthesized keyframe (the single place the relay
+  decodes payload bytes — amortized one band-apply per frame, and one
+  keyframe encode per ``cache_deltas`` frames).
+- **Stall isolation.**  Per-downstream bounded queues drop OLDEST on
+  overflow and flag the client for a cache resync (keyframe + deltas,
+  then live) — one stalled viewer never backpressures the tree, same
+  contract as the FramePlane it mirrors.
+- **Seq-gap resubscribe.**  An upstream disconnect triggers
+  capped-exponential-backoff resubscription.  Frames may have been
+  missed in the gap, so deltas are REFUSED until the new
+  subscription's keyframe arrives (a fresh FramePlane subscriber — or
+  a parent relay's cache — always keyframes first); relaying that
+  keyframe verbatim is what re-keyframes the whole subtree.  The cache
+  keeps serving late joiners across the outage.
+
+Observability: ``relay.*`` counters on the relay's own registry,
+``/healthz`` (body carries ``"relay": true`` — what flips
+``tools/pod_top.py`` into the relay view) and ``/metrics``
+(OpenMetrics).  Downstream endpoint: ``GET /v1/frames`` (upgrade) —
+``/v1/sessions/<anything>/frames`` is an alias, so
+``tools/gol_client.py`` spectates a relay with no client-side changes.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import queue
+import struct
+import threading
+import time
+from urllib.parse import urlsplit
+
+from distributed_gol_tpu.obs import metrics as metrics_lib
+from distributed_gol_tpu.obs import openmetrics
+from distributed_gol_tpu.serve import ws as ws_lib
+from distributed_gol_tpu.serve.httpd import StdlibHTTPServer
+from distributed_gol_tpu.serve.ws import WsClosed
+
+#: Default per-downstream queue depth (frames) — the FramePlane default.
+DEFAULT_QUEUE_DEPTH = 8
+
+#: Default cached-delta bound before compaction.
+DEFAULT_CACHE_DELTAS = 64
+
+#: Resubscribe backoff curve: initial and cap, seconds.
+BACKOFF_INITIAL = 0.25
+BACKOFF_MAX = 5.0
+
+
+def _parse_frame_header(blob) -> dict:
+    """The JSON header of one spectator wire message — the ONLY part of
+    an upstream frame the relay hot path decodes (payload bytes ride
+    through verbatim)."""
+    if len(blob) < 4:
+        raise ValueError("frame message shorter than its length prefix")
+    (hlen,) = struct.unpack_from(">I", blob)
+    if 4 + hlen > len(blob):
+        raise ValueError("frame header truncated")
+    return json.loads(bytes(blob[4 : 4 + hlen]))
+
+
+def _wire_blob(frame: bytes) -> bytes:
+    """The spectator wire message inside a cached ws frame (strip the
+    ws header) — the compaction path's inverse of
+    ``ws.encode_server_frame``."""
+    n7 = frame[1] & 0x7F
+    off = 2 + (2 if n7 == 126 else 8 if n7 == 127 else 0)
+    return frame[off:]
+
+
+class _Downstream:
+    """One relayed viewer: a bounded frame queue (drop-oldest) and the
+    resync flag its pump services from the cache."""
+
+    def __init__(self, cid: int, depth: int):
+        self.id = cid
+        self.frames: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self.dropped = False  # overflowed: pump resyncs from the cache
+
+
+class RelayServer(StdlibHTTPServer):
+    """One relay node.  ``upstream`` is a spectator stream URL — a
+    gateway leg (``http://pod/v1/sessions/<t>/frames?rect=...``) or
+    another relay (``http://relay/v1/frames``).  ``port=0`` binds
+    ephemeral and publishes the URL as the ``relay.endpoint`` info
+    label on the relay's own registry."""
+
+    thread_name = "gol-relay-http"
+
+    def __init__(
+        self,
+        upstream: str,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        cache_deltas: int = DEFAULT_CACHE_DELTAS,
+        queue_depth: int = DEFAULT_QUEUE_DEPTH,
+        backoff_initial: float = BACKOFF_INITIAL,
+        backoff_max: float = BACKOFF_MAX,
+        connect_timeout: float = 10.0,
+        registry=None,
+    ):
+        self.upstream = upstream
+        self._cache_max = max(1, int(cache_deltas))
+        self._queue_depth = max(1, int(queue_depth))
+        self._backoff_initial = backoff_initial
+        self._backoff_max = backoff_max
+        self._connect_timeout = connect_timeout
+
+        self._lock = threading.Lock()
+        self._clients: dict[int, _Downstream] = {}
+        self._ids = itertools.count(1)
+        #: The re-keyframe cache: (turn, encoded ws frame) anchor plus
+        #: the verbatim delta tail since it.
+        self._cache_key: tuple[int, bytes] | None = None
+        self._cache_deltas: list[tuple[int, bytes]] = []
+        #: Seq-gap latch: True while inbound deltas cannot be assumed
+        #: contiguous with the cache (fresh start, post-reconnect) —
+        #: they are refused until a keyframe re-anchors the stream.
+        self._gap = True
+        self._hello: dict = {"type": "hello", "tenant": None, "rect": None}
+        self._turn = 0
+        self._connected = False
+        self._ended = threading.Event()
+        self._closing = False
+        self._upstream_ws = None
+
+        reg = registry if registry is not None else metrics_lib.MetricsRegistry()
+        self._m_frames_in = reg.counter("relay.frames_in")
+        self._m_frames_out = reg.counter("relay.frames_out")
+        self._m_bytes_in = reg.counter("relay.bytes_in")
+        self._m_bytes_out = reg.counter("relay.bytes_out")
+        self._m_drops = reg.counter("relay.drops")
+        self._m_cache_serves = reg.counter("relay.cache_serves")
+        self._m_resubscribes = reg.counter("relay.resubscribes")
+        self._g_clients = reg.gauge("relay.clients")
+        self._g_clients.set(0)
+        reg.info("relay.upstream", upstream)
+        super().__init__(port=port, host=host, registry=reg)
+        reg.info("relay.endpoint", self.url)
+        self._thread_up = threading.Thread(
+            target=self._upstream_loop, name="gol-relay-upstream", daemon=True
+        )
+        self._thread_up.start()
+
+    # -- lifecycle -------------------------------------------------------------
+    def close(self) -> None:
+        self._closing = True
+        u = self._upstream_ws
+        if u is not None:
+            u.abort()  # unblock the reader parked in recv
+        super().close()
+
+    # -- the upstream leg ------------------------------------------------------
+    def _connect_upstream(self):
+        u = urlsplit(self.upstream)
+        path = u.path or "/v1/frames"
+        if u.query:
+            path += "?" + u.query
+        return ws_lib.client_connect(
+            u.hostname or "127.0.0.1",
+            u.port or 80,
+            path,
+            timeout=self._connect_timeout,
+        )
+
+    def _upstream_loop(self) -> None:
+        """Subscribe ONCE; on disconnect, capped-backoff resubscribe.
+        Every (re)connection opens the seq-gap latch — the new
+        subscription's first keyframe closes it and, relayed verbatim,
+        re-keyframes the whole downstream subtree."""
+        backoff = self._backoff_initial
+        first = True
+        while not self._closing and not self._ended.is_set():
+            if not first:
+                self._m_resubscribes.inc()
+                time.sleep(backoff)
+                backoff = min(backoff * 2, self._backoff_max)
+            first = False
+            try:
+                wsock = self._connect_upstream()
+            except (OSError, WsClosed, ValueError):
+                continue
+            self._upstream_ws = wsock
+            # Frames can be arbitrarily sparse (a paused session): the
+            # reader blocks without an idle timeout; close()/abort()
+            # unblocks it.
+            wsock.settimeout(None)
+            with self._lock:
+                self._connected = True
+                self._gap = True
+            try:
+                while not self._closing:
+                    op, payload = wsock.recv()
+                    if op == ws_lib.OP_TEXT:
+                        self._on_text(payload)
+                        if self._ended.is_set():
+                            break
+                        continue
+                    self._ingest(payload)
+                    backoff = self._backoff_initial
+            except (WsClosed, OSError, ValueError):
+                pass
+            finally:
+                with self._lock:
+                    self._connected = False
+                wsock.close()
+        self._upstream_ws = None
+
+    def _on_text(self, payload) -> None:
+        try:
+            msg = json.loads(payload)
+        except ValueError:
+            return
+        kind = msg.get("type")
+        if kind == "hello":
+            with self._lock:
+                self._hello = {
+                    "type": "hello",
+                    "tenant": msg.get("tenant"),
+                    "rect": msg.get("rect"),
+                }
+                self._turn = max(self._turn, int(msg.get("turn") or 0))
+        elif kind == "end":
+            self._ended.set()
+            # Wake every pump NOW (a None sentinel through the normal
+            # queue) instead of waiting out its poll timeout — end
+            # propagation stays prompt at any tree depth.
+            with self._lock:
+                for c in self._clients.values():
+                    self._offer(c, None)
+
+    def _ingest(self, blob) -> None:
+        """One upstream binary frame: header-only decode, cache update,
+        single-serialize, fan-out.  The encoded ws frame is built ONCE;
+        every downstream queue gets the same buffer."""
+        header = _parse_frame_header(blob)
+        kind = header.get("type")
+        turn = int(header.get("turn") or 0)
+        self._m_frames_in.inc()
+        self._m_bytes_in.inc(len(blob))
+        frame = ws_lib.encode_server_frame(ws_lib.OP_BINARY, blob)
+        with self._lock:
+            if kind == "keyframe":
+                self._cache_key = (turn, frame)
+                self._cache_deltas.clear()
+                self._gap = False
+                if header.get("rect") is not None:
+                    self._hello["rect"] = header["rect"]
+            elif kind == "delta":
+                if self._gap or self._cache_key is None:
+                    # Seq gap: a delta with no contiguous anchor cannot
+                    # apply anywhere downstream — refuse it; the
+                    # upstream re-keyframe re-anchors the stream.
+                    self._m_drops.inc()
+                    return
+                self._cache_deltas.append((turn, frame))
+                if len(self._cache_deltas) > self._cache_max:
+                    self._compact_locked()
+            else:
+                return  # unknown frame kind: not relayed
+            self._turn = turn
+            mv = memoryview(frame)
+            for c in self._clients.values():
+                self._offer(c, mv)
+
+    def _offer(self, c: _Downstream, frame) -> None:
+        """Bounded fan-out put: drop OLDEST and flag the client for a
+        cache resync — a stalled viewer loses frames, never stalls the
+        tree.  Caller holds the relay lock (one producer; the lock is
+        what makes cache snapshot + queue contents gap-free)."""
+        while True:
+            try:
+                c.frames.put_nowait(frame)
+                return
+            except queue.Full:
+                c.dropped = True
+                self._m_drops.inc()
+                try:
+                    c.frames.get_nowait()
+                except queue.Empty:
+                    pass
+
+    def _compact_locked(self) -> None:
+        """Fold the cached delta tail into one synthesized keyframe so
+        the cache stays bounded while late joiners are ALWAYS served —
+        the only place the relay touches payload bytes, amortized one
+        band-apply per frame plus one keyframe encode per
+        ``cache_deltas`` frames.  Live streams never see the synthetic
+        keyframe; it only anchors future cache serves."""
+        import numpy as np
+
+        from distributed_gol_tpu.engine import frames as frames_lib
+        from distributed_gol_tpu.engine.events import FrameReady
+        from distributed_gol_tpu.serve import wire
+
+        key_turn, key_frame = self._cache_key
+        ev = wire.decode_frame_event(_wire_blob(key_frame))
+        buf = np.array(ev.frame, dtype=np.uint8, copy=True)
+        turn = key_turn
+        for turn, frame in self._cache_deltas:
+            delta = wire.decode_frame_event(_wire_blob(frame))
+            frames_lib.apply_bands(buf, delta.bands)
+        blob = wire.encode_frame_event(FrameReady(turn, buf, rect=ev.rect))
+        self._cache_key = (
+            turn, ws_lib.encode_server_frame(ws_lib.OP_BINARY, blob)
+        )
+        self._cache_deltas.clear()
+
+    def _cache_frames_locked(self) -> list:
+        """Keyframe + delta tail, in ship order (caller holds the
+        lock) — what a late joiner or a drop-recovered client is
+        served.  Empty until the first upstream keyframe lands."""
+        if self._cache_key is None:
+            return []
+        out = [self._cache_key[1]]
+        out.extend(frame for _, frame in self._cache_deltas)
+        return out
+
+    # -- the downstream leg ----------------------------------------------------
+    def handle(self, request, method: str, path: str, query: dict) -> bool:
+        if path == "/healthz" and method == "GET":
+            health = self.health()
+            request._send_json(200 if health["ready"] else 503, health)
+            return True
+        if path == "/metrics" and method == "GET":
+            text = openmetrics.render(self.registry.snapshot().to_dict())
+            request._send(200, text.encode(), openmetrics.CONTENT_TYPE)
+            return True
+        if method == "GET" and (
+            path == "/v1/frames"
+            or (path.startswith("/v1/sessions/") and path.endswith("/frames"))
+        ):
+            return self._downstream_ws(request, query)
+        return False
+
+    def health(self) -> dict:
+        with self._lock:
+            cache = {
+                "anchored": self._cache_key is not None,
+                "keyframe_turn": (
+                    self._cache_key[0] if self._cache_key else None
+                ),
+                "deltas": len(self._cache_deltas),
+            }
+            out = {
+                "relay": True,
+                "ready": self._connected or cache["anchored"],
+                "connected": self._connected,
+                "ended": self._ended.is_set(),
+                "upstream": self.upstream,
+                "endpoint": self.url,
+                "tenant": self._hello.get("tenant"),
+                "rect": self._hello.get("rect"),
+                "turn": self._turn,
+                "clients": len(self._clients),
+                "cache": cache,
+            }
+        for name, counter in (
+            ("frames_in", self._m_frames_in),
+            ("frames_out", self._m_frames_out),
+            ("bytes_in", self._m_bytes_in),
+            ("bytes_out", self._m_bytes_out),
+            ("drops", self._m_drops),
+            ("cache_serves", self._m_cache_serves),
+            ("resubscribes", self._m_resubscribes),
+        ):
+            out[name] = counter.value
+        return out
+
+    def _downstream_ws(self, request, query) -> bool:
+        try:
+            depth = max(1, int(query.get("queue", self._queue_depth)))
+        except ValueError:
+            request._send_json(400, {"error": "bad queue depth"})
+            return True
+        # Liveness over staleness, same as the gateway's spectator leg:
+        # bound kernel send buffering so a stalled client's backpressure
+        # reaches the drop-oldest queue within a few frames.
+        try:
+            import socket as socket_mod
+
+            request.connection.setsockopt(
+                socket_mod.SOL_SOCKET, socket_mod.SO_SNDBUF, 1 << 16
+            )
+        except OSError:
+            pass
+        wsock = ws_lib.server_upgrade(request)
+        if wsock is None:
+            return True
+        c = _Downstream(next(self._ids), depth)
+        with self._lock:
+            hello = dict(self._hello)
+            hello["turn"] = self._turn
+            hello["relay"] = True
+            snapshot = self._cache_frames_locked()
+            self._clients[c.id] = c
+            self._g_clients.set(len(self._clients))
+        dead = threading.Event()
+        try:
+            wsock.send_text(json.dumps(hello))
+            self._serve_frames(wsock, snapshot, cached=True)
+            self._start_reader(wsock, dead)
+            while not dead.is_set() and not self._closing:
+                if c.dropped:
+                    # Drop recovery, served locally: snapshot the cache
+                    # and clear the queue under the SAME lock the
+                    # producer fans out under — everything fanned out
+                    # after this snapshot is still in (or headed for)
+                    # the queue, so the stream stays contiguous.
+                    with self._lock:
+                        snapshot = self._cache_frames_locked()
+                        while True:
+                            try:
+                                c.frames.get_nowait()
+                            except queue.Empty:
+                                break
+                        c.dropped = False
+                    self._serve_frames(wsock, snapshot, cached=True)
+                    continue
+                try:
+                    frame = c.frames.get(timeout=0.25)
+                except queue.Empty:
+                    if self._ended.is_set():
+                        wsock.send_text(json.dumps({"type": "end"}))
+                        break
+                    continue
+                if frame is None:  # end sentinel: drain then close out
+                    if c.frames.empty() and self._ended.is_set():
+                        wsock.send_text(json.dumps({"type": "end"}))
+                        break
+                    continue
+                self._serve_frames(wsock, (frame,), cached=False)
+        except (WsClosed, OSError):
+            pass  # viewer left; the tree loses one leaf
+        finally:
+            with self._lock:
+                self._clients.pop(c.id, None)
+                self._g_clients.set(len(self._clients))
+            wsock.close()
+        return True
+
+    def _serve_frames(self, wsock, frames, cached: bool) -> None:
+        """Multi-write half of the hot path: pre-encoded frames go out
+        verbatim.  ``cached`` counts re-keyframe-cache serves (late
+        join, drop recovery) apart from live relay."""
+        for frame in frames:
+            n = wsock.send_raw(frame)
+            self._m_frames_out.inc()
+            self._m_bytes_out.inc(n)
+            if cached:
+                self._m_cache_serves.inc()
+
+    def _start_reader(self, wsock, dead) -> None:
+        """Inbound frames from a viewer: the relay's streams are
+        fixed-rect (one upstream subscription serves every leaf), so
+        control frames are answered with an error, never forwarded —
+        and a disconnect flags the pump."""
+
+        def reader():
+            try:
+                while True:
+                    wsock.recv()
+                    wsock.send_text(json.dumps({
+                        "type": "error",
+                        "error": "relay streams are fixed-rect; "
+                                 "set_viewport is not supported here",
+                    }))
+            except (WsClosed, OSError, ValueError):
+                pass
+            finally:
+                dead.set()
+
+        threading.Thread(
+            target=reader, name="gol-relay-ws-reader", daemon=True
+        ).start()
+
+
+__all__ = [
+    "BACKOFF_INITIAL",
+    "BACKOFF_MAX",
+    "DEFAULT_CACHE_DELTAS",
+    "DEFAULT_QUEUE_DEPTH",
+    "RelayServer",
+]

@@ -48,15 +48,48 @@ def accept_key(key: str) -> str:
     return base64.b64encode(digest).decode()
 
 
-def _mask(data: bytes, key: bytes) -> bytes:
-    """XOR-mask ``data`` with the 4-byte ``key`` (involutive)."""
+def _mask(data, key) -> "bytes | bytearray":
+    """XOR-mask ``data`` with the 4-byte ``key`` (involutive).  A
+    ``bytearray`` is masked IN PLACE and returned — the receive path
+    unmasks each payload inside the buffer it was read into, so a
+    frame costs one allocation, not one per mask pass."""
     n = len(data)
     if not n:
         return data
-    rep = (key * (n // 4 + 1))[:n]
-    return (
-        int.from_bytes(data, "little") ^ int.from_bytes(rep, "little")
-    ).to_bytes(n, "little")
+    rep = (bytes(key) * (n // 4 + 1))[:n]
+    word = int.from_bytes(data, "little") ^ int.from_bytes(rep, "little")
+    if isinstance(data, bytearray):
+        data[:] = word.to_bytes(n, "little")
+        return data
+    return word.to_bytes(n, "little")
+
+
+def _frame_head(opcode: int, n: int, mask_bit: int) -> bytearray:
+    """The frame header for an ``n``-byte payload (no mask key)."""
+    head = bytearray([0x80 | opcode])
+    if n < 126:
+        head.append(mask_bit | n)
+    elif n < 1 << 16:
+        head.append(mask_bit | 126)
+        head += struct.pack(">H", n)
+    else:
+        head.append(mask_bit | 127)
+        head += struct.pack(">Q", n)
+    return head
+
+
+def encode_server_frame(opcode: int, payload) -> bytes:
+    """One complete UNMASKED (server→client) frame: header + payload in
+    a single buffer.  The relay's single-serialize/multi-write seam —
+    encode the frame ONCE, then :meth:`WebSocket.send_raw` the same
+    ``memoryview`` into every downstream socket.  Byte-identical to
+    what ``_send`` puts on the wire from a server endpoint."""
+    n = len(payload)
+    if n > MAX_PAYLOAD:
+        raise ValueError(f"payload of {n} bytes exceeds MAX_PAYLOAD")
+    head = _frame_head(opcode, n, 0)
+    head += payload
+    return bytes(head)
 
 
 class WebSocket:
@@ -79,35 +112,52 @@ class WebSocket:
     def send_text(self, text: str) -> int:
         return self._send(OP_TEXT, text.encode())
 
-    def send_binary(self, payload: bytes) -> int:
-        return self._send(OP_BINARY, bytes(payload))
+    def send_binary(self, payload) -> int:
+        return self._send(OP_BINARY, payload)
 
     def ping(self, payload: bytes = b"") -> None:
         self._send(OP_PING, payload)
 
-    def _send(self, opcode: int, payload: bytes) -> int:
-        n = len(payload)
-        if n > MAX_PAYLOAD:
-            raise ValueError(f"payload of {n} bytes exceeds MAX_PAYLOAD")
-        head = bytearray([0x80 | opcode])
-        mask_bit = 0x80 if self._mask_frames else 0
-        if n < 126:
-            head.append(mask_bit | n)
-        elif n < 1 << 16:
-            head.append(mask_bit | 126)
-            head += struct.pack(">H", n)
-        else:
-            head.append(mask_bit | 127)
-            head += struct.pack(">Q", n)
+    def send_raw(self, frame) -> int:
+        """Write a pre-encoded frame (:func:`encode_server_frame`)
+        verbatim — the multi-write half of the relay's
+        single-serialize/multi-write fan-out.  Only legal on an
+        unmasked (server) endpoint: a masked one needs a fresh key —
+        and a fresh serialization — per frame."""
         if self._mask_frames:
-            key = os.urandom(4)
-            head += key
-            payload = _mask(payload, key)
+            raise ValueError("send_raw requires an unmasked (server) "
+                             "endpoint")
         with self._send_lock:
             if self.closed:
                 raise WsClosed("websocket is closed")
             try:
-                self._w.write(bytes(head) + payload)
+                self._w.write(frame)
+                self._w.flush()
+            except (OSError, ValueError) as e:
+                self.closed = True
+                raise WsClosed(f"send failed: {e}") from e
+        return len(frame)
+
+    def _send(self, opcode: int, payload) -> int:
+        n = len(payload)
+        if n > MAX_PAYLOAD:
+            raise ValueError(f"payload of {n} bytes exceeds MAX_PAYLOAD")
+        head = _frame_head(opcode, n, 0x80 if self._mask_frames else 0)
+        if self._mask_frames:
+            key = os.urandom(4)
+            head += key
+            # Mask a COPY (bytes in, bytes out): the caller's buffer is
+            # not ours to scramble, even involutively.
+            payload = _mask(bytes(payload), key)
+        with self._send_lock:
+            if self.closed:
+                raise WsClosed("websocket is closed")
+            try:
+                # Two buffered writes, one flush: no header+payload
+                # concatenation copy on the hot path.
+                self._w.write(head)
+                if n:
+                    self._w.write(payload)
                 self._w.flush()
             except (OSError, ValueError) as e:
                 self.closed = True
@@ -158,27 +208,49 @@ class WebSocket:
         key = self._read_exact(4) if masked else None
         payload = self._read_exact(n)
         if key is not None:
-            payload = _mask(payload, key)
+            payload = _mask(payload, key)  # in place: payload is ours
         return op, fin, payload
 
-    def _read_exact(self, n: int) -> bytes:
-        out = b""
-        while len(out) < n:
+    def _read_exact(self, n: int) -> bytearray:
+        """Read exactly ``n`` bytes into ONE preallocated buffer
+        (``readinto`` over a memoryview) — the unmask pass then runs in
+        place, so a received frame costs a single payload-sized
+        allocation end to end."""
+        out = bytearray(n)
+        view = memoryview(out)
+        got = 0
+        while got < n:
             try:
-                chunk = self._r.read(n - len(out))
+                k = self._r.readinto(view[got:])
             except (OSError, ValueError) as e:
                 self.closed = True
                 raise WsClosed(f"read failed: {e}") from e
-            if not chunk:
+            if not k:
                 self.closed = True
                 raise WsClosed("socket EOF")
-            out += chunk
+            got += k
         return out
 
     # -- lifecycle -------------------------------------------------------------
     def settimeout(self, seconds: float | None) -> None:
         if self._sock is not None:
             self._sock.settimeout(seconds)
+
+    def abort(self) -> None:
+        """Hard-close the underlying socket, no close handshake — the
+        only way another thread can unblock a reader parked in
+        :meth:`recv` (the relay's teardown, and how the chaos suite
+        kills an upstream mid-stream).  Idempotent."""
+        self.closed = True
+        if self._sock is not None:
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
 
     def close(self, code: int = 1000) -> None:
         """Send the close frame (once) and mark the endpoint closed.
@@ -294,5 +366,6 @@ __all__ = [
     "WsClosed",
     "accept_key",
     "client_connect",
+    "encode_server_frame",
     "server_upgrade",
 ]

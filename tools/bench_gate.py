@@ -30,6 +30,8 @@ Usage:
     python tools/bench_gate.py fresh.json BENCH_TIMECOMP_PR16.json
     python bench.py --federation > fresh.json
     python tools/bench_gate.py fresh.json BENCH_FEDERATION_PR17.json
+    python bench.py --relay > fresh.json
+    python tools/bench_gate.py fresh.json BENCH_RELAY_PR18.json
 
 The time-compression artifact (ISSUE 16) gates on BOTH sides of its
 record: the effective-rate headline row and its nested dense sub-row
@@ -40,6 +42,14 @@ The federation artifact (ISSUE 17) gates three rows the same way:
 ``gol_federation_control_direct`` / ``gol_federation_control_broker``
 (ops/s — regress DOWN) and ``gol_federation_failover_mttr`` (seconds —
 regresses UP: a slower kill-to-first-dispatch recovery trips the gate).
+
+The relay artifact (ISSUE 18, ``bench.py --relay`` ->
+``BENCH_RELAY_PR18.json``) gates its two new rows the same way:
+``gol_relay_depth2_frames`` (frames/s through a 2-deep relay chain —
+regresses DOWN: a slower tree trips the gate) and
+``gol_relay_fanout_staleness_p99`` (seconds of p99 frame staleness for
+>=256 relayed viewers vs a direct-subscriber oracle — regresses UP).
+``gol_relay_direct_frames`` rides along as the A/B reference row.
 """
 
 from __future__ import annotations

@@ -73,6 +73,20 @@ def main():
                     "FramePlane, and the N-spectator fetches/frame pin")
     ap.add_argument("--gateway-spectators", type=int, default=8,
                     metavar="N", help="wire spectator count for --gateway")
+    ap.add_argument("--relay", nargs="?", const=True, default=None,
+                    metavar="JSON",
+                    help="also run bench.bench_relay (ISSUE 18) and "
+                    "render the relay rows: the direct vs depth-2 "
+                    "relay-chain A/B (frames/s, bytes/frame) and the "
+                    "fan-out economics row — >=256 viewers behind 2 "
+                    "relays, egress amplification, p99 staleness, and "
+                    "the pod fetches/frame pin.  With a JSON path "
+                    "(e.g. BENCH_RELAY_PR18.json) renders that "
+                    "committed artifact instead of re-benching — and "
+                    "skips the engine table entirely")
+    ap.add_argument("--relay-clients", type=int, default=256,
+                    metavar="N", help="viewer count for --relay's "
+                    "fan-out arm")
     ap.add_argument("--federation", action="store_true",
                     help="also run bench.bench_federation (ISSUE 17) and "
                     "render the broker rows: direct vs brokered control "
@@ -86,6 +100,16 @@ def main():
                     "rows with their mesh-shape and per-direction "
                     "halo-byte columns (round 7)")
     args = ap.parse_args()
+
+    if isinstance(args.relay, str):
+        # Render-only: a committed BENCH_RELAY_*.json needs no backend
+        # and no engine rows — lint it and print the relay tables.
+        import json
+
+        rec = json.loads(Path(args.relay).read_text())
+        _lint_serve(rec)
+        print_relay_table(rec)
+        return
 
     ensure_live_backend()
 
@@ -162,6 +186,13 @@ def main():
         rec = bench_gateway(spectators=args.gateway_spectators)
         _lint_serve(rec)
         print_gateway_table(rec)
+
+    if args.relay:
+        from bench import bench_relay
+
+        rec = bench_relay(fan_clients=args.relay_clients)
+        _lint_serve(rec)
+        print_relay_table(rec)
 
     if args.federation:
         from bench import bench_federation
@@ -325,6 +356,41 @@ def print_gateway_table(rec: dict) -> None:
         f"\n{rec['spectators']} wire spectators on one {rec['size']}² run: "
         f"{fr['fetches_per_frame']:.2f} device fetches/frame; wire byte "
         f"overhead x{fr['wire_overhead_ratio']:.2f} vs in-process"
+    )
+
+
+def print_relay_table(rec: dict) -> None:
+    """Render a ``bench.bench_relay`` record (ISSUE 18) as markdown:
+    the direct vs depth-2 relay-chain A/B (frames/s with spread, wire
+    bytes/frame — relays forward payloads verbatim, so the ratio is
+    the ws-header share) and the fan-out economics row — hundreds of
+    viewers behind 2 chained relays on ONE upstream subscription."""
+    ab = rec["ab"]
+    fan = rec["fanout"]
+    print()
+    print("| Relay arm | frames/s (median) | spread | reps | bytes/frame |")
+    print("|---|---|---|---|---|")
+    for label, row in (
+        ("direct spectator", ab["direct"]),
+        ("depth-2 relay chain", ab["depth2"]),
+    ):
+        print(
+            f"| {label} | {row['median']:,.1f} | {row['spread']:.1%} | "
+            f"{row['reps']} | {row['bytes_per_frame']:,.0f} |"
+        )
+    stale = fan["staleness_p99"]
+    print(
+        f"| fan-out p99 staleness | {stale['median'] * 1e3:.1f} ms | "
+        f"{stale['spread']:.1%} | {stale['reps']} | — |"
+    )
+    print(
+        f"\n{fan['clients']} viewers behind {fan['relays']} relays on one "
+        f"{fan['size']}² run: x{fan['egress_amplification']:.0f} egress "
+        f"amplification over ONE upstream subscription "
+        f"({fan['pod_spectator_sockets']:.0f} pod spectator sockets incl. "
+        f"the oracle); {fan['fetches_per_frame']:.2f} device "
+        f"fetches/frame; bytes/frame overhead "
+        f"x{ab['relay_overhead_ratio']:.3f} vs direct"
     )
 
 

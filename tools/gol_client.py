@@ -328,6 +328,29 @@ class GolClient:
             )
         )
 
+    def relay_spectate(
+        self,
+        queue_depth: int = 8,
+        recv_buffer: int | None = None,
+    ) -> "SpectatorStream":
+        """Attach to a spectator RELAY's fan-out stream (ISSUE 18,
+        ``/v1/frames`` on a ``python -m distributed_gol_tpu relay``
+        node): the same keyframe/delta wire format, served from the
+        relay's re-keyframe cache + live feed — the pod never sees
+        this connection."""
+        path = "/v1/frames"
+        if queue_depth != 8:
+            path += f"?queue={queue_depth}"
+        return SpectatorStream(
+            client_connect(
+                self.host,
+                self.port,
+                path,
+                timeout=self.timeout,
+                recv_buffer=recv_buffer,
+            )
+        )
+
 
 class ControllerStream:
     """The controller leg, client side: ``recv()`` yields wire message
@@ -500,6 +523,11 @@ def main(argv=None) -> int:
                     help="URL is a federation broker: control verbs go "
                     "through it; events/watch resolve the tenant's "
                     "owning pod via /placement and attach pod-direct")
+    ap.add_argument("--relay", action="store_true",
+                    help="URL is a spectator relay (python -m "
+                    "distributed_gol_tpu relay): 'watch' attaches to "
+                    "its fan-out stream — the tenant argument may be "
+                    "'-' (the relay carries exactly one stream)")
     sub = ap.add_subparsers(dest="verb", required=True)
 
     p_submit = sub.add_parser("submit", help="Broker.Publish: start a session")
@@ -547,7 +575,8 @@ def main(argv=None) -> int:
                          "rendered timeline")
 
     p_watch = sub.add_parser("watch", help="attach as a spectator")
-    p_watch.add_argument("tenant")
+    p_watch.add_argument("tenant", nargs="?", default="-",
+                         help="tenant name ('-' against a --relay)")
     p_watch.add_argument("--rect", default=None, metavar="Y0,X0,VH,VW")
     p_watch.add_argument("--frames", type=int, default=0,
                          help="stop after N frames (0 = until the end)")
@@ -667,7 +696,13 @@ def _run_verb(client: GolClient, args) -> int:
         if args.rect:
             rect = [int(v) for v in args.rect.split(",")]
         shown = 0
-        with client.spectate(args.tenant, rect=rect) as stream:
+        if getattr(args, "relay", False):
+            # A relay carries exactly ONE stream: no tenant routing,
+            # no rect choice — the hello reports the stream's rect.
+            stream_cm = client.relay_spectate()
+        else:
+            stream_cm = client.spectate(args.tenant, rect=rect)
+        with stream_cm as stream:
             try:
                 while True:
                     event = stream.recv()

@@ -9,6 +9,9 @@ burn.  Pointed at a broker (``python -m distributed_gol_tpu broker``)
 the same scrape autodetects the fleet health body (``"broker": true``)
 and renders one row per POD instead — ready/degraded/draining/condemned,
 resident/queued, cell headroom, and which SLO objectives are burning.
+Pointed at a spectator relay (``python -m distributed_gol_tpu relay``,
+ISSUE 18) it autodetects ``"relay": true`` and renders the fan-out row —
+clients, relayed frames/s, cache hit rate, and the upstream endpoint.
 Pure stdlib; rendering is a pure function of two scrapes so it is
 unit-testable without a pod.
 
@@ -17,6 +20,7 @@ Usage:
     python tools/pod_top.py http://127.0.0.1:9090 --interval 2
     python tools/pod_top.py http://127.0.0.1:9090 --once   # one frame, no loop
     python tools/pod_top.py http://127.0.0.1:9300 --fleet  # broker fleet view
+    python tools/pod_top.py http://127.0.0.1:9400 --relay  # relay fan-out view
 """
 
 from __future__ import annotations
@@ -235,6 +239,59 @@ def render_fleet(cur: dict, prev: dict | None = None) -> str:
     return "\n".join(lines)
 
 
+def render_relay(cur: dict, prev: dict | None = None) -> str:
+    """One frame from a relay scrape (``/healthz`` with ``"relay": true``,
+    ISSUE 18): topology line (endpoint <- upstream), then the fan-out
+    row — clients, relayed frames/s and egress bytes/s (client-side from
+    consecutive scrapes), drops, and the re-keyframe cache state with its
+    hit rate (cache serves / frames out).  Pure function — the test
+    surface, like :func:`render_frame`."""
+    health = cur["health"]
+    flags = []
+    if not health.get("ready", False):
+        flags.append("NOT-READY")
+    if not health.get("connected", False):
+        flags.append("DISCONNECTED")
+    if health.get("ended"):
+        flags.append("ENDED")
+    state = " ".join(flags) if flags else "ready"
+    lines = [
+        f"relay {state} | {health.get('endpoint') or '-'} <- "
+        f"{health.get('upstream') or '-'} | "
+        f"tenant {health.get('tenant') or '-'} "
+        f"rect {health.get('rect') or '-'} turn {health.get('turn', 0)} | "
+        f"resubscribes {health.get('resubscribes', 0)}"
+    ]
+    dt = (cur["t"] - prev["t"]) if prev else 0.0
+    before = (prev or {}).get("health", {})
+    fps = bps = None
+    if prev and dt > 0:
+        fps = (
+            health.get("frames_out", 0) - before.get("frames_out", 0)
+        ) / dt
+        bps = (
+            health.get("bytes_out", 0) - before.get("bytes_out", 0)
+        ) / dt
+    out = health.get("frames_out", 0)
+    hit = (health.get("cache_serves", 0) / out) if out else 0.0
+    cache = health.get("cache") or {}
+    anchor = (
+        f"kf@{cache.get('keyframe_turn')}+{cache.get('deltas', 0)}d"
+        if cache.get("anchored")
+        else "unanchored"
+    )
+    lines.append(
+        f"{'CLIENTS':>7} {'FRAMES/S':>9} {'EGRESS/S':>9} {'DROPS':>6} "
+        f"{'CACHE':<16} HIT"
+    )
+    lines.append(
+        f"{health.get('clients', 0):>7} {_fmt_rate(fps):>9} "
+        f"{_fmt_bytes(bps) if bps is not None else '-':>9} "
+        f"{health.get('drops', 0):>6} {anchor:<16} {hit:.0%}"
+    )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("url", help="pod telemetry base URL, e.g. "
@@ -246,6 +303,9 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet", action="store_true",
                     help="force the broker fleet view (autodetected from "
                     "the health body otherwise)")
+    ap.add_argument("--relay", action="store_true",
+                    help="force the relay view (autodetected from the "
+                    "health body otherwise)")
     args = ap.parse_args(argv)
 
     prev = None
@@ -257,7 +317,13 @@ def main(argv=None) -> int:
                 print(f"{args.url}: unreachable ({e})", file=sys.stderr)
                 return 1
             fleet = args.fleet or bool(cur["health"].get("broker"))
-            frame = (render_fleet if fleet else render_frame)(cur, prev)
+            relay = args.relay or bool(cur["health"].get("relay"))
+            render = (
+                render_relay
+                if relay
+                else render_fleet if fleet else render_frame
+            )
+            frame = render(cur, prev)
             if args.once:
                 print(frame)
                 return 0
