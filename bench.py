@@ -1670,6 +1670,11 @@ def bench_federation(reps: int = 3, ops: int = 20, size: int = 64) -> dict:
       end to end.  Thresholds are dialed tight (probe 0.1 s, 2 misses)
       so the record measures the machinery, not the default timers; the
       ``detect`` share is recorded beside the headline.
+    - **Stitched-trace fetch** (ISSUE 19): wall time of one
+      ``GET /fleet/traces/<id>`` through a live ``CollectorServer`` —
+      the per-node ``/traces?all=1`` fan-out plus the merge, the cost
+      of pulling one cross-process incident timeline during a
+      postmortem.
 
     The victim pod runs ``JAX_PLATFORMS=cpu`` (the bench process owns
     any accelerator) — the engine work is a 64² roll board, so the MTTR
@@ -1836,15 +1841,47 @@ def bench_federation(reps: int = 3, ops: int = 20, size: int = 64) -> dict:
             proc.kill()
             proc.wait(timeout=10)
 
+    cserver = None
     try:
         ctl = "fed-ctl"
         wait_until(
             lambda: all(p["ready"] for p in broker.pod_states()),
             30, "steady-state broker ready",
         )
-        brokered._request("POST", "/v1/sessions", spec(ctl))
+        receipt = brokered._request("POST", "/v1/sessions", spec(ctl))
+        # The fleet plane over the steady rig: one scraped pod plus the
+        # broker's local legs — the stitched fetch fans to the pod's
+        # /traces and merges, the postmortem-pull path end to end.
+        from urllib.request import urlopen
+
+        from distributed_gol_tpu.obs.fleet import (
+            CollectorServer,
+            FleetCollector,
+        )
+
+        cserver = CollectorServer(
+            FleetCollector(
+                {"pod": gateway.url},
+                interval=0.2,
+                scrape_timeout=2.0,
+                local_name="broker",
+                local_flight=broker.flight,
+            ),
+            port=0,
+        )
+        stitch_url = (
+            f"{cserver.url}/fleet/traces/{receipt['broker_trace_id']}"
+        )
+
+        def stitched_fetch_s() -> float:
+            t0 = time.perf_counter()
+            with urlopen(stitch_url, timeout=10) as resp:
+                resp.read()
+            return time.perf_counter() - t0
+
+        trace_ops = 5
         direct_rates, broker_rates = [], []
-        mttrs, detects = [], []
+        mttrs, detects, stitch_lats = [], [], []
         for rep in range(max(1, reps)):
             t0 = time.perf_counter()
             for _ in range(ops):
@@ -1854,6 +1891,9 @@ def bench_federation(reps: int = 3, ops: int = 20, size: int = 64) -> dict:
             for _ in range(ops):
                 brokered.state(ctl)
             broker_rates.append(ops / (time.perf_counter() - t0))
+            stitch_lats.append(
+                measure.median([stitched_fetch_s() for _ in range(trace_ops)])
+            )
             mttr, detect = failover_rep(rep)
             mttrs.append(mttr)
             detects.append(detect)
@@ -1862,6 +1902,8 @@ def bench_federation(reps: int = 3, ops: int = 20, size: int = 64) -> dict:
         if h is not None:
             h.wait(timeout=60)
     finally:
+        if cserver is not None:
+            cserver.close()
         broker.close()
         gateway.close()
         plane.close()
@@ -1897,6 +1939,13 @@ def bench_federation(reps: int = 3, ops: int = 20, size: int = 64) -> dict:
             "probe_miss_threshold": 2,
             "checkpoint_every_turns": 16,
         },
+        "stitched_trace": {
+            "metric": "gol_federation_stitched_trace_fetch",
+            "unit": "seconds",
+            **measure.summarize(stitch_lats),
+            "fetches_per_rep": trace_ops,
+            "fan_nodes": 1,
+        },
         "metrics": reg.snapshot(include_lazy=False).to_dict(),
     }
     log(
@@ -1904,7 +1953,8 @@ def bench_federation(reps: int = 3, ops: int = 20, size: int = 64) -> dict:
         f"direct vs {measure.median(broker_rates):,.0f} brokered "
         f"(hop +{record['control']['broker_hop_ms']:.2f} ms); failover "
         f"MTTR {measure.median(mttrs):.3f} s "
-        f"(detect {measure.median(detects):.3f} s) over {len(mttrs)} kills"
+        f"(detect {measure.median(detects):.3f} s) over {len(mttrs)} kills; "
+        f"stitched-trace fetch {measure.median(stitch_lats) * 1e3:.1f} ms"
     )
     return record
 
@@ -2861,6 +2911,74 @@ def bench_tracing_overhead(
     }
 
 
+def bench_collector_overhead(
+    size: int = 256,
+    budget_seconds: float = 2.0,
+    reps: int = 3,
+    scrape_seconds: float = 0.05,
+) -> dict:
+    """The ISSUE-19 collector-overhead arm: interleaved A/B
+    controller-path reps with the fleet collector OFF vs ON — the ON
+    arm runs a real ``TelemetryServer`` over this process's registry
+    and a ``FleetCollector`` scraping it over loopback HTTP at 20 Hz
+    (4-10x the production cadence, so the pilot-scale number
+    UPPER-bounds deployments), parse + aggregate + ring sample
+    included.  Same methodology and verdict tolerance as
+    ``bench_telemetry_overhead`` (interleaved arms, each arm's
+    measured rep envelope, 30% quiet-rig floor): being scraped must
+    cost a pod nothing it can feel."""
+    from distributed_gol_tpu.obs import metrics as obs_metrics
+    from distributed_gol_tpu.obs.fleet import FleetCollector
+    from distributed_gol_tpu.serve.telemetry import TelemetryServer
+    from distributed_gol_tpu.utils import measure
+
+    off_rates, on_rates = [], []
+    for _ in range(reps):
+        gps, _ = bench_controller_path(
+            size, budget_seconds=budget_seconds, superstep=256
+        )
+        if gps > 0:
+            off_rates.append(gps)
+        server = TelemetryServer(
+            lambda: obs_metrics.REGISTRY.snapshot(
+                include_lazy=False
+            ).to_dict(),
+            lambda: {"ready": True, "live": True},
+        )
+        collector = FleetCollector(
+            {"pilot": server.url},
+            interval=scrape_seconds,
+            scrape_timeout=2.0,
+        )
+        try:
+            gps, _ = bench_controller_path(
+                size, budget_seconds=budget_seconds, superstep=256
+            )
+        finally:
+            collector.close()
+            server.close()
+        if gps > 0:
+            on_rates.append(gps)
+    if not off_rates or not on_rates:
+        return {"error": "no surviving reps", "off": off_rates, "on": on_rates}
+    off = measure.summarize(off_rates)
+    on = measure.summarize(on_rates)
+    envelope = off["spread"] + on["spread"]
+    tolerance = max(0.3, envelope)
+    rel = abs(on["median"] - off["median"]) / off["median"]
+    return {
+        "metric": f"gol_collector_overhead_pilot_{size}x{size}",
+        "unit": "generations/sec",
+        "value": round(on["median"], 2),
+        **on,
+        "scrape_off": off,
+        "scrape_seconds": scrape_seconds,
+        "overhead_rel": round(rel, 4),
+        "tolerance": round(tolerance, 4),
+        "within_rep_spread": rel <= tolerance,
+    }
+
+
 def timecomp_board(size: int):
     """An ash-dominated board for the time-compression arms: a lattice of
     blocks and blinkers (settled from turn 0) with one T-tetromino in a
@@ -3069,6 +3187,12 @@ def pilot_record(dev) -> dict:
     # Tracing-overhead arm (ISSUE 15): request trace on vs off,
     # interleaved, asserted within the rep spread by tier-1.
     record["tracing_overhead"] = bench_tracing_overhead(
+        size, budget_seconds=2.0, reps=3
+    )
+    # Collector-overhead arm (ISSUE 19): fleet scrape on vs off,
+    # interleaved, asserted within the rep spread by tier-1 — being
+    # scraped must cost a pod nothing it can feel.
+    record["collector_overhead"] = bench_collector_overhead(
         size, budget_seconds=2.0, reps=3
     )
     # Time-compression arm (ISSUE 16): effective-vs-computed on the

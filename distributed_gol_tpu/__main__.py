@@ -632,6 +632,18 @@ def broker_main(argv) -> int:
                     help="at startup, sweep the shared root for orphaned "
                     "resumable checkpoints no live pod claims and "
                     "readopt them onto the fleet")
+    ap.add_argument("--collector", action="store_true",
+                    help="ride the fleet observability collector "
+                    "(ISSUE 19) in this broker: scrape every pod's "
+                    "/metrics + /healthz and serve /fleet/* (aggregated "
+                    "metrics, stitched traces, merged postmortem) from "
+                    "the broker's port")
+    ap.add_argument("--collector-interval", type=float, default=0.5,
+                    help="fleet scrape cadence, seconds")
+    ap.add_argument("--collector-scrape-timeout", type=float, default=2.0,
+                    help="per-node scrape answer budget, seconds (a "
+                    "wedged node costs one timeout per round, never a "
+                    "wedged collector)")
     args = ap.parse_args(argv)
     if not args.pod:
         ap.error("a broker needs at least one --pod URL")
@@ -643,6 +655,9 @@ def broker_main(argv) -> int:
             rejoin_threshold=args.rejoin_threshold,
             checkpoint_root=args.checkpoint_root,
             failover=not args.no_failover,
+            collector=args.collector,
+            collector_interval_seconds=args.collector_interval,
+            collector_scrape_timeout_seconds=args.collector_scrape_timeout,
         )
     except ValueError as e:
         ap.error(str(e))
@@ -653,6 +668,12 @@ def broker_main(argv) -> int:
         f"tools/gol_client.py {broker.url})",
         file=sys.stderr,
     )
+    if args.collector:
+        print(
+            f"collector: {broker.url}/fleet/metrics /fleet/healthz "
+            f"/fleet/slo /fleet/traces/<id> /fleet/flight",
+            file=sys.stderr,
+        )
     try:
         if args.recover:
             broker.probe_once()  # placement needs at least one health
@@ -739,6 +760,115 @@ def relay_main(argv) -> int:
     return 0
 
 
+def collector_main(argv) -> int:
+    """The ``collector`` subcommand (ISSUE 19): the standalone fleet
+    observability plane — scrape every node's ``/metrics`` +
+    ``/healthz`` on a cadence and serve ONE aggregated surface:
+    ``/fleet/metrics`` (node-labelled + fleet-aggregate OpenMetrics),
+    ``/fleet/healthz``, ``/fleet/slo`` (fleet-level per-tenant burn
+    over the aggregate — a tenant migrated mid-window keeps one
+    continuous budget), ``/fleet/traces/<id>`` (cross-process stitch)
+    and ``/fleet/flight`` (the merged postmortem).  Device-less, like
+    the broker and relay; the same surface rides in-broker via
+    ``broker --collector`` (docs/API.md "Fleet observability")."""
+    import time
+
+    from distributed_gol_tpu.obs.fleet import (
+        CollectorServer,
+        FleetCollector,
+        node_name,
+    )
+    from distributed_gol_tpu.obs.slo import SLOObjectives
+
+    ap = argparse.ArgumentParser(
+        prog="distributed_gol_tpu collector",
+        description="fleet observability collector: federated scrape "
+        "plane, cross-process trace stitching, one merged postmortem "
+        "timeline over N nodes (pods, brokers, relays)",
+    )
+    ap.add_argument("--node", action="append", default=[],
+                    metavar="[NAME=]URL",
+                    help="one node to scrape (repeatable): a pod "
+                    "gateway, broker, relay, or telemetry endpoint — "
+                    "optionally named (name=http://...); unnamed nodes "
+                    "are labelled by their host:port")
+    ap.add_argument("--port", type=int, default=0,
+                    help="collector bind port (0 = ephemeral; the "
+                    "bound URL is printed to stderr and published as "
+                    "the fleet.endpoint info label)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--interval", type=float, default=0.5,
+                    help="scrape cadence, seconds")
+    ap.add_argument("--scrape-timeout", type=float, default=2.0,
+                    help="per-node scrape answer budget, seconds (a "
+                    "wedged node costs one timeout per round and a "
+                    "fleet.scrape_misses bump, never a wedged "
+                    "collector)")
+    ap.add_argument("--checkpoint-root", default=None, metavar="DIR",
+                    help="the federation's shared checkpoint root: "
+                    "on-disk flight-*.json abort dumps under it join "
+                    "the /fleet/flight merged timeline")
+    ap.add_argument("--slo-latency", type=float, default=0.0,
+                    help="fleet per-tenant dispatch-latency objective, "
+                    "seconds (0 = off)")
+    ap.add_argument("--slo-latency-percentile", type=float, default=0.99)
+    ap.add_argument("--slo-error-rate", type=float, default=0.0,
+                    help="fleet per-tenant dispatch error-rate "
+                    "objective (0 = off)")
+    ap.add_argument("--slo-fast-window", type=float, default=60.0)
+    ap.add_argument("--slo-slow-window", type=float, default=300.0)
+    ap.add_argument("--slo-burn-threshold", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    if not args.node:
+        ap.error("a collector needs at least one --node URL")
+    nodes = {}
+    for spec in args.node:
+        name, eq, rest = spec.partition("=")
+        if eq and "://" not in name:
+            nodes[name] = rest
+        else:
+            nodes[node_name(spec)] = spec
+    objectives = None
+    if args.slo_latency > 0 or args.slo_error_rate > 0:
+        try:
+            objectives = SLOObjectives(
+                latency_seconds=args.slo_latency,
+                latency_percentile=args.slo_latency_percentile,
+                error_rate=args.slo_error_rate,
+                fast_window_seconds=args.slo_fast_window,
+                slow_window_seconds=args.slo_slow_window,
+                burn_threshold=args.slo_burn_threshold,
+            )
+        except ValueError as e:
+            ap.error(str(e))
+    try:
+        collector = FleetCollector(
+            nodes,
+            interval=args.interval,
+            scrape_timeout=args.scrape_timeout,
+            checkpoint_root=args.checkpoint_root,
+            objectives=objectives,
+        )
+    except ValueError as e:
+        ap.error(str(e))
+    server = CollectorServer(collector, port=args.port, host=args.host)
+    print(
+        f"collector: {server.url}/fleet/metrics /fleet/healthz "
+        f"/fleet/slo /fleet/traces/<id> /fleet/flight scraping "
+        f"{len(nodes)} node(s) every {args.interval}s "
+        f"(fleet top: tools/pod_top.py {server.url})",
+        file=sys.stderr,
+    )
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+    return 0
+
+
 def main(argv=None) -> int:
     honour_env_platforms()
     if argv is None:
@@ -749,6 +879,8 @@ def main(argv=None) -> int:
         return broker_main(argv[1:])
     if argv and argv[0] == "relay":
         return relay_main(argv[1:])
+    if argv and argv[0] == "collector":
+        return collector_main(argv[1:])
     ap = build_parser()
     args = ap.parse_args(argv)
     try:

@@ -120,6 +120,13 @@ class FrameReady(Event):
     # protocol: it replaces the viewer's buffer wholesale and re-anchors
     # subsequent FrameDelta bands.
     rect: tuple | None = None
+    # Wall-clock publish stamp (ISSUE 19), set ONCE by the FramePlane so
+    # every subscriber's copy of one publish encodes to identical wire
+    # bytes (the relay tree's bit-identity guarantee); relays forward
+    # blobs verbatim, so the last hop of a depth-N chain still measures
+    # true pod-to-viewer staleness from it.  None = unstamped (engine
+    # internal frames, old peers).
+    ts: float | None = field(default=None, compare=False)
 
 
 @dataclass(frozen=True)
@@ -143,6 +150,8 @@ class FrameDelta(Event):
     bands: Sequence = field(default_factory=tuple, compare=False)
     factors: tuple = (1, 1)
     rect: tuple | None = None
+    # Wall-clock publish stamp (ISSUE 19) — see FrameReady.ts.
+    ts: float | None = field(default=None, compare=False)
 
 
 @dataclass(frozen=True)

@@ -38,13 +38,15 @@ from typing import Mapping
 from distributed_gol_tpu.obs.metrics import (
     SCHEMA,
     check_metrics_snapshot,
-    labelled,
     tenant_of,
 )
 
 _NAME_BAD = re.compile(r"[^a-zA-Z0-9_]")
 _SAMPLE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)\s*$")
 _LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+#: Sample-scoped labels :func:`parse` must NOT fold back into the
+#: registry key (``le`` belongs to a bucket, ``value`` to an info line).
+_RESERVED_LABELS = frozenset({"le", "value"})
 
 CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
@@ -52,26 +54,61 @@ CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 def metric_name(name: str) -> str:
     """The OpenMetrics family name for one registry instrument name
     (WITHOUT its ``{tenant=...}`` suffix — strip via :func:`split_name`
-    first)."""
+    first).  Idempotent: an already-mangled family name (as the fleet
+    collector re-renders after :func:`parse`) passes through unchanged
+    instead of growing a second ``gol_`` prefix."""
+    if name.startswith("gol_") and not _NAME_BAD.search(name):
+        return name
     return "gol_" + _NAME_BAD.sub("_", name)
+
+
+def split_all(name: str) -> tuple[str, dict[str, str]]:
+    """Registry name → (base name, labels dict).  The generalised form of
+    :func:`split_name` for the fleet plane's multi-label spelling
+    (``name{node=a,tenant=b}``): the trailing ``{k=v,...}`` suffix is
+    parsed into a dict; a name whose brace suffix is not label-shaped
+    (every comma-part carrying ``=``) comes back unlabelled."""
+    if not name.endswith("}") or "{" not in name:
+        return name, {}
+    base, _, suffix = name.rpartition("{")
+    labels: dict[str, str] = {}
+    for part in suffix[:-1].split(","):
+        k, eq, v = part.partition("=")
+        if not eq or not k:
+            return name, {}
+        labels[k] = v
+    return base, labels
+
+
+def spell(base: str, labels: Mapping[str, str]) -> str:
+    """Inverse of :func:`split_all`: the registry spelling of a labelled
+    instrument, label keys sorted so one (base, labels) set always maps
+    to one snapshot key (``{node=...}`` sorts before ``{tenant=...}``)."""
+    if not labels:
+        return base
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{base}{{{inner}}}"
 
 
 def split_name(name: str) -> tuple[str, str | None]:
     """Registry name → (base name, tenant or None)."""
     t = tenant_of(name)
-    return (name[: name.rindex("{")], t) if t is not None else (name, None)
+    if t is not None:
+        return name[: name.rindex("{")], t
+    base, labels = split_all(name)
+    return (base, labels["tenant"]) if "tenant" in labels else (name, None)
 
 
 def _esc(value: str) -> str:
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
-def _labels(tenant: str | None, extra: str | None = None) -> str:
+def _labels(labels: Mapping[str, str], extra: str | None = None) -> str:
     parts = []
     if extra:
         parts.append(extra)
-    if tenant is not None:
-        parts.append(f'tenant="{_esc(tenant)}"')
+    for k in sorted(labels):
+        parts.append(f'{k}="{_esc(str(labels[k]))}"')
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
@@ -95,43 +132,43 @@ def render(snapshot: Mapping) -> str:
         return fam["lines"]
 
     for name, v in snapshot.get("counters", {}).items():
-        base, tenant = split_name(name)
-        family(base, "counter").append((tenant, None, v))
+        base, labels = split_all(name)
+        family(base, "counter").append((labels, None, v))
     for name, v in snapshot.get("gauges", {}).items():
-        base, tenant = split_name(name)
-        family(base, "gauge").append((tenant, None, v))
+        base, labels = split_all(name)
+        family(base, "gauge").append((labels, None, v))
     for name, h in snapshot.get("histograms", {}).items():
-        base, tenant = split_name(name)
-        family(base, "histogram").append((tenant, None, h))
+        base, labels = split_all(name)
+        family(base, "histogram").append((labels, None, h))
     for name, v in snapshot.get("info", {}).items():
-        base, tenant = split_name(name)
-        family(base, "info").append((tenant, None, v))
+        base, labels = split_all(name)
+        family(base, "info").append((labels, None, v))
 
     out: list[str] = []
     for fname in sorted(families):
         fam = families[fname]
         kind = fam["kind"]
         out.append(f"# TYPE {fname} {kind}")
-        for tenant, _, v in fam["lines"]:
+        for labels, _, v in fam["lines"]:
             if kind == "counter":
-                out.append(f"{fname}_total{_labels(tenant)} {_num(v)}")
+                out.append(f"{fname}_total{_labels(labels)} {_num(v)}")
             elif kind == "gauge":
-                out.append(f"{fname}{_labels(tenant)} {_num(v)}")
+                out.append(f"{fname}{_labels(labels)} {_num(v)}")
             elif kind == "info":
                 value_label = 'value="' + _esc(str(v)) + '"'
-                out.append(f"{fname}_info{_labels(tenant, value_label)} 1")
+                out.append(f"{fname}_info{_labels(labels, value_label)} 1")
             else:  # histogram
                 cum = 0
                 for bound, count in zip(v["buckets"], v["counts"]):
                     cum += count
                     le = 'le="' + repr(float(bound)) + '"'
-                    out.append(f"{fname}_bucket{_labels(tenant, le)} {cum}")
+                    out.append(f"{fname}_bucket{_labels(labels, le)} {cum}")
                 inf_le = 'le="+Inf"'
                 out.append(
-                    f"{fname}_bucket{_labels(tenant, inf_le)} {v['count']}"
+                    f"{fname}_bucket{_labels(labels, inf_le)} {v['count']}"
                 )
-                out.append(f"{fname}_sum{_labels(tenant)} {_num(v['sum'])}")
-                out.append(f"{fname}_count{_labels(tenant)} {v['count']}")
+                out.append(f"{fname}_sum{_labels(labels)} {_num(v['sum'])}")
+                out.append(f"{fname}_count{_labels(labels)} {v['count']}")
     out.append("# EOF")
     return "\n".join(out) + "\n"
 
@@ -139,10 +176,10 @@ def render(snapshot: Mapping) -> str:
 def parse(text: str) -> dict:
     """OpenMetrics exposition text (as :func:`render` produces) back into
     a ``gol-metrics-v1`` dict.  Names stay in their mangled form (the
-    dot→underscore mapping is lossy by design); tenant labels are folded
-    back into the registry's ``name{tenant=x}`` spelling via
-    :func:`obs.metrics.labelled`, so the result round-trips through
-    :func:`obs.metrics.check_metrics_snapshot`."""
+    dot→underscore mapping is lossy by design); tenant — and, on the
+    fleet plane, ``node=`` — labels are folded back into the registry's
+    ``name{k=v,...}`` spelling via :func:`spell`, so the result
+    round-trips through :func:`obs.metrics.check_metrics_snapshot`."""
     kinds: dict[str, str] = {}
     # family -> tenant -> accumulated state
     hists: dict[str, dict] = {}
@@ -172,7 +209,9 @@ def parse(text: str) -> dict:
             k: v.replace('\\"', '"').replace("\\n", "\n").replace("\\\\", "\\")
             for k, v in _LABEL.findall(labelstr)
         }
-        tenant = labels.get("tenant")
+        key_labels = {
+            k: v for k, v in labels.items() if k not in _RESERVED_LABELS
+        }
         # Resolve the family by stripping the kind-specific suffix and
         # checking the TYPE line registered that family with the kind
         # the suffix implies; bare names resolve as gauges last, so a
@@ -195,7 +234,7 @@ def parse(text: str) -> dict:
         if resolved is None:
             raise ValueError(f"sample names no declared family: {line!r}")
         fam, kind, hit = resolved
-        key = labelled(fam, tenant)
+        key = spell(fam, key_labels)
         if kind == "counter":
             out["counters"][key] = float(value)
         elif kind == "gauge":
@@ -253,8 +292,8 @@ def check_roundtrip(snapshot: Mapping) -> list[str]:
     problems.extend(check_metrics_snapshot(parsed, "$roundtrip"))
 
     def mangled(name: str) -> str:
-        base, tenant = split_name(name)
-        return labelled(metric_name(base), tenant)
+        base, labels = split_all(name)
+        return spell(metric_name(base), labels)
 
     for section in ("counters", "gauges"):
         for name, v in snapshot.get(section, {}).items():

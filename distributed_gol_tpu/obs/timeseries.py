@@ -120,6 +120,39 @@ def fraction_above(
     return (total - good) / total
 
 
+class SnapshotRegistry:
+    """A :class:`~distributed_gol_tpu.obs.metrics.MetricsRegistry`
+    duck-type over an EXTERNAL snapshot source — what lets a
+    :class:`TelemetrySampler` ring hold some OTHER process's metrics
+    (the fleet collector's per-node rings over scraped ``/metrics``
+    text, and the fleet-aggregate ring over their merge — ISSUE 19)
+    while the sampler's own bookkeeping counters land on a real local
+    ``registry``.  ``fn`` returns the newest ``gol-metrics-v1`` dict
+    the source holds (None samples as empty); it should hand back a
+    dict it will not mutate afterwards, since ring samples alias it."""
+
+    def __init__(self, fn: Callable[[], dict | None], registry=None):
+        self._fn = fn
+        self._registry = (
+            registry if registry is not None else metrics_lib.REGISTRY
+        )
+
+    def snapshot(self, include_lazy: bool = True) -> metrics_lib.MetricsSnapshot:
+        snap = self._fn()
+        if snap is None:
+            snap = {
+                "schema": metrics_lib.SCHEMA,
+                "counters": {},
+                "gauges": {},
+                "histograms": {},
+                "info": {},
+            }
+        return metrics_lib.MetricsSnapshot(snap)
+
+    def counter(self, name: str):
+        return self._registry.counter(name)
+
+
 class TelemetrySampler:
     """The continuous-sampling daemon (module doc).  ``interval`` is the
     cadence in seconds; ``depth`` bounds the ring; every ``lazy_every``-th

@@ -67,6 +67,7 @@ import threading
 import time
 from collections import deque
 from contextvars import ContextVar
+from typing import Mapping, Sequence
 
 from distributed_gol_tpu.obs import metrics as metrics_lib
 
@@ -479,6 +480,23 @@ class Tracer:
             return live[-1].to_dict()
         return None
 
+    def lookup_all(self, trace_id: str) -> list[dict]:
+        """EVERY leg this process retains for an id (or prefix):
+        finished docs plus live snapshots.  One process can hold
+        several legs of one trace — a broker's request leg already
+        ended while a relay's subscribe leg on the same id is still
+        open — and ``lookup`` returns only one of them (finished
+        first, shadowing the live leg).  The fleet stitcher wants
+        them all."""
+        with self._lock:
+            docs = list(self._finished)
+            active = list(self._active.values())
+        out = [d for d in docs if d["trace_id"].startswith(trace_id)]
+        out.extend(
+            t.to_dict() for t in active if t.trace_id.startswith(trace_id)
+        )
+        return out
+
     def clear(self) -> None:
         """Drop all state (tests)."""
         with self._lock:
@@ -560,6 +578,14 @@ def http_traces(query: dict) -> tuple[int, dict]:
     endpoint contract."""
     trace_id = query.get("trace_id")
     if trace_id:
+        if query.get("all"):
+            # Every retained leg of the id (the fleet stitcher's form):
+            # a process serving both a finished request leg and a live
+            # relay leg on one id returns BOTH.
+            docs = TRACER.lookup_all(trace_id)
+            if not docs:
+                return 404, {"error": f"no retained trace {trace_id!r}"}
+            return 200, {"schema": "gol-traces-v1", "traces": docs}
         doc = TRACER.lookup(trace_id)
         if doc is None:
             return 404, {"error": f"no retained trace {trace_id!r}"}
@@ -574,7 +600,91 @@ def http_traces(query: dict) -> tuple[int, dict]:
     }
 
 
+# -- cross-process stitching (the fleet plane, ISSUE 19) -----------------------
+
+FLEET_SCHEMA = "gol-fleet-trace-v1"
+
+
+def stitch_traces(node_docs: Mapping[str, Sequence[dict]]) -> dict | None:
+    """Merge per-process ``gol-trace-v1`` docs sharing ONE trace id into
+    a single ``gol-fleet-trace-v1`` timeline: ``{node: [docs]}`` (as the
+    fleet collector's ``/traces?trace_id=`` fan-out returns them) →
+    one span forest whose every span/event carries a ``node`` stamp and
+    a ``t0_ns`` re-based onto the EARLIEST process's clock.
+
+    Alignment is by wall clock: each doc's ``t0_unix`` is its monotonic
+    origin's wall time, so ``offset_ns = (t0_unix - min_t0_unix)*1e9``
+    places its relative span times on the shared axis (good to NTP skew
+    — microseconds locally, the only cross-process clock there is).
+    Span ids are process-local (every trace roots at span 1), so span
+    ids and parent links are namespaced ``node:span_id`` in the merged
+    forest.  Pure function; returns None when no node had the trace."""
+    docs = [
+        (node, doc)
+        for node, ds in node_docs.items()
+        for doc in (ds or ())
+        if doc and doc.get("trace_id")
+    ]
+    if not docs:
+        return None
+    trace_id = docs[0][1]["trace_id"]
+    base = min(float(d.get("t0_unix", 0.0)) for _, d in docs)
+    spans: list[dict] = []
+    events: list[dict] = []
+    nodes: dict[str, dict] = {}
+    tenant = None
+    flagged = None
+    end_ns = 0
+    for node, d in sorted(docs, key=lambda nd: float(nd[1].get("t0_unix", 0.0))):
+        off = round((float(d.get("t0_unix", 0.0)) - base) * 1e9)
+        info = nodes.setdefault(
+            node,
+            {"traces": 0, "names": [], "t0_unix": d.get("t0_unix")},
+        )
+        info["traces"] += 1
+        if d.get("name") not in info["names"]:
+            info["names"].append(d.get("name"))
+        if tenant is None:
+            tenant = d.get("tenant")
+        if flagged is None:
+            flagged = d.get("flagged")
+        for s in d.get("spans", ()):
+            t0 = int(s.get("t0_ns", 0)) + off
+            spans.append(
+                {
+                    **s,
+                    "node": node,
+                    "t0_ns": t0,
+                    "span_id": f"{node}:{s.get('span_id')}",
+                    "parent_id": (
+                        f"{node}:{s['parent_id']}"
+                        if s.get("parent_id") is not None
+                        else None
+                    ),
+                }
+            )
+            end_ns = max(end_ns, t0 + int(s.get("dur_ns", 0)))
+        for e in d.get("events", ()):
+            t = int(e.get("t_ns", 0)) + off
+            events.append({**e, "node": node, "t_ns": t})
+            end_ns = max(end_ns, t)
+    spans.sort(key=lambda s: s["t0_ns"])
+    events.sort(key=lambda e: e["t_ns"])
+    return {
+        "schema": FLEET_SCHEMA,
+        "trace_id": trace_id,
+        "tenant": tenant,
+        "flagged": flagged,
+        "t0_unix": round(base, 6),
+        "duration_ns": end_ns,
+        "nodes": nodes,
+        "spans": spans,
+        "events": events,
+    }
+
+
 __all__ = [
+    "FLEET_SCHEMA",
     "SCHEMA",
     "TRACER",
     "Trace",
@@ -591,4 +701,5 @@ __all__ = [
     "new_trace_id",
     "parse_traceparent",
     "span",
+    "stitch_traces",
 ]

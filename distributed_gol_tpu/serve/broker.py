@@ -67,8 +67,9 @@ import time
 from dataclasses import dataclass, field
 from pathlib import Path
 
-from distributed_gol_tpu.obs import tracing
 from distributed_gol_tpu.obs import metrics as metrics_lib
+from distributed_gol_tpu.obs import openmetrics
+from distributed_gol_tpu.obs import tracing
 from distributed_gol_tpu.obs.flight import FlightRecorder
 from distributed_gol_tpu.serve.httpd import StdlibHTTPServer, read_body
 from distributed_gol_tpu.serve.podclient import (
@@ -109,6 +110,13 @@ class BrokerConfig:
     attempts: int = 2
     backoff_seconds: float = 0.05
     backoff_max_seconds: float = 1.0
+    #: Ride the fleet observability collector (ISSUE 19) in-broker:
+    #: scrape every pod's /metrics + /healthz on a cadence and serve
+    #: the /fleet/* surface (aggregated metrics, stitched traces, the
+    #: merged postmortem) from this broker's port.
+    collector: bool = False
+    collector_interval_seconds: float = 0.5
+    collector_scrape_timeout_seconds: float = 2.0
 
     def __post_init__(self):
         if self.probe_interval_seconds <= 0:
@@ -123,6 +131,10 @@ class BrokerConfig:
             raise ValueError("flight_depth must be >= 0")
         if self.attempts < 1:
             raise ValueError("attempts must be >= 1")
+        if self.collector_interval_seconds <= 0:
+            raise ValueError("collector_interval_seconds must be > 0")
+        if self.collector_scrape_timeout_seconds <= 0:
+            raise ValueError("collector_scrape_timeout_seconds must be > 0")
 
 
 @dataclass
@@ -243,6 +255,12 @@ class Broker(StdlibHTTPServer):
                                            onto live pods
         GET  /flight                       the broker's flight ring
         GET  /traces                       this process's trace surface
+        GET  /metrics                      the broker's own registry
+                                           (OpenMetrics)
+        GET  /fleet/*                      the fleet observability
+                                           surface (metrics, healthz,
+                                           slo, traces/<id>, flight) —
+                                           only with config.collector
     """
 
     thread_name = "gol-broker-http"
@@ -299,6 +317,25 @@ class Broker(StdlibHTTPServer):
         super().__init__(port=port, host=host, registry=reg,
                          request_counter=self._m_requests)
         reg.info("broker.endpoint", self.url)
+        #: The in-broker fleet observability collector (ISSUE 19):
+        #: armed by config, scrapes the SAME pod endpoints the prober
+        #: probes and serves /fleet/* off this broker's port.  The
+        #: broker's own flight ring and the shared checkpoint root join
+        #: the merged postmortem; local_name folds the broker's
+        #: process-wide registry (and its retained traces) in.
+        self.collector = None
+        if self.config.collector:
+            from distributed_gol_tpu.obs.fleet import FleetCollector
+
+            self.collector = FleetCollector(
+                list(endpoints),
+                interval=self.config.collector_interval_seconds,
+                scrape_timeout=self.config.collector_scrape_timeout_seconds,
+                checkpoint_root=self.config.checkpoint_root,
+                local_name="broker",
+                local_flight=self.flight,
+                registry=reg,
+            )
         self._discover()
         self._prober = threading.Thread(
             target=self._probe_loop, name="gol-broker-prober", daemon=True
@@ -309,6 +346,8 @@ class Broker(StdlibHTTPServer):
     def close(self) -> None:
         self._closed.set()
         self._probe_wake.set()
+        if self.collector is not None:
+            self.collector.close()
         super().close()
         self._prober.join(timeout=5)
         with self._lock:
@@ -911,6 +950,14 @@ class Broker(StdlibHTTPServer):
             code, obj = tracing.http_traces(query)
             request._send_json(code, obj)
             return True
+        if path == "/metrics" and method == "GET":
+            # The broker's OWN registry (a fleet collector scrapes this
+            # like any node); the aggregated view is /fleet/metrics.
+            text = openmetrics.render(self.metrics.snapshot().to_dict())
+            request._send(200, text.encode(), openmetrics.CONTENT_TYPE)
+            return True
+        if self.collector is not None and path.startswith("/fleet"):
+            return self.collector.handle_http(request, method, path, query)
         if path == "/v1/sessions":
             if method == "POST":
                 return self._submit(request)

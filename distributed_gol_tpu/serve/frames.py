@@ -35,6 +35,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -69,7 +70,9 @@ class FrameSubscriber:
         last = self._last
         return last is None or self._dropped or last.shape != frame.shape
 
-    def _ship(self, turn: int, frame: np.ndarray, rect, bands=None) -> int:
+    def _ship(
+        self, turn: int, frame: np.ndarray, rect, bands=None, ts=None
+    ) -> int:
         """Enqueue this turn's frame for the spectator — keyframe when
         un-anchored (first frame, rect change, post-drop), else delta
         bands.  ``rect`` is the publisher's SNAPSHOT of this
@@ -84,12 +87,12 @@ class FrameSubscriber:
         self._last = frame
         if last is None or self._dropped or last.shape != frame.shape:
             self._dropped = False
-            ev = FrameReady(turn, frame, rect=rect)
+            ev = FrameReady(turn, frame, rect=rect, ts=ts)
             nbytes = frame.nbytes
         else:
             if bands is None:
                 bands = frames_lib.delta_bands(last, frame)
-            ev = FrameDelta(turn, bands=bands, rect=rect)
+            ev = FrameDelta(turn, bands=bands, rect=rect, ts=ts)
             nbytes = frames_lib.bands_nbytes(bands)
         while True:
             try:
@@ -252,6 +255,11 @@ class FramePlane:
         # per-subscriber slice/ship fan-out), so a many-spectator
         # tenant's frame latency is attributable to this span, not
         # unaccounted host time after it.
+        # One wall-clock stamp per publish, shared by every subscriber's
+        # event: same publish → identical wire bytes downstream (the
+        # relay tree's bit-identity), and the stamp measures frame AGE
+        # (publish → ingest), not encode skew.
+        ts = round(time.time(), 6)
         with tracing.span(
             "gol.frame.publish", turn=turn, subscribers=len(subs)
         ):
@@ -305,7 +313,7 @@ class FramePlane:
                         else:
                             bands = hit[1]
                     shipped += sub._ship(
-                        turn, view, (sy, sx, svh, svw), bands=bands
+                        turn, view, (sy, sx, svh, svw), bands=bands, ts=ts
                     )
                     self._m_frames.inc()
             self._m_bytes_shipped.inc(shipped)

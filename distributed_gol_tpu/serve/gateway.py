@@ -71,6 +71,7 @@ from distributed_gol_tpu.engine.events import (
     TurnComplete,
     TurnsCompleted,
 )
+from distributed_gol_tpu.obs import openmetrics
 from distributed_gol_tpu.obs import tracing
 from distributed_gol_tpu.serve import wire
 from distributed_gol_tpu.serve.admission import AdmissionRejected
@@ -401,6 +402,19 @@ class GatewayServer(StdlibHTTPServer):
             code, obj = tracing.http_traces(query)
             request._send_json(code, obj)
             return True
+        if path == "/metrics" and method == "GET":
+            # The fleet collector's per-pod scrape target (ISSUE 19):
+            # one base URL serves frames AND metrics, so a pod needs no
+            # sidecar telemetry server to join the federated plane.
+            snap = self.plane.metrics.snapshot().to_dict()
+            text = openmetrics.render(snap)
+            request._send(200, text.encode(), openmetrics.CONTENT_TYPE)
+            return True
+        if path == "/flight" and method == "GET":
+            # The pod's plane ring, same shape as the broker's /flight —
+            # what /fleet/flight time-orders into the merged postmortem.
+            request._send_json(200, {"records": self.plane.flight.records()})
+            return True
         if path == "/v1/sessions":
             if method == "GET":
                 return self._list_sessions(request)
@@ -718,6 +732,16 @@ class GatewayServer(StdlibHTTPServer):
                         "tenant": tenant,
                         "rect": list(sub.rect),
                         "turn": session.turn,
+                        # The session's request trace, exported to the
+                        # stream (ISSUE 19): a subscribing relay joins
+                        # it (gol.relay.* spans) and re-exports it, so
+                        # /fleet/traces stitches pod + relay legs on
+                        # one id.
+                        "traceparent": (
+                            session.trace.traceparent()
+                            if session.trace is not None
+                            else None
+                        ),
                     }
                 )
             )

@@ -41,10 +41,19 @@ Hot-path contract (the perf_opt):
   keyframe verbatim is what re-keyframes the whole subtree.  The cache
   keeps serving late joiners across the outage.
 
-Observability: ``relay.*`` counters on the relay's own registry,
-``/healthz`` (body carries ``"relay": true`` — what flips
-``tools/pod_top.py`` into the relay view) and ``/metrics``
-(OpenMetrics).  Downstream endpoint: ``GET /v1/frames`` (upgrade) —
+Observability (grown for ISSUE 19's fleet plane): ``relay.*``
+counters plus a ``relay.frame_staleness_seconds`` histogram (frame
+age at ingest, from the pod's wall-clock ``ts`` header stamp — blobs
+ride verbatim, so the last hop of a depth-N chain measures true
+end-to-end staleness) on the relay's own registry; ``/healthz`` (body
+carries ``"relay": true`` — what flips ``tools/pod_top.py`` into the
+relay view), ``/metrics`` (OpenMetrics) and ``/traces``.  The relay
+joins the stream's distributed trace from the upstream hello's
+traceparent (``gol.relay.subscribe`` / ``.resubscribe`` /
+``.cache_serve`` spans, a ``gol.relay.first_frame`` event) and
+re-exports the traceparent downstream, so ``/fleet/traces`` stitches
+pod, relay and broker legs on one id.  Downstream endpoint: ``GET
+/v1/frames`` (upgrade) —
 ``/v1/sessions/<anything>/frames`` is an alias, so
 ``tools/gol_client.py`` spectates a relay with no client-side changes.
 """
@@ -61,6 +70,7 @@ from urllib.parse import urlsplit
 
 from distributed_gol_tpu.obs import metrics as metrics_lib
 from distributed_gol_tpu.obs import openmetrics
+from distributed_gol_tpu.obs import tracing
 from distributed_gol_tpu.serve import ws as ws_lib
 from distributed_gol_tpu.serve.httpd import StdlibHTTPServer
 from distributed_gol_tpu.serve.ws import WsClosed
@@ -147,11 +157,24 @@ class RelayServer(StdlibHTTPServer):
         #: they are refused until a keyframe re-anchors the stream.
         self._gap = True
         self._hello: dict = {"type": "hello", "tenant": None, "rect": None}
+        #: Set on the FIRST upstream hello — downstream upgrades wait
+        #: (bounded) on it so a chain built faster than its hellos
+        #: propagate never caches a default (tenant-less) hello at a
+        #: lower tier.  Stays set forever after; only the construction
+        #: window can stall, and only until the upstream speaks.
+        self._hello_seen = threading.Event()
         self._turn = 0
         self._connected = False
         self._ended = threading.Event()
         self._closing = False
         self._upstream_ws = None
+        #: The relay's leg of the distributed trace: joined from the
+        #: upstream hello's traceparent (same trace id as the gateway's
+        #: ``gol.request`` — what ``/fleet/traces`` stitches on) and
+        #: re-exported downstream so chained relays join the same trace.
+        self._trace: tracing.Trace | None = None
+        self._first_frame_pending = False
+        self._t_subscribe_ns = tracing.clock_ns()
 
         reg = registry if registry is not None else metrics_lib.MetricsRegistry()
         self._m_frames_in = reg.counter("relay.frames_in")
@@ -161,6 +184,11 @@ class RelayServer(StdlibHTTPServer):
         self._m_drops = reg.counter("relay.drops")
         self._m_cache_serves = reg.counter("relay.cache_serves")
         self._m_resubscribes = reg.counter("relay.resubscribes")
+        #: End-to-end frame age at ingest, from the ``ts`` wall-clock
+        #: stamp pods put in the frame header — relays forward blobs
+        #: verbatim, so a depth-N chain's last hop still measures true
+        #: pod-to-here staleness.
+        self._m_staleness = reg.histogram("relay.frame_staleness_seconds")
         self._g_clients = reg.gauge("relay.clients")
         self._g_clients.set(0)
         reg.info("relay.upstream", upstream)
@@ -177,6 +205,10 @@ class RelayServer(StdlibHTTPServer):
         u = self._upstream_ws
         if u is not None:
             u.abort()  # unblock the reader parked in recv
+        t = self._trace
+        if t is not None:
+            self._trace = None
+            tracing.TRACER.end_trace(t)
         super().close()
 
     # -- the upstream leg ------------------------------------------------------
@@ -202,9 +234,18 @@ class RelayServer(StdlibHTTPServer):
         while not self._closing and not self._ended.is_set():
             if not first:
                 self._m_resubscribes.inc()
+                t0 = tracing.clock_ns()
                 time.sleep(backoff)
+                if self._trace is not None:
+                    self._trace.record_span(
+                        "gol.relay.resubscribe",
+                        t0,
+                        tracing.clock_ns(),
+                        backoff_seconds=backoff,
+                    )
                 backoff = min(backoff * 2, self._backoff_max)
             first = False
+            self._t_subscribe_ns = tracing.clock_ns()
             try:
                 wsock = self._connect_upstream()
             except (OSError, WsClosed, ValueError):
@@ -242,13 +283,21 @@ class RelayServer(StdlibHTTPServer):
             return
         kind = msg.get("type")
         if kind == "hello":
+            trace = self._join_trace(
+                msg.get("traceparent"), msg.get("tenant")
+            )
             with self._lock:
                 self._hello = {
                     "type": "hello",
                     "tenant": msg.get("tenant"),
                     "rect": msg.get("rect"),
+                    "traceparent": (
+                        trace.traceparent() if trace is not None
+                        else None
+                    ),
                 }
                 self._turn = max(self._turn, int(msg.get("turn") or 0))
+            self._hello_seen.set()
         elif kind == "end":
             self._ended.set()
             # Wake every pump NOW (a None sentinel through the normal
@@ -257,6 +306,43 @@ class RelayServer(StdlibHTTPServer):
             with self._lock:
                 for c in self._clients.values():
                     self._offer(c, None)
+
+    def _join_trace(self, traceparent, tenant) -> tracing.Trace | None:
+        """Join the stream's distributed trace from the upstream
+        hello's traceparent — SAME trace id as the pod's
+        ``gol.request`` (the ``/fleet/traces`` stitch key), this
+        relay's spans riding as its own process lane.  A resubscribe
+        to the same stream records a fresh subscribe span on the
+        existing leg; a different stream retires the old leg first.
+        An untraced upstream (no traceparent) records nothing."""
+        old = self._trace
+        parsed = tracing.parse_traceparent(traceparent)
+        now = tracing.clock_ns()
+        if old is not None:
+            if parsed is not None and parsed[0] == old.trace_id:
+                old.record_span(
+                    "gol.relay.subscribe",
+                    self._t_subscribe_ns,
+                    now,
+                    upstream=self.upstream,
+                )
+                return old
+            self._trace = None
+            tracing.TRACER.end_trace(old)
+        if parsed is None:
+            return None
+        trace = tracing.TRACER.start_trace(
+            "gol.relay.subscribe", traceparent=traceparent, tenant=tenant
+        )
+        trace.record_span(
+            "gol.relay.subscribe",
+            self._t_subscribe_ns,
+            now,
+            upstream=self.upstream,
+        )
+        self._trace = trace
+        self._first_frame_pending = True
+        return trace
 
     def _ingest(self, blob) -> None:
         """One upstream binary frame: header-only decode, cache update,
@@ -267,6 +353,12 @@ class RelayServer(StdlibHTTPServer):
         turn = int(header.get("turn") or 0)
         self._m_frames_in.inc()
         self._m_bytes_in.inc(len(blob))
+        ts = header.get("ts")
+        if isinstance(ts, (int, float)):
+            self._m_staleness.observe(max(0.0, time.time() - ts))
+        if self._first_frame_pending and self._trace is not None:
+            self._first_frame_pending = False
+            self._trace.add_event("gol.relay.first_frame", turn=turn)
         frame = ws_lib.encode_server_frame(ws_lib.OP_BINARY, blob)
         with self._lock:
             if kind == "keyframe":
@@ -325,11 +417,14 @@ class RelayServer(StdlibHTTPServer):
         key_turn, key_frame = self._cache_key
         ev = wire.decode_frame_event(_wire_blob(key_frame))
         buf = np.array(ev.frame, dtype=np.uint8, copy=True)
-        turn = key_turn
+        turn, ts = key_turn, ev.ts
         for turn, frame in self._cache_deltas:
             delta = wire.decode_frame_event(_wire_blob(frame))
             frames_lib.apply_bands(buf, delta.bands)
-        blob = wire.encode_frame_event(FrameReady(turn, buf, rect=ev.rect))
+            ts = delta.ts if delta.ts is not None else ts
+        blob = wire.encode_frame_event(
+            FrameReady(turn, buf, rect=ev.rect, ts=ts)
+        )
         self._cache_key = (
             turn, ws_lib.encode_server_frame(ws_lib.OP_BINARY, blob)
         )
@@ -354,6 +449,10 @@ class RelayServer(StdlibHTTPServer):
         if path == "/metrics" and method == "GET":
             text = openmetrics.render(self.registry.snapshot().to_dict())
             request._send(200, text.encode(), openmetrics.CONTENT_TYPE)
+            return True
+        if path == "/traces" and method == "GET":
+            code, obj = tracing.http_traces(query)
+            request._send_json(code, obj)
             return True
         if method == "GET" and (
             path == "/v1/frames"
@@ -416,6 +515,10 @@ class RelayServer(StdlibHTTPServer):
         wsock = ws_lib.server_upgrade(request)
         if wsock is None:
             return True
+        # Bounded wait for the first upstream hello (see _hello_seen):
+        # no-op after it ever arrived; a dead-at-birth upstream falls
+        # through to the default hello after the timeout.
+        self._hello_seen.wait(timeout=2.0)
         c = _Downstream(next(self._ids), depth)
         with self._lock:
             hello = dict(self._hello)
@@ -472,12 +575,20 @@ class RelayServer(StdlibHTTPServer):
         """Multi-write half of the hot path: pre-encoded frames go out
         verbatim.  ``cached`` counts re-keyframe-cache serves (late
         join, drop recovery) apart from live relay."""
+        t0 = tracing.clock_ns() if cached and frames else None
         for frame in frames:
             n = wsock.send_raw(frame)
             self._m_frames_out.inc()
             self._m_bytes_out.inc(n)
             if cached:
                 self._m_cache_serves.inc()
+        if t0 is not None and self._trace is not None:
+            self._trace.record_span(
+                "gol.relay.cache_serve",
+                t0,
+                tracing.clock_ns(),
+                frames=len(frames),
+            )
 
     def _start_reader(self, wsock, dead) -> None:
         """Inbound frames from a viewer: the relay's streams are

@@ -56,10 +56,12 @@ class TelemetryServer(StdlibHTTPServer):
         port: int = 0,
         host: str = "127.0.0.1",
         registry=None,
+        flight_fn: Callable[[], dict] | None = None,
     ):
         self._metrics_fn = metrics_fn
         self._health_fn = health_fn
         self._slo_fn = slo_fn
+        self._flight_fn = flight_fn
         registry = registry if registry is not None else metrics_lib.REGISTRY
         # The scrape counter exists BEFORE the server binds (the base
         # bumps it per request), so even a scrape racing construction
@@ -86,6 +88,11 @@ class TelemetryServer(StdlibHTTPServer):
             request._send_json(code, health)
         elif path == "/slo" and self._slo_fn is not None:
             request._send_json(200, self._slo_fn())
+        elif path == "/flight" and self._flight_fn is not None:
+            # The plane's flight ring, broker-/flight-shaped (ISSUE 19):
+            # one of the sources /fleet/flight time-orders into the
+            # merged postmortem.
+            request._send_json(200, self._flight_fn())
         elif path == "/traces":
             # Request-scoped tracing (ISSUE 15): recent retained traces
             # (``?tenant=``, ``?limit=``) or one by ``?trace_id=`` —
@@ -103,7 +110,8 @@ def serve_plane_telemetry(plane, port: int = 0, host: str = "127.0.0.1"):
     the plane sampler's latest sample (falling back to a direct lazy-free
     snapshot when the sampler is off), ``/healthz`` serves
     ``plane.health()`` (itself sampler-backed, see the plane), ``/slo``
-    the SLO tracker's table when objectives are armed."""
+    the SLO tracker's table when objectives are armed, and ``/flight``
+    the plane's flight ring (one source of the fleet postmortem)."""
 
     def metrics_fn() -> dict:
         sampler = plane.sampler
@@ -119,6 +127,7 @@ def serve_plane_telemetry(plane, port: int = 0, host: str = "127.0.0.1"):
     return TelemetryServer(
         metrics_fn, plane.health, slo_fn, port=port, host=host,
         registry=plane.metrics,
+        flight_fn=lambda: {"records": plane.flight.records()},
     )
 
 

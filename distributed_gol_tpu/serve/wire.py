@@ -107,7 +107,15 @@ def event_to_wire(event) -> dict | None:
 
 def encode_frame_event(event) -> bytes:
     """A FrameReady/FrameDelta as one binary wire frame:
-    ``>I header-length | header JSON | payload``."""
+    ``>I header-length | header JSON | payload``.  When the event
+    carries the FramePlane's wall-clock publish stamp (``event.ts``,
+    ISSUE 19), the header carries it verbatim: the stamp is set ONCE
+    per publish, so every subscriber's copy of one frame encodes to
+    identical wire bytes (the relay tree's bit-identity), and relays —
+    which forward blobs verbatim — measure true publish-to-here
+    staleness (``relay.frame_staleness_seconds``) at any chain depth.
+    Decoders ignore unknown header keys — old clients are
+    unaffected."""
     if isinstance(event, FrameReady):
         frame = np.ascontiguousarray(event.frame, dtype=np.uint8)
         header = {
@@ -127,6 +135,8 @@ def encode_frame_event(event) -> bytes:
         }
     else:
         raise TypeError(f"not a frame event: {type(event).__name__}")
+    if event.ts is not None:
+        header["ts"] = event.ts
     head = json.dumps(header).encode()
     return struct.pack(">I", len(head)) + head + payload
 
@@ -143,6 +153,9 @@ def decode_frame_event(blob: bytes):
     payload = blob[4 + hlen :]
     rect = tuple(header["rect"]) if header.get("rect") is not None else None
     turn = int(header["turn"])
+    ts = header.get("ts")
+    if not isinstance(ts, (int, float)):
+        ts = None
     if header.get("type") == "keyframe":
         h, w = (int(v) for v in header["shape"])
         if len(payload) != h * w:
@@ -150,10 +163,10 @@ def decode_frame_event(blob: bytes):
                 f"keyframe payload {len(payload)} != shape {h}x{w}"
             )
         frame = np.frombuffer(payload, np.uint8).reshape(h, w)
-        return FrameReady(turn, frame, rect=rect)
+        return FrameReady(turn, frame, rect=rect, ts=ts)
     if header.get("type") == "delta":
         bands = frames_lib.unpack_bands(header["bands"], payload)
-        return FrameDelta(turn, bands=bands, rect=rect)
+        return FrameDelta(turn, bands=bands, rect=rect, ts=ts)
     raise ValueError(f"unknown frame message type {header.get('type')!r}")
 
 

@@ -75,6 +75,16 @@ def test_bench_pilot_record_shape(tmp_path):
         f"measured rep envelope {arm['tolerance']:.1%} "
         f"(on {arm['rates']}, off {arm['tracing_off']['rates']})"
     )
+    # Collector-overhead arm (ISSUE 19): fleet scrape on vs off,
+    # interleaved, within the rep spread — the tier-1 proof that being
+    # scraped costs a pod nothing it can feel.
+    arm = record["collector_overhead"]
+    assert arm["scrape_off"]["median"] > 0 and arm["median"] > 0
+    assert arm["within_rep_spread"] is True, (
+        f"collector overhead {arm['overhead_rel']:.1%} exceeds the "
+        f"measured rep envelope {arm['tolerance']:.1%} "
+        f"(on {arm['rates']}, off {arm['scrape_off']['rates']})"
+    )
     # Time-compression arm (ISSUE 16): the effective-rate row carries the
     # computed side (the stats lint refuses it otherwise — asserted here
     # through the real record), and the ash-dominated pilot board clears

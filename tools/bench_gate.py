@@ -50,6 +50,16 @@ regresses DOWN: a slower tree trips the gate) and
 ``gol_relay_fanout_staleness_p99`` (seconds of p99 frame staleness for
 >=256 relayed viewers vs a direct-subscriber oracle — regresses UP).
 ``gol_relay_direct_frames`` rides along as the A/B reference row.
+
+The fleet-observability rows (ISSUE 19) gate in two records:
+``gol_collector_overhead_pilot_*`` rides the ``--pilot`` record
+(generations/sec with a 20 Hz fleet collector scraping the pod —
+regresses DOWN: a scrape that slows the controller path trips the
+gate; its interleaved ``scrape_off`` twin is the A/B reference), and
+``gol_federation_stitched_trace_fetch`` rides the ``--federation``
+record (seconds to pull one merged cross-process trace through
+``/fleet/traces/<id>`` — regresses UP: a slower postmortem pull trips
+the gate).
 """
 
 from __future__ import annotations

@@ -5,9 +5,16 @@ structured records when a run dies; this is the postmortem reader: what
 killed the run, at which turn, the tail of dispatch/retry/watchdog/
 checkpoint history leading up to it, and the run's metrics highlights.
 
+With ``--fleet URL`` (ISSUE 19) it reads a live collector's (or
+``broker --collector``'s) ``/fleet/flight`` instead: the broker ring,
+every pod's ``/flight`` ring, and the on-disk abort dumps, time-ordered
+into ONE postmortem with a node column — "pod A died, broker condemned
+it, tenant failed over to pod B" reads top to bottom.
+
 Usage:
     python tools/flight_report.py <flight-....json | dir containing one>
     python tools/flight_report.py --tail 40 out/
+    python tools/flight_report.py --fleet http://127.0.0.1:9500
 """
 
 from __future__ import annotations
@@ -191,6 +198,85 @@ def _d_timecomp_dense_replay(r):
     )
 
 
+# -- broker-plane kinds (ISSUE 19 fleet postmortem) ----------------------------
+
+def _d_discover(r):
+    return f"broker discover sweep adopted {r.get('tenants', '?')} tenant(s)"
+
+
+def _d_pod_condemned(r):
+    stranded = r.get("stranded") or []
+    tail = (
+        f", stranding {stranded}" if stranded else ", no tenants stranded"
+    )
+    return (
+        f"pod {r.get('pod', '?')} CONDEMNED after "
+        f"{r.get('misses', '?')} missed probe(s){tail}"
+    )
+
+
+def _d_failover(r):
+    turn = r.get("checkpoint_turn")
+    src = r.get("from_pod") or "(cold adopt)"
+    trace = f" [trace {r['trace_id'][:8]}]" if r.get("trace_id") else ""
+    return (
+        f"tenant {r.get('tenant', '?')} FAILED OVER {src} -> "
+        f"{r.get('to_pod', '?')}"
+        + (f" from checkpoint turn {turn}" if turn is not None else " (fresh)")
+        + trace
+    )
+
+
+def _d_failover_lost(r):
+    return (
+        f"tenant {r.get('tenant', '?')} LOST with pod {r.get('pod', '?')}: "
+        f"{r.get('reason', '?')}"
+    )
+
+
+def _d_migration(r):
+    trace = f" [trace {r['trace_id'][:8]}]" if r.get("trace_id") else ""
+    return (
+        f"tenant {r.get('tenant', '?')} migrated {r.get('from_pod', '?')} -> "
+        f"{r.get('to_pod', '?')} at turn {r.get('turn', '?')}{trace}"
+    )
+
+
+def _d_migration_failed(r):
+    rolled = "rolled back on source" if r.get("restored") else "NOT restored"
+    return (
+        f"tenant {r.get('tenant', '?')} migration off "
+        f"{r.get('from_pod', '?')} FAILED ({r.get('error', '?')}) — {rolled}"
+    )
+
+
+def _d_spill(r):
+    trace = f" [trace {r['trace_id'][:8]}]" if r.get("trace_id") else ""
+    return (
+        f"tenant {r.get('tenant', '?')} SPILLED {r.get('from_pod', '?')} -> "
+        f"{r.get('to_pod', '?')} at turn {r.get('turn', '?')} "
+        f"(source shedding load){trace}"
+    )
+
+
+def _d_rejoin_quit(r):
+    return (
+        f"rejoined pod {r.get('pod', '?')} told to QUIT stale tenant "
+        f"{r.get('tenant', '?')} (now owned by {r.get('owner', '?')})"
+    )
+
+
+def _d_rejoin_readopt(r):
+    return (
+        f"tenant {r.get('tenant', '?')} re-adopted on rejoined pod "
+        f"{r.get('pod', '?')} (no surviving owner)"
+    )
+
+
+def _d_pod_rejoined(r):
+    return f"pod {r.get('pod', '?')} REJOINED after condemnation"
+
+
 _DESCRIBE = {
     "restart": _d_restart,
     "supervisor_exhausted": _d_supervisor_exhausted,
@@ -208,19 +294,29 @@ _DESCRIBE = {
     "timecomp_skip": _d_timecomp_skip,
     "timecomp_guard_mismatch": _d_timecomp_guard_mismatch,
     "timecomp_dense_replay": _d_timecomp_dense_replay,
+    "discover": _d_discover,
+    "pod_condemned": _d_pod_condemned,
+    "failover": _d_failover,
+    "failover_lost": _d_failover_lost,
+    "migration": _d_migration,
+    "migration_failed": _d_migration_failed,
+    "spill": _d_spill,
+    "rejoin_quit": _d_rejoin_quit,
+    "rejoin_readopt": _d_rejoin_readopt,
+    "pod_rejoined": _d_pod_rejoined,
 }
 
 
-def _fmt_record(r: dict, t0: float) -> str:
+def _fmt_record(r: dict, t0: float, node_width: int = 0) -> str:
     kind = r["kind"]
     describe = _DESCRIBE.get(kind)
+    skip = ("kind", "t", "node") if node_width else ("kind", "t")
     if describe is not None:
         rest = describe(r)
     else:
-        rest = " ".join(
-            f"{k}={v}" for k, v in r.items() if k not in ("kind", "t")
-        )
-    return f"  {_fmt_t(r['t'], t0)}  {kind:<16} {rest}"
+        rest = " ".join(f"{k}={v}" for k, v in r.items() if k not in skip)
+    node = f"{str(r.get('node', '?')):<{node_width}}  " if node_width else ""
+    return f"  {_fmt_t(r['t'], t0)}  {node}{kind:<16} {rest}"
 
 
 def render(doc: dict, tail: int = 20) -> str:
@@ -268,13 +364,80 @@ def render(doc: dict, tail: int = 20) -> str:
     return "\n".join(out)
 
 
+def render_fleet(doc: dict, tail: int = 40) -> str:
+    """The merged form (``gol-fleet-flight-v1``): every record carries
+    the ``node`` that produced it, so the report grows a node column and
+    the cross-process causality — condemn on the broker, failover
+    landing on the survivor — reads as one sequence."""
+    if doc.get("schema") != "gol-fleet-flight-v1":
+        raise ValueError(
+            f"not a gol-fleet-flight-v1 record (schema={doc.get('schema')!r})"
+        )
+    out = []
+    records = doc.get("records", [])
+    sources = doc.get("sources", [])
+    out.append(
+        f"fleet flight timeline ({len(records)} record(s) from "
+        f"{len(sources)} source(s): {', '.join(sources) or 'none'})"
+    )
+    if not records:
+        out.append("  (no flight records anywhere in the fleet yet)")
+        return "\n".join(out)
+    t0 = records[0].get("t", 0.0)
+    shown = records[-tail:]
+    if len(shown) < len(records):
+        out.append(f"... {len(records) - len(shown)} earlier records elided ...")
+    width = max(len(str(r.get("node", "?"))) for r in shown)
+    out.extend(_fmt_record(r, t0, node_width=width) for r in shown)
+    return "\n".join(out)
+
+
+def _fetch_fleet(url: str) -> dict:
+    import http.client
+    import json
+    from urllib.parse import urlsplit
+
+    split = urlsplit(url if "//" in url else f"//{url}")
+    conn = http.client.HTTPConnection(
+        split.hostname or "127.0.0.1", split.port or 80, timeout=30
+    )
+    try:
+        conn.request("GET", "/fleet/flight")
+        resp = conn.getresponse()
+        body = resp.read()
+        if resp.status != 200:
+            raise RuntimeError(
+                f"GET /fleet/flight: HTTP {resp.status} {body[:200]!r}"
+            )
+        return json.loads(body)
+    finally:
+        conn.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="a flight-*.json, or a directory holding some "
-                                 "(newest is rendered)")
+    ap.add_argument("path", nargs="?", default=None,
+                    help="a flight-*.json, or a directory holding some "
+                         "(newest is rendered)")
+    ap.add_argument("--fleet", default=None, metavar="http://host:port",
+                    help="fetch the MERGED fleet timeline from a live "
+                    "collector's (or broker --collector's) /fleet/flight "
+                    "instead of reading a file")
     ap.add_argument("--tail", type=int, default=20,
                     help="how many trailing ring records to show")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        try:
+            doc = _fetch_fleet(args.fleet)
+            print(f"== {args.fleet}/fleet/flight")
+            print(render_fleet(doc, tail=args.tail))
+        except (OSError, ValueError, RuntimeError) as e:
+            print(f"{args.fleet}: {e}", file=sys.stderr)
+            return 1
+        return 0
+    if not args.path:
+        ap.error("pass a flight-*.json path or --fleet URL")
 
     path = Path(args.path)
     if path.is_dir():

@@ -12,8 +12,15 @@ resident/queued, cell headroom, and which SLO objectives are burning.
 Pointed at a spectator relay (``python -m distributed_gol_tpu relay``,
 ISSUE 18) it autodetects ``"relay": true`` and renders the fan-out row —
 clients, relayed frames/s, cache hit rate, and the upstream endpoint.
-Pure stdlib; rendering is a pure function of two scrapes so it is
-unit-testable without a pod.
+Pointed at a fleet collector (``python -m distributed_gol_tpu
+collector``, ISSUE 19) it autodetects ``"fleet": true`` and renders ONE
+row per scraped node from a single ``/fleet/healthz`` + ``/fleet/metrics``
+pair — freshness, consecutive misses, per-node dispatch/frame rates and
+the relay frame-staleness p99, all read off the collector (no per-node
+fan-out from this tool); ``--collector`` forces that view for a
+``broker --collector`` whose own ``/healthz`` answers as a broker.
+Rendering is a pure function of two scrapes so it is unit-testable
+without a pod.
 
 Usage:
     python tools/pod_top.py http://127.0.0.1:9090
@@ -21,6 +28,7 @@ Usage:
     python tools/pod_top.py http://127.0.0.1:9090 --once   # one frame, no loop
     python tools/pod_top.py http://127.0.0.1:9300 --fleet  # broker fleet view
     python tools/pod_top.py http://127.0.0.1:9400 --relay  # relay fan-out view
+    python tools/pod_top.py http://127.0.0.1:9500 --collector  # fleet collector
 """
 
 from __future__ import annotations
@@ -34,6 +42,11 @@ import urllib.request
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from distributed_gol_tpu.obs import openmetrics  # noqa: E402
+from distributed_gol_tpu.obs.timeseries import (  # noqa: E402
+    histogram_delta_percentiles,
+)
 
 CLEAR = "\x1b[2J\x1b[H"
 
@@ -56,6 +69,31 @@ def scrape(base_url: str, timeout: float = 5.0) -> dict:
             out["slo"] = json.loads(resp.read())
     except (urllib.error.HTTPError, urllib.error.URLError, ValueError):
         out["slo"] = None
+    return out
+
+
+def scrape_collector(base_url: str, timeout: float = 5.0) -> dict:
+    """One collector poll (ISSUE 19): ``{"health": /fleet/healthz
+    body, "metrics": parsed /fleet/metrics | None, "t": unix}``.  Two
+    bounded GETs against ONE process — the collector already scraped the
+    fleet, so this tool never fans out.  503 still yields the body (a
+    stale fleet reports); an unparseable metrics page degrades to None
+    (the health table still renders)."""
+    out: dict = {"t": time.time()}
+    base = base_url.rstrip("/")
+    req = urllib.request.Request(base + "/fleet/healthz")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            out["health"] = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        out["health"] = json.loads(e.read())
+    try:
+        with urllib.request.urlopen(
+            base + "/fleet/metrics", timeout=timeout
+        ) as resp:
+            out["metrics"] = openmetrics.parse(resp.read().decode())
+    except (urllib.error.HTTPError, urllib.error.URLError, ValueError):
+        out["metrics"] = None
     return out
 
 
@@ -292,6 +330,112 @@ def render_relay(cur: dict, prev: dict | None = None) -> str:
     return "\n".join(lines)
 
 
+def _node_metric(snap: dict | None, section: str, base: str, node: str):
+    """One node-labelled family out of a parsed ``/fleet/metrics``
+    snapshot — names arrive mangled (``gol_*``), labels folded back to
+    the ``{node=...}`` spelling by ``openmetrics.parse``."""
+    if not snap:
+        return None
+    return snap.get(section, {}).get(
+        openmetrics.spell(openmetrics.metric_name(base), {"node": node})
+    )
+
+
+def _sum_family(snap: dict | None, section: str, base: str, node: str):
+    """Sum every sample of ``base{node=..., ...}`` for one node — e.g.
+    all tenants' dispatch counters on one pod."""
+    if not snap:
+        return None
+    fam = openmetrics.metric_name(base)
+    total, hit = 0.0, False
+    for key, v in snap.get(section, {}).items():
+        b, labels = openmetrics.split_all(key)
+        if b == fam and labels.get("node") == node:
+            total, hit = total + v, True
+    return total if hit else None
+
+
+def render_fleet_collector(cur: dict, prev: dict | None = None) -> str:
+    """One frame from a collector scrape (``/fleet/healthz`` with
+    ``"fleet": true`` + parsed ``/fleet/metrics``, ISSUE 19): the fleet
+    line (readiness, scrape cadence, aggregate sample age), then one row
+    per scraped NODE — freshness against the staleness bound, consecutive
+    misses, client-side dispatch and frame rates from the node-labelled
+    counters, and the relay frame-staleness p99 read off the node's
+    ``relay.frame_staleness_seconds`` histogram (the windowed delta when
+    a previous scrape is supplied, the since-start population otherwise).
+    Pure function — the test surface, like :func:`render_frame`."""
+    health = cur["health"]
+    snap = cur.get("metrics")
+    prev_snap = (prev or {}).get("metrics")
+    nodes = health.get("nodes", {})
+    bound = health.get("staleness_bound_seconds")
+    agg_age = health.get("aggregate_sample_age_seconds")
+    rounds = misses = None
+    if snap:
+        rounds = snap.get("counters", {}).get("gol_fleet_scrape_rounds")
+        misses = sum(
+            v
+            for k, v in snap.get("counters", {}).items()
+            if k.startswith("gol_fleet_scrape_misses")
+        )
+    lines = [
+        f"collector {'ready' if health.get('ready') else 'NOT-READY'} | "
+        f"{len(nodes)} node(s) | scrape every "
+        f"{health.get('scrape_interval_seconds', '?')}s "
+        f"(staleness bound {bound if bound is not None else '?'}s) | "
+        f"rounds {_fmt_rate(rounds)} misses {_fmt_rate(misses)} | "
+        f"aggregate sample {agg_age if agg_age is not None else '-'}s old"
+    ]
+    dt = (cur["t"] - prev["t"]) if prev else 0.0
+    lines.append(
+        f"{'NODE':<18} {'STATE':<10} {'AGE':>6} {'MISS':>5} "
+        f"{'DISP/S':>7} {'FRAMES/S':>9} {'STALE-P99':>10}  LAST ERROR"
+    )
+    for name in sorted(nodes):
+        row = nodes[name]
+        state = (
+            "STALE"
+            if row.get("stale")
+            else ("ready" if row.get("ready") else "not-ready")
+        )
+        age = row.get("sample_age_seconds")
+        disp = fps = None
+        if prev_snap and dt > 0:
+            for metric, out in (
+                ("controller.dispatches", "disp"),
+                ("relay.frames_out", "fps"),
+            ):
+                now_v = _sum_family(snap, "counters", metric, name)
+                then = _sum_family(prev_snap, "counters", metric, name)
+                if now_v is not None and then is not None:
+                    rate = (now_v - then) / dt
+                    if out == "disp":
+                        disp = rate
+                    else:
+                        fps = rate
+        pcts = histogram_delta_percentiles(
+            _node_metric(
+                snap, "histograms", "relay.frame_staleness_seconds", name
+            ),
+            _node_metric(
+                prev_snap, "histograms", "relay.frame_staleness_seconds", name
+            ),
+            qs=(0.99,),
+        )
+        err = row.get("last_error")
+        lines.append(
+            f"{name:<18} {state:<10} "
+            f"{(f'{age:.1f}s' if age is not None else '-'):>6} "
+            f"{row.get('consecutive_misses', 0):>5} "
+            f"{_fmt_rate(disp):>7} {_fmt_rate(fps):>9} "
+            f"{_fmt_latency(pcts):>10}  {err if err else '-'}"
+        )
+    if not nodes:
+        lines.append("(no nodes)")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("url", help="pod telemetry base URL, e.g. "
@@ -306,20 +450,39 @@ def main(argv=None) -> int:
     ap.add_argument("--relay", action="store_true",
                     help="force the relay view (autodetected from the "
                     "health body otherwise)")
+    ap.add_argument("--collector", action="store_true",
+                    help="force the fleet-collector view (scrapes "
+                    "/fleet/healthz + /fleet/metrics; autodetected when "
+                    "the health body says \"fleet\": true — pass this "
+                    "for a broker --collector, whose own /healthz "
+                    "answers as a broker)")
     args = ap.parse_args(argv)
 
     prev = None
+    collector = args.collector
     try:
         while True:
             try:
-                cur = scrape(args.url)
+                cur = (
+                    scrape_collector(args.url)
+                    if collector
+                    else scrape(args.url)
+                )
+                if not collector and cur["health"].get("fleet"):
+                    # A standalone CollectorServer aliases /healthz to
+                    # /fleet/healthz — upgrade to the collector view
+                    # (and its /fleet/metrics scrape) for good.
+                    collector = True
+                    cur = scrape_collector(args.url)
             except (urllib.error.URLError, OSError, ValueError) as e:
                 print(f"{args.url}: unreachable ({e})", file=sys.stderr)
                 return 1
             fleet = args.fleet or bool(cur["health"].get("broker"))
             relay = args.relay or bool(cur["health"].get("relay"))
             render = (
-                render_relay
+                render_fleet_collector
+                if collector
+                else render_relay
                 if relay
                 else render_fleet if fleet else render_frame
             )

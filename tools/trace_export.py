@@ -1,13 +1,19 @@
-"""Render a request trace (``gol-trace-v1``) to Chrome Trace Event JSON
-(ISSUE 15) — loadable in Perfetto / ``chrome://tracing``.
+"""Render a request trace (``gol-trace-v1``) — or a STITCHED fleet
+trace (``gol-fleet-trace-v1``, ISSUE 19) — to Chrome Trace Event JSON,
+loadable in Perfetto / ``chrome://tracing``.
 
 Input forms:
 
-- a trace JSON file (one ``gol-trace-v1`` dict, or a ``/traces``
-  payload holding several — pick one with ``--trace-id``),
+- a trace JSON file (one ``gol-trace-v1`` / ``gol-fleet-trace-v1``
+  dict, or a ``/traces`` payload holding several — pick one with
+  ``--trace-id``),
 - ``--url http://pod:PORT`` to fetch from a live pod's ``/traces``
   endpoint (gateway or telemetry server; combine with ``--trace-id`` /
   ``--tenant``),
+- ``--url http://collector:PORT --fleet --trace-id ID`` to fetch the
+  stitched cross-process trace from a fleet collector's (or
+  ``broker --collector``'s) ``/fleet/traces/<id>`` — each process
+  renders as its own lane (broker, pods, relays on one timeline),
 - a flight record (``flight-*.json``): its ``trace_id`` stamp selects
   the correlated trace from ``--url`` or a ``--traces FILE`` dump — the
   postmortem-to-timeline join.
@@ -15,6 +21,7 @@ Input forms:
 Usage:
     python tools/trace_export.py trace.json -o chrome.json
     python tools/trace_export.py --url http://127.0.0.1:9191 --tenant alice -o chrome.json
+    python tools/trace_export.py --url http://127.0.0.1:9500 --fleet --trace-id 4f2a -o chrome.json
     python tools/trace_export.py out/flight-123.json --url http://127.0.0.1:9191
 """
 
@@ -28,6 +35,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 TRACE_SCHEMA = "gol-trace-v1"
+FLEET_TRACE_SCHEMA = "gol-fleet-trace-v1"
 FLIGHT_SCHEMA = "gol-flight-v1"
 
 
@@ -37,10 +45,15 @@ def to_chrome(trace: dict) -> dict:
     events with microsecond timestamps relative to the trace start;
     always-retained events become instants ("i"); SLI marks become
     instants too, so time-to-first-dispatch/-frame read straight off
-    the timeline."""
+    the timeline.  A stitched ``gol-fleet-trace-v1`` doc renders with
+    one PROCESS LANE per node (broker, each pod, each relay), all on
+    the shared wall-clock-aligned axis."""
+    if trace.get("schema") == FLEET_TRACE_SCHEMA:
+        return _fleet_to_chrome(trace)
     if trace.get("schema") != TRACE_SCHEMA:
         raise ValueError(
-            f"not a {TRACE_SCHEMA} record (schema={trace.get('schema')!r})"
+            f"not a {TRACE_SCHEMA} / {FLEET_TRACE_SCHEMA} record "
+            f"(schema={trace.get('schema')!r})"
         )
     pid = 1
     events: list[dict] = [
@@ -112,7 +125,77 @@ def to_chrome(trace: dict) -> dict:
     }
 
 
-def _fetch_url(url: str, query: str) -> dict:
+def _fleet_to_chrome(trace: dict) -> dict:
+    """The stitched form: pid = node lane.  Span/event ``t0_ns`` are
+    already re-based onto the earliest process's clock by
+    ``obs.tracing.stitch_traces``, so lanes line up without further
+    arithmetic."""
+    pids = {
+        node: i + 1
+        for i, node in enumerate(sorted(trace.get("nodes", {})))
+    }
+    events: list[dict] = []
+    for node, pid in pids.items():
+        info = trace["nodes"].get(node) or {}
+        names = ",".join(info.get("names") or ())
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": f"{node} [{names}]" if names else node},
+            }
+        )
+    def lane(item) -> int:
+        pid = pids.get(item.get("node"))
+        if pid is None:
+            pid = pids[item.get("node")] = len(pids) + 1
+        return pid
+    for span in trace.get("spans", ()):
+        labels = {
+            k: v
+            for k, v in (span.get("labels") or {}).items()
+            if v is not None
+        }
+        events.append(
+            {
+                "name": span["name"],
+                "cat": "gol",
+                "ph": "X",
+                "ts": span["t0_ns"] / 1000.0,
+                "dur": max(span.get("dur_ns", 0), 1) / 1000.0,
+                "pid": lane(span),
+                "tid": 1,
+                "args": labels,
+            }
+        )
+    for ev in trace.get("events", ()):
+        events.append(
+            {
+                "name": ev["name"],
+                "cat": "gol.event",
+                "ph": "i",
+                "s": "p",
+                "ts": ev["t_ns"] / 1000.0,
+                "pid": lane(ev),
+                "tid": 1,
+                "args": dict(ev.get("labels") or {}),
+            }
+        )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "trace_id": trace["trace_id"],
+            "tenant": trace.get("tenant"),
+            "flagged": trace.get("flagged"),
+            "t0_unix": trace.get("t0_unix"),
+            "nodes": sorted(trace.get("nodes", {})),
+        },
+    }
+
+
+def _fetch_url(url: str, path: str) -> dict:
     import http.client
     from urllib.parse import urlsplit
 
@@ -121,11 +204,11 @@ def _fetch_url(url: str, query: str) -> dict:
         split.hostname or "127.0.0.1", split.port or 80, timeout=30
     )
     try:
-        conn.request("GET", f"/traces{query}")
+        conn.request("GET", path)
         resp = conn.getresponse()
         body = resp.read()
         if resp.status != 200:
-            raise RuntimeError(f"GET /traces{query}: HTTP {resp.status} {body[:200]!r}")
+            raise RuntimeError(f"GET {path}: HTTP {resp.status} {body[:200]!r}")
         return json.loads(body)
     finally:
         conn.close()
@@ -133,7 +216,7 @@ def _fetch_url(url: str, query: str) -> dict:
 
 def _pick(doc: dict, trace_id: str | None, tenant: str | None) -> dict:
     """One trace out of a single-trace dict or a /traces payload."""
-    if doc.get("schema") == TRACE_SCHEMA:
+    if doc.get("schema") in (TRACE_SCHEMA, FLEET_TRACE_SCHEMA):
         return doc
     traces = doc.get("traces")
     if not isinstance(traces, list) or not traces:
@@ -170,10 +253,18 @@ def resolve_trace(args) -> dict:
             if args.traces:
                 file_doc = json.loads(Path(args.traces).read_text())
     if file_doc is None and args.url:
-        query = f"?trace_id={trace_id}" if trace_id else (
-            f"?tenant={tenant}" if tenant else ""
-        )
-        file_doc = _fetch_url(args.url, query)
+        if getattr(args, "fleet", False):
+            if not trace_id:
+                raise RuntimeError(
+                    "--fleet needs --trace-id (or a flight record "
+                    "carrying one)"
+                )
+            file_doc = _fetch_url(args.url, f"/fleet/traces/{trace_id}")
+        else:
+            query = f"?trace_id={trace_id}" if trace_id else (
+                f"?tenant={tenant}" if tenant else ""
+            )
+            file_doc = _fetch_url(args.url, f"/traces{query}")
     if file_doc is None:
         raise RuntimeError(
             "nothing to read: pass a trace/flight JSON file, --url, or "
@@ -189,6 +280,10 @@ def main(argv=None) -> int:
                     "a flight-*.json to correlate")
     ap.add_argument("--url", default=None, metavar="http://host:port",
                     help="fetch from a live pod's /traces endpoint")
+    ap.add_argument("--fleet", action="store_true",
+                    help="treat --url as a fleet collector (or broker "
+                    "--collector) and fetch the STITCHED cross-process "
+                    "trace from /fleet/traces/<id> (needs --trace-id)")
     ap.add_argument("--traces", default=None, metavar="FILE",
                     help="a saved /traces payload to resolve a flight "
                     "record's trace_id against (offline correlation)")
