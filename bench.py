@@ -23,7 +23,7 @@ Extra diagnostics go to stderr; stdout carries exactly the one JSON line.
 
 Usage: python bench.py [--size N] [--kturns K] [--reps R] [--all]
                        [--engine auto|roll|pallas|packed|pallas-packed]
-                       [--pilot] [--plan-geometry M,C]
+                       [--pilot] [--netchaos] [--plan-geometry M,C]
 """
 
 from __future__ import annotations
@@ -2598,6 +2598,17 @@ def main():
         help="fast-forward horizon for --timecomp (delivered turns per "
         "compressed rep)",
     )
+    ap.add_argument(
+        "--netchaos",
+        action="store_true",
+        help="wire-chaos A/B mode (ISSUE 20): a hardened gateway's "
+        "/healthz round-trips clean vs through a seeded ChaosProxy "
+        "injecting a known per-connection latency, interleaved — the "
+        "chaos arm's deficit calibrates the fault injector, and the "
+        "wire_overhead block carries the hardened-on/off verdict.  "
+        "Prints one lint-checked JSON line and exits "
+        "(BENCH_NETCHAOS artifact).",
+    )
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -2631,6 +2642,20 @@ def main():
         # The metrics-snapshot lint (ISSUE 4): same contract as the stats
         # lint above — a malformed embedded snapshot fails the run rather
         # than shipping a broken artifact.
+        obs_metrics.require_embedded_metrics(record)
+        print(json.dumps(record))
+        return
+
+    if args.netchaos:
+        record = bench_netchaos(budget_seconds=1.0, reps=max(args.reps, 3))
+        # The clean-path hardening verdict rides the same artifact: the
+        # acceptance bar is "wire hardening costs the clean path nothing
+        # outside the rep spread", and this row is where it is recorded.
+        record["wire_overhead"] = bench_wire_overhead(
+            budget_seconds=1.0, reps=3
+        )
+        record["platform"] = dev.platform
+        measure.require_headline_stats(record)
         obs_metrics.require_embedded_metrics(record)
         print(json.dumps(record))
         return
@@ -2979,6 +3004,200 @@ def bench_collector_overhead(
     }
 
 
+def _healthz_rate(host: str, port: int, budget_seconds: float) -> float:
+    """One measurement window of the wire arms: warmed fresh-connection
+    GET /healthz round-trips counted against a live gateway for the
+    budget window.  Fresh connections on purpose — the wire guards
+    (accept bookkeeping, deadline arming, shed check) all live on the
+    connection path, so a kept-alive socket would measure nothing."""
+    import http.client
+
+    def rtt() -> None:
+        conn = http.client.HTTPConnection(host, port, timeout=10.0)
+        try:
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"healthz returned {resp.status}")
+        finally:
+            conn.close()
+
+    for _ in range(3):
+        rtt()
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < budget_seconds:
+        rtt()
+        n += 1
+    return n / (time.perf_counter() - t0)
+
+
+def bench_wire_overhead(budget_seconds: float = 1.0, reps: int = 3) -> dict:
+    """The ISSUE-20 wire-hardening arm: interleaved A/B gateway
+    /healthz round-trips with every wire guard OFF vs ON (read
+    deadline, body cap, connection bound, ws keepalive, idempotency
+    cache).  Same methodology and verdict tolerance as
+    ``bench_telemetry_overhead`` (interleaved arms, each arm's measured
+    rep envelope, 30% quiet-rig floor): hardening the wire must cost
+    the clean path nothing it can feel.  Both gateways stay up for the
+    whole run (they are stateless between requests) — the arms
+    alternate measurement WINDOWS, which is where the interleaving
+    earns its keep."""
+    import tempfile
+    from contextlib import ExitStack
+    from pathlib import Path
+
+    from distributed_gol_tpu.serve import GatewayServer, ServeConfig, ServePlane
+    from distributed_gol_tpu.utils import measure
+
+    off_cfg = dict(
+        wire_read_timeout_seconds=0.0,
+        wire_max_connections=0,
+        ws_keepalive_seconds=0.0,
+        idempotency_cache_size=0,
+    )
+    on_cfg = dict(
+        wire_read_timeout_seconds=10.0,
+        wire_body_cap_bytes=1 << 20,
+        wire_max_connections=64,
+        ws_keepalive_seconds=5.0,
+        idempotency_cache_size=256,
+    )
+    off_rates, on_rates = [], []
+    with ExitStack() as stack:
+        root = Path(stack.enter_context(
+            tempfile.TemporaryDirectory(prefix="gol_wirebench_")
+        ))
+        gateways = []
+        for name, cfg in (("off", off_cfg), ("on", on_cfg)):
+            plane = stack.enter_context(ServePlane(
+                ServeConfig(max_sessions=1, **cfg),
+                checkpoint_root=root / name,
+            ))
+            gw = GatewayServer(plane, port=0)
+            stack.callback(gw.close)
+            gateways.append(gw)
+        gw_off, gw_on = gateways
+        for _ in range(reps):
+            rate = _healthz_rate(gw_off.host, gw_off.port, budget_seconds)
+            if rate > 0:
+                off_rates.append(rate)
+            rate = _healthz_rate(gw_on.host, gw_on.port, budget_seconds)
+            if rate > 0:
+                on_rates.append(rate)
+    if not off_rates or not on_rates:
+        return {"error": "no surviving reps", "off": off_rates, "on": on_rates}
+    off = measure.summarize(off_rates)
+    on = measure.summarize(on_rates)
+    envelope = off["spread"] + on["spread"]
+    tolerance = max(0.3, envelope)
+    rel = abs(on["median"] - off["median"]) / off["median"]
+    return {
+        "metric": "gol_wire_overhead_pilot_healthz_rtt",
+        "unit": "requests/sec",
+        "value": round(on["median"], 2),
+        **on,
+        "hardening_off": off,
+        "overhead_rel": round(rel, 4),
+        "tolerance": round(tolerance, 4),
+        "within_rep_spread": rel <= tolerance,
+    }
+
+
+def bench_netchaos(
+    budget_seconds: float = 1.0,
+    reps: int = 3,
+    latency_seconds: float = 0.005,
+    seed: int = 20,
+) -> dict:
+    """``--netchaos``: the fault-injection A/B row (ISSUE 20).  A fully
+    hardened gateway serves /healthz twice per rep, interleaved: once
+    over loopback (the clean arm) and once through a seeded
+    :class:`ChaosProxy` whose plan hits EVERY connection with one
+    ``latency`` fault of a known size.  The chaos arm's deficit per
+    request should be the injected delay and nothing more — the proxy
+    is the measurement instrument, and this row is its calibration
+    record (observed added seconds ride next to the injected value)."""
+    from distributed_gol_tpu.obs import metrics as obs_metrics
+    from distributed_gol_tpu.testing.netchaos import ChaosProxy, WirePlan
+    from distributed_gol_tpu.utils import measure
+
+    import tempfile
+    from pathlib import Path
+
+    from distributed_gol_tpu.serve import GatewayServer, ServeConfig, ServePlane
+
+    hardened = dict(
+        wire_read_timeout_seconds=10.0,
+        wire_body_cap_bytes=1 << 20,
+        wire_max_connections=64,
+        ws_keepalive_seconds=5.0,
+        idempotency_cache_size=256,
+    )
+    clean_rates, chaos_rates = [], []
+    with tempfile.TemporaryDirectory(prefix="gol_netchaos_") as root:
+        with ServePlane(
+            ServeConfig(max_sessions=1, **hardened),
+            checkpoint_root=Path(root),
+        ) as plane:
+            gw = GatewayServer(plane, port=0)
+            plan = WirePlan.random(
+                seed,
+                4096,
+                p_fault=1.0,
+                kinds=("latency",),
+                seconds=latency_seconds,
+            )
+            proxy = ChaosProxy((gw.host, gw.port), plan)
+            try:
+                for _ in range(reps):
+                    rate = _healthz_rate(gw.host, gw.port, budget_seconds)
+                    if rate > 0:
+                        clean_rates.append(rate)
+                    rate = _healthz_rate(proxy.host, proxy.port, budget_seconds)
+                    if rate > 0:
+                        chaos_rates.append(rate)
+                faults_fired = len(proxy.fired)
+            finally:
+                proxy.close()
+                gw.close()
+    if not clean_rates or not chaos_rates:
+        return {
+            "error": "no surviving reps",
+            "clean": clean_rates,
+            "chaos": chaos_rates,
+        }
+    clean = measure.summarize(clean_rates)
+    chaos = measure.summarize(chaos_rates)
+    added = 1.0 / chaos["median"] - 1.0 / clean["median"]
+    record = {
+        "metric": "gol_netchaos_healthz_rtt",
+        "unit": "requests/sec",
+        "value": round(chaos["median"], 2),
+        **chaos,
+        "clean": {
+            "metric": "gol_netchaos_healthz_rtt_clean",
+            "unit": "requests/sec",
+            "value": round(clean["median"], 2),
+            **clean,
+        },
+        "seed": seed,
+        "injected_latency_seconds": latency_seconds,
+        "observed_added_seconds": round(added, 6),
+        "faults_fired": faults_fired,
+        "slowdown_rel": round(clean["median"] / chaos["median"], 4),
+        "metrics": obs_metrics.REGISTRY.snapshot(include_lazy=False).to_dict(),
+    }
+    log(
+        f"  netchaos healthz: clean {clean['median']:,.0f} req/s vs "
+        f"chaos {chaos['median']:,.0f} req/s "
+        f"({record['observed_added_seconds'] * 1e3:.2f} ms added for "
+        f"{latency_seconds * 1e3:.2f} ms injected)"
+    )
+    return record
+
+
 def timecomp_board(size: int):
     """An ash-dominated board for the time-compression arms: a lattice of
     blocks and blinkers (settled from turn 0) with one T-tetromino in a
@@ -3182,19 +3401,24 @@ def pilot_record(dev) -> dict:
     # Telemetry-overhead arm (ISSUE 12): sampler on vs off, interleaved,
     # asserted within the rep spread by tier-1 (test_bench_pilot).
     record["telemetry_overhead"] = bench_telemetry_overhead(
-        size, budget_seconds=2.0, reps=3
+        size, budget_seconds=1.5, reps=2
     )
     # Tracing-overhead arm (ISSUE 15): request trace on vs off,
     # interleaved, asserted within the rep spread by tier-1.
     record["tracing_overhead"] = bench_tracing_overhead(
-        size, budget_seconds=2.0, reps=3
+        size, budget_seconds=1.5, reps=2
     )
     # Collector-overhead arm (ISSUE 19): fleet scrape on vs off,
     # interleaved, asserted within the rep spread by tier-1 — being
     # scraped must cost a pod nothing it can feel.
     record["collector_overhead"] = bench_collector_overhead(
-        size, budget_seconds=2.0, reps=3
+        size, budget_seconds=1.5, reps=2
     )
+    # Wire-hardening arm (ISSUE 20): every wire guard on vs off over
+    # fresh-connection /healthz round-trips, interleaved, asserted
+    # within the rep spread by tier-1 — hardening the wire must cost
+    # the clean path nothing it can feel.
+    record["wire_overhead"] = bench_wire_overhead(budget_seconds=0.5, reps=2)
     # Time-compression arm (ISSUE 16): effective-vs-computed on the
     # ash-dominated pilot board, pilot-sized (10^7 fast-forward turns,
     # 2 reps) — tier-1 asserts the row shape and the >=10x floor.

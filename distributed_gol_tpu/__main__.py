@@ -372,6 +372,32 @@ def build_serve_parser() -> argparse.ArgumentParser:
     ap.add_argument("--gateway-host", default="127.0.0.1",
                     help="gateway bind address (0.0.0.0 for off-host "
                     "controllers/spectators)")
+    # Wire hardening (ISSUE 20; docs/API.md "Wire hardening").
+    ap.add_argument("--wire-read-timeout", type=float, default=30.0,
+                    metavar="SECONDS",
+                    help="per-connection read deadline on the gateway: "
+                    "a request trickling slower than this (slow-loris) "
+                    "is answered 408 and reaped (0 = off)")
+    ap.add_argument("--wire-body-cap", type=int, default=1 << 26,
+                    metavar="BYTES",
+                    help="request-body Content-Length bound; past it "
+                    "the answer is 413, never a buffered read")
+    ap.add_argument("--wire-max-connections", type=int, default=0,
+                    metavar="N",
+                    help="concurrent-connection bound on the gateway; "
+                    "past it a new connection gets a raw 503 on the "
+                    "accept thread (0 = unbounded)")
+    ap.add_argument("--ws-keepalive", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="WebSocket ping/pong keepalive interval on the "
+                    "gateway's legs: a peer that answers neither frames "
+                    "nor pongs for 3 consecutive intervals is dropped "
+                    "(0 = off; arm it only for clients that sit in "
+                    "recv and auto-pong, like gol_client.py streams)")
+    ap.add_argument("--ws-max-frame", type=int, default=1 << 20,
+                    metavar="BYTES",
+                    help="inbound WebSocket frame cap; an over-length "
+                    "declaration is a protocol error, not an allocation")
     # Continuous telemetry + SLOs (ISSUE 12; docs/API.md "Telemetry
     # export").
     ap.add_argument("--telemetry-port", type=int, default=None,
@@ -480,6 +506,11 @@ def serve_main(argv) -> int:
             slo_queue_wait_seconds=args.slo_queue_wait,
             trace_sample_rate=args.trace_sample_rate,
             trace_ring_depth=args.trace_ring_depth,
+            wire_read_timeout_seconds=args.wire_read_timeout,
+            wire_body_cap_bytes=args.wire_body_cap,
+            wire_max_connections=args.wire_max_connections,
+            ws_keepalive_seconds=args.ws_keepalive,
+            ws_max_frame_bytes=args.ws_max_frame,
         )
     except ValueError as e:
         ap.error(str(e))
@@ -707,6 +738,7 @@ def relay_main(argv) -> int:
     from distributed_gol_tpu.serve.relay import (
         BACKOFF_MAX,
         DEFAULT_CACHE_DELTAS,
+        DEFAULT_KEEPALIVE,
         DEFAULT_QUEUE_DEPTH,
         RelayServer,
     )
@@ -735,6 +767,13 @@ def relay_main(argv) -> int:
                     "+ cache resync past it)")
     ap.add_argument("--backoff-max", type=float, default=BACKOFF_MAX,
                     help="resubscribe backoff cap, seconds")
+    ap.add_argument("--keepalive", type=float, default=DEFAULT_KEEPALIVE,
+                    metavar="SECONDS",
+                    help="upstream ping/pong keepalive interval (ISSUE "
+                    "20): an upstream that answers neither frames nor "
+                    "pongs for 3 consecutive intervals is a half-open "
+                    "stall, dropped and resubscribed like a disconnect "
+                    "(0 = unbounded blocking reads)")
     args = ap.parse_args(argv)
     relay = RelayServer(
         args.upstream,
@@ -743,6 +782,7 @@ def relay_main(argv) -> int:
         cache_deltas=args.cache_deltas,
         queue_depth=args.queue_depth,
         backoff_max=args.backoff_max,
+        keepalive_seconds=args.keepalive,
     )
     print(
         f"relay: {relay.url}/v1/frames <- {args.upstream} "

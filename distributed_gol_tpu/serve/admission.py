@@ -159,6 +159,36 @@ class ServeConfig:
     # dropped_spans — a request timeline's interesting part is its head).
     trace_ring_depth: int = 256
     trace_max_spans: int = 512
+    # --- wire hardening (ISSUE 20; docs/API.md "Wire hardening") ---
+    # Per-connection HTTP read deadline on the gateway: a peer that
+    # trickles its request slower than this (slow-loris) is answered a
+    # best-effort 408 and reaped (net.slowloris_reaped).  0 = off.
+    wire_read_timeout_seconds: float = 30.0
+    # Request-body Content-Length bound; an oversized declaration is a
+    # 413 (net.oversize_rejected), never a 500.
+    wire_body_cap_bytes: int = 1 << 26
+    # Concurrent-connection bound on the gateway: past it, a new
+    # connection gets a raw 503 on the accept thread
+    # (net.connections_shed).  0 = unbounded (the pre-ISSUE-20 shape).
+    wire_max_connections: int = 0
+    # WebSocket recv keepalive on the gateway's controller/spectator
+    # legs: a stalled-NOT-closed peer (half-open socket) is pinged
+    # every this-many seconds and dropped after ws_keepalive_misses
+    # silent intervals (net.keepalive_drops) — detection bound =
+    # seconds × misses.  0 = off: a quiet controller leg may sit idle
+    # forever (the pre-ISSUE-20 shape; a live client's auto-pong makes
+    # arming this safe whenever the client library is ours).
+    ws_keepalive_seconds: float = 0.0
+    ws_keepalive_misses: int = 3
+    # Inbound WebSocket frame-size cap on the gateway's legs (control
+    # messages are tiny; anything near the codec ceiling is an attack
+    # or a bug).
+    ws_max_frame_bytes: int = 1 << 20
+    # POST /v1/sessions idempotency-token replay window: receipts for
+    # the last N tokens are retained so a submit whose response died
+    # mid-body can be retried (same X-Gol-Idempotency-Key) without
+    # double-placing the tenant (net.idempotent_replays).
+    idempotency_cache_size: int = 256
 
     def __post_init__(self):
         if self.max_sessions < 1:
@@ -206,6 +236,28 @@ class ServeConfig:
         if self.slo_queue_wait_seconds < 0:
             raise ValueError(
                 "slo_queue_wait_seconds must be >= 0 (0 disables)"
+            )
+        if self.wire_read_timeout_seconds < 0:
+            raise ValueError(
+                "wire_read_timeout_seconds must be >= 0 (0 disables)"
+            )
+        if self.wire_body_cap_bytes < 1:
+            raise ValueError("wire_body_cap_bytes must be >= 1")
+        if self.wire_max_connections < 0:
+            raise ValueError(
+                "wire_max_connections must be >= 0 (0 = unbounded)"
+            )
+        if self.ws_keepalive_seconds < 0:
+            raise ValueError(
+                "ws_keepalive_seconds must be >= 0 (0 disables)"
+            )
+        if self.ws_keepalive_misses < 1:
+            raise ValueError("ws_keepalive_misses must be >= 1")
+        if self.ws_max_frame_bytes < 1:
+            raise ValueError("ws_max_frame_bytes must be >= 1")
+        if self.idempotency_cache_size < 0:
+            raise ValueError(
+                "idempotency_cache_size must be >= 0 (0 disables replay)"
             )
         # The SLO field set validates as a unit (ranges, window ordering)
         # and an armed objective REQUIRES the sampler: the burn windows

@@ -105,6 +105,10 @@ class BrokerConfig:
     #: which a condemned pod could have rejoined (interval * threshold).
     retry_after_seconds: float = 1.0
     flight_depth: int = 256
+    #: TCP connect deadline for broker→pod calls, split from the read
+    #: budget (ISSUE 20): a blackholed pod address fails in this bound
+    #: instead of eating the whole request timeout.
+    connect_timeout_seconds: float = 5.0
     #: Transport retry policy for control forwards (the PR-2 shape);
     #: probes always use attempts=1 — one miss is one datum.
     attempts: int = 2
@@ -131,6 +135,8 @@ class BrokerConfig:
             raise ValueError("flight_depth must be >= 0")
         if self.attempts < 1:
             raise ValueError("attempts must be >= 1")
+        if self.connect_timeout_seconds <= 0:
+            raise ValueError("connect_timeout_seconds must be > 0")
         if self.collector_interval_seconds <= 0:
             raise ValueError("collector_interval_seconds must be > 0")
         if self.collector_scrape_timeout_seconds <= 0:
@@ -299,6 +305,7 @@ class Broker(StdlibHTTPServer):
                     attempts=self.config.attempts,
                     backoff_seconds=self.config.backoff_seconds,
                     backoff_max_seconds=self.config.backoff_max_seconds,
+                    connect_timeout=self.config.connect_timeout_seconds,
                 ),
             )
             for e in endpoints

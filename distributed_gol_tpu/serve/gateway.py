@@ -60,7 +60,7 @@ import json
 import queue
 import re
 import threading
-from collections import deque
+from collections import OrderedDict, deque
 from pathlib import Path
 
 from distributed_gol_tpu.engine.events import (
@@ -76,7 +76,7 @@ from distributed_gol_tpu.obs import tracing
 from distributed_gol_tpu.serve import wire
 from distributed_gol_tpu.serve.admission import AdmissionRejected
 from distributed_gol_tpu.serve.httpd import StdlibHTTPServer, read_body
-from distributed_gol_tpu.serve.ws import WsClosed, server_upgrade
+from distributed_gol_tpu.serve.ws import WsClosed, WsTimeout, server_upgrade
 
 #: Event-ring depth per session: the reconnect replay window (a
 #: controller that detached longer ago than this re-anchors from the
@@ -309,6 +309,20 @@ class GatewayServer(StdlibHTTPServer):
         self._g_spectators.set(0)
         self._n_controllers = 0
         self._n_spectators = 0
+        # Wire hardening (ISSUE 20): the gateway arms the scaffolding's
+        # read deadline / body cap / connection bound from ServeConfig,
+        # keeps a bounded idempotency-receipt ring so a retried POST
+        # /v1/sessions (response died mid-body) replays its receipt
+        # instead of double-placing the tenant, and counts keepalive
+        # drops from its WebSocket legs.
+        cfg = plane.config
+        self._ws_keepalive = float(cfg.ws_keepalive_seconds)
+        self._ws_keepalive_misses = int(cfg.ws_keepalive_misses)
+        self._ws_max_frame = int(cfg.ws_max_frame_bytes)
+        self._idem_cap = int(cfg.idempotency_cache_size)
+        self._idem: OrderedDict[str, tuple[int, dict]] = OrderedDict()
+        self._m_replays = reg.counter("net.idempotent_replays")
+        self._m_keepalive_drops = reg.counter("net.keepalive_drops")
         # SIGTERM closes the wire face BEFORE the plane sheds (the
         # drain contract's gateway half).
         plane.add_drain_hook(self._on_drain)
@@ -317,6 +331,9 @@ class GatewayServer(StdlibHTTPServer):
             host=host,
             registry=reg,
             request_counter=self._m_requests,
+            read_timeout=(cfg.wire_read_timeout_seconds or None),
+            body_cap=cfg.wire_body_cap_bytes,
+            max_connections=cfg.wire_max_connections,
         )
         # The bound wire address (ephemeral port 0 resolved) — how a
         # second terminal discovers the gateway.
@@ -511,6 +528,23 @@ class GatewayServer(StdlibHTTPServer):
                 {"error": "tenant must match [A-Za-z0-9][A-Za-z0-9._-]*"},
             )
             return True
+        # Idempotent retry (ISSUE 20): a client whose 201 died mid-body
+        # resends with the same ``X-Gol-Idempotency-Key``; the stored
+        # receipt is replayed verbatim instead of double-placing the
+        # tenant through admission.
+        idem_key = request.headers.get("X-Gol-Idempotency-Key")
+        if idem_key:
+            with self._lock:
+                stored = self._idem.get(idem_key)
+            if stored is not None:
+                code, receipt = stored
+                self._m_replays.inc()
+                request._send_json(
+                    code,
+                    receipt,
+                    headers=[("X-Gol-Idempotent-Replay", "1")],
+                )
+                return True
         # Request-scoped tracing (ISSUE 15): accept the inbound W3C
         # ``traceparent`` (a malformed one starts a fresh trace; an
         # inbound sampled flag forces retention) — the wire-handling
@@ -571,26 +605,30 @@ class GatewayServer(StdlibHTTPServer):
             path="/v1/sessions",
             tenant=tenant,
         )
-        request._send_json(
-            201,
-            {
-                "tenant": tenant,
-                "status": handle.status,
-                "admitted_as": handle.admitted_as,
-                "spectate": options["spectate"],
-                # The correlation stamp (ISSUE 15): fetch the timeline
-                # at GET /traces?trace_id=<this> once the run moves.
-                "trace_id": req_trace.trace_id,
-                "traceparent": req_trace.traceparent(),
-                "links": {
-                    "state": f"/v1/sessions/{tenant}/state",
-                    "events": f"/v1/sessions/{tenant}/events",
-                    "frames": f"/v1/sessions/{tenant}/frames",
-                    "trace": f"/traces?trace_id={req_trace.trace_id}",
-                },
+        receipt = {
+            "tenant": tenant,
+            "status": handle.status,
+            "admitted_as": handle.admitted_as,
+            "spectate": options["spectate"],
+            # The correlation stamp (ISSUE 15): fetch the timeline
+            # at GET /traces?trace_id=<this> once the run moves.
+            "trace_id": req_trace.trace_id,
+            "traceparent": req_trace.traceparent(),
+            "links": {
+                "state": f"/v1/sessions/{tenant}/state",
+                "events": f"/v1/sessions/{tenant}/events",
+                "frames": f"/v1/sessions/{tenant}/frames",
+                "trace": f"/traces?trace_id={req_trace.trace_id}",
             },
-            headers=trace_headers,
-        )
+        }
+        if idem_key and self._idem_cap:
+            # Store BEFORE the send: it is exactly the response that
+            # dies mid-body whose retry must find the receipt.
+            with self._lock:
+                self._idem[idem_key] = (201, receipt)
+                while len(self._idem) > self._idem_cap:
+                    self._idem.popitem(last=False)
+        request._send_json(201, receipt, headers=trace_headers)
         return True
 
     def _control(self, request, tenant, session, action) -> bool:
@@ -618,6 +656,21 @@ class GatewayServer(StdlibHTTPServer):
         )
         return True
 
+    # -- ws legs ---------------------------------------------------------------
+    def _upgrade(self, request):
+        """``server_upgrade`` with the gateway's wire policy: the
+        inbound frame cap, and (when armed) the recv-deadline keepalive
+        that detects a stalled-not-closed peer.  The keepalive socket
+        timeout also bounds every ``send``: a spectator that stopped
+        reading (full SO_SNDBUF) times the leg out instead of parking
+        its streaming thread forever."""
+        ws = server_upgrade(request, max_payload=self._ws_max_frame)
+        if ws is not None and self._ws_keepalive > 0:
+            ws.enable_keepalive(
+                self._ws_keepalive, misses=self._ws_keepalive_misses
+            )
+        return ws
+
     # -- the controller leg ----------------------------------------------------
     def _controller_ws(self, request, tenant, session, query) -> bool:
         if session is None:
@@ -630,7 +683,7 @@ class GatewayServer(StdlibHTTPServer):
         except ValueError:
             request._send_json(400, {"error": "bad since"})
             return True
-        ws = server_upgrade(request)
+        ws = self._upgrade(request)
         if ws is None:
             return True
         cq: queue.Queue = queue.Queue(maxsize=1024)
@@ -718,7 +771,7 @@ class GatewayServer(StdlibHTTPServer):
             )
         except OSError:
             pass
-        ws = server_upgrade(request)
+        ws = self._upgrade(request)
         if ws is None:
             session.frame_plane.unsubscribe(sub)
             return True
@@ -796,6 +849,10 @@ class GatewayServer(StdlibHTTPServer):
                         ws.send_text(
                             json.dumps({"type": "error", "error": str(e)})
                         )
+            except WsTimeout:
+                # The keepalive verdict: no frame (not even a pong)
+                # inside the miss budget — a stalled-not-closed peer.
+                self._m_keepalive_drops.inc()
             except (WsClosed, OSError, UnicodeDecodeError):
                 pass
             finally:

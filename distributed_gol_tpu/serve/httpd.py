@@ -1,5 +1,5 @@
 """Stdlib HTTP scaffolding — ONE home for the pod's wire servers
-(ISSUE 14 satellite).
+(ISSUE 14 satellite; transport hardening ISSUE 20).
 
 Both network faces of the serving plane — the telemetry scrape surface
 (``serve/telemetry.py``, ISSUE 12) and the gateway control plane
@@ -26,6 +26,22 @@ Both network faces of the serving plane — the telemetry scrape surface
   and never touch a device, take a session lock, or wait on a
   dispatch, so a wedged tenant can never hang a request.
 
+Wire hardening (ISSUE 20; docs/API.md "Wire hardening") — three
+optional knobs, each off (0/None) by default so every existing server
+keeps its exact behavior until it arms them:
+
+- ``read_timeout`` — per-connection socket read deadline.  A peer that
+  trickles its request slower than the deadline (the slow-loris shape)
+  is answered a best-effort ``408`` and reaped, counted on
+  ``net.slowloris_reaped``.  WebSocket upgrades DISARM the reaper (the
+  leg owns its own deadline/keepalive policy from there).
+- ``body_cap`` — :func:`read_body`'s default Content-Length bound; an
+  oversized declaration is a ``413`` (never a 500), counted on
+  ``net.oversize_rejected``.
+- ``max_connections`` — concurrent-connection bound; past it, a new
+  connection is answered a raw ``503`` and closed before a handler
+  thread is ever spawned, counted on ``net.connections_shed``.
+
 Subclasses implement :meth:`handle`; everything above stays here
 instead of growing a second hand-rolled copy per server.
 """
@@ -39,12 +55,112 @@ from urllib.parse import parse_qs, urlsplit
 
 from distributed_gol_tpu.obs import metrics as metrics_lib
 
+#: Default Content-Length bound of :func:`read_body` when neither the
+#: caller nor the server armed one (a 65536² board upload is ~0.5 GiB
+#: of PGM; anything past 64 MiB through a control endpoint is a bug).
+DEFAULT_BODY_CAP = 1 << 26
+
+
+class BodyTooLarge(ValueError):
+    """A request body whose declared length exceeds the cap — the
+    routing layer answers 413 (and bumps ``net.oversize_rejected``)
+    instead of the generic 500."""
+
+
+class _ReapingFile:
+    """The slow-loris reaper: wraps a handler's ``rfile`` so a read
+    deadline expiring mid-request is COUNTED and answered a
+    best-effort 408 before the stdlib's quiet TimeoutError close path
+    runs.  :func:`ws.server_upgrade` disarms it — a WebSocket leg owns
+    its own deadline/keepalive policy."""
+
+    def __init__(self, inner, connection, on_timeout):
+        self._inner = inner
+        self._connection = connection
+        self._on_timeout = on_timeout
+        self.armed = True
+
+    def disarm(self) -> None:
+        self.armed = False
+
+    def _reap(self) -> None:
+        if not self.armed:
+            return
+        self.armed = False  # count one reap per connection
+        self._on_timeout()
+        try:
+            self._connection.sendall(
+                b"HTTP/1.1 408 Request Timeout\r\n"
+                b"Content-Length: 0\r\nConnection: close\r\n\r\n"
+            )
+        except OSError:
+            pass
+
+    def readline(self, *args):
+        try:
+            return self._inner.readline(*args)
+        except TimeoutError:
+            self._reap()
+            raise
+
+    def read(self, *args):
+        try:
+            return self._inner.read(*args)
+        except TimeoutError:
+            self._reap()
+            raise
+
+    def readinto(self, b):
+        try:
+            return self._inner.readinto(b)
+        except TimeoutError:
+            self._reap()
+            raise
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class _BoundedThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with an optional concurrent-connection
+    bound: past ``gol_conn_slots``, a new connection gets a raw 503
+    and is closed on the ACCEPT thread — no handler thread, no parse,
+    no queue."""
+
+    gol_conn_slots: threading.Semaphore | None = None
+    gol_on_shed = None
+
+    def process_request(self, request, client_address):
+        slots = self.gol_conn_slots
+        if slots is not None and not slots.acquire(blocking=False):
+            if self.gol_on_shed is not None:
+                self.gol_on_shed()
+            try:
+                request.sendall(
+                    b"HTTP/1.1 503 Service Unavailable\r\n"
+                    b"Content-Length: 0\r\nConnection: close\r\n\r\n"
+                )
+            except OSError:
+                pass
+            self.shutdown_request(request)
+            return
+        super().process_request(request, client_address)
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            if self.gol_conn_slots is not None:
+                self.gol_conn_slots.release()
+
 
 class StdlibHTTPServer:
     """The scaffolding base: bind, serve from daemon threads, publish
     the endpoint, tear down.  ``request_counter`` (optional) is bumped
     once per request before routing — the ``telemetry.scrapes`` /
-    ``gateway.requests`` families ride it."""
+    ``gateway.requests`` families ride it.  ``read_timeout`` /
+    ``body_cap`` / ``max_connections`` arm the wire hardening (module
+    docstring); all default off."""
 
     #: Thread name of the accept loop; subclasses override.
     thread_name = "gol-http"
@@ -55,17 +171,38 @@ class StdlibHTTPServer:
         host: str = "127.0.0.1",
         registry=None,
         request_counter=None,
+        read_timeout: float | None = None,
+        body_cap: int = DEFAULT_BODY_CAP,
+        max_connections: int = 0,
     ):
         self.registry = (
             registry if registry is not None else metrics_lib.REGISTRY
         )
         self._request_counter = request_counter
+        self._read_timeout = read_timeout if read_timeout else None
+        self._body_cap = int(body_cap)
+        # The wire-hardening families (ISSUE 20), one registration site
+        # for every server that rides this scaffolding.
+        self._m_slowloris = self.registry.counter("net.slowloris_reaped")
+        self._m_oversize = self.registry.counter("net.oversize_rejected")
+        self._m_conn_shed = self.registry.counter("net.connections_shed")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             # A wire surface must never block on the pod's logs.
             def log_message(self, fmt, *args):  # noqa: ARG002
                 pass
+
+            def setup(self):
+                super().setup()
+                self.gol_body_cap = outer._body_cap
+                if outer._read_timeout is not None:
+                    self.connection.settimeout(outer._read_timeout)
+                    self.rfile = _ReapingFile(
+                        self.rfile,
+                        self.connection,
+                        outer._m_slowloris.inc,
+                    )
 
             def _send(
                 self, code: int, body: bytes, ctype: str, headers=()
@@ -93,7 +230,12 @@ class StdlibHTTPServer:
             def do_DELETE(self):  # noqa: N802
                 outer._route(self, "DELETE")
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = _BoundedThreadingHTTPServer((host, port), Handler)
+        if max_connections:
+            self._httpd.gol_conn_slots = threading.Semaphore(
+                int(max_connections)
+            )
+            self._httpd.gol_on_shed = self._m_conn_shed.inc
         self._httpd.daemon_threads = True
         self.host = self._httpd.server_address[0]
         self.port = self._httpd.server_address[1]
@@ -116,8 +258,19 @@ class StdlibHTTPServer:
         try:
             if not self.handle(request, method, path, query):
                 request._send(404, b"not found\n", "text/plain")
+        except BodyTooLarge as e:
+            self._m_oversize.inc()
+            try:
+                request._send_json(413, {"error": str(e)})
+            except OSError:
+                pass
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away mid-response
+        except TimeoutError:
+            # The read deadline fired inside a handler's body read: the
+            # reaper already counted it and answered 408 — re-raise so
+            # the stdlib's handle_one_request closes the connection.
+            raise
         except Exception as e:  # noqa: BLE001 — a handler bug is a 500
             body = f"{type(e).__name__}: {e}\n".encode()
             try:
@@ -149,10 +302,17 @@ class StdlibHTTPServer:
         self.close()
 
 
-def read_body(request, cap: int = 1 << 26) -> bytes:
+def read_body(request, cap: int | None = None) -> bytes:
     """The request body per its Content-Length (empty when absent),
-    refused past ``cap`` — a wire surface reads bounded input only."""
+    refused past ``cap`` — a wire surface reads bounded input only.
+    ``cap=None`` uses the server's armed ``body_cap`` (falling back to
+    :data:`DEFAULT_BODY_CAP`); the refusal is a 413 through the
+    routing layer (:class:`BodyTooLarge`), never a 500."""
+    if cap is None:
+        cap = getattr(request, "gol_body_cap", DEFAULT_BODY_CAP)
     length = int(request.headers.get("Content-Length") or 0)
     if length < 0 or length > cap:
-        raise ValueError(f"request body of {length} bytes exceeds the cap")
+        raise BodyTooLarge(
+            f"request body of {length} bytes exceeds the {cap}-byte cap"
+        )
     return request.rfile.read(length) if length else b""

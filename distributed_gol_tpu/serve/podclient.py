@@ -30,7 +30,19 @@ from __future__ import annotations
 import http.client
 import json
 import time
+import uuid
 from urllib.parse import urlsplit
+
+#: Response-size bound (ISSUE 20): a broker control answer is JSON a
+#: few KiB long; a pod (or a chaos proxy wearing its address) that
+#: declares or streams more than this is answering garbage, and the
+#: broker must not buffer it.
+DEFAULT_RESPONSE_CAP = 1 << 24
+
+#: The idempotency header ``POST /v1/sessions`` retries carry
+#: (docs/API.md "Wire hardening"); the gateway replays the stored
+#: receipt for a repeated key instead of double-placing the tenant.
+IDEMPOTENCY_HEADER = "X-Gol-Idempotency-Key"
 
 
 class PodUnreachable(RuntimeError):
@@ -90,6 +102,8 @@ class PodClient:
         attempts: int = 1,
         backoff_seconds: float = 0.05,
         backoff_max_seconds: float = 1.0,
+        connect_timeout: float | None = None,
+        response_cap: int = DEFAULT_RESPONSE_CAP,
     ):
         split = urlsplit(base_url if "//" in base_url else f"//{base_url}")
         self.host = split.hostname or "127.0.0.1"
@@ -100,6 +114,16 @@ class PodClient:
         self.attempts = max(1, attempts)
         self.backoff_seconds = backoff_seconds
         self.backoff_max_seconds = backoff_max_seconds
+        # Split budgets (ISSUE 20): TCP connect gets its own (usually
+        # tighter) deadline — a blackholed address should fail in
+        # connect_timeout, not eat the whole read budget.  Default:
+        # min(read budget, 10 s).
+        self.connect_timeout = (
+            float(connect_timeout)
+            if connect_timeout is not None
+            else min(timeout, 10.0)
+        )
+        self.response_cap = int(response_cap)
 
     def __repr__(self) -> str:
         return f"PodClient({self.base_url})"
@@ -113,17 +137,39 @@ class PodClient:
         headers: dict | None,
         timeout: float,
     ):
+        # Connect under its own deadline, then widen to the read
+        # budget for the request/response exchange (the split-timeout
+        # discipline: a blackholed pod fails fast, a slow answer gets
+        # its full read budget).
         conn = http.client.HTTPConnection(
-            self.host, self.port, timeout=timeout
+            self.host, self.port, timeout=min(self.connect_timeout, timeout)
         )
         try:
+            conn.connect()
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
             payload = json.dumps(body).encode() if body is not None else None
             send_headers = dict(headers or {})
             if payload:
                 send_headers["Content-Type"] = "application/json"
             conn.request(method, path, body=payload, headers=send_headers)
             resp = conn.getresponse()
-            raw = resp.read()
+            cap = self.response_cap
+            declared = int(resp.headers.get("Content-Length") or 0)
+            if declared > cap:
+                raise PodHTTPError(
+                    resp.status,
+                    {
+                        "error": f"response of {declared} bytes exceeds "
+                        f"the {cap}-byte cap"
+                    },
+                )
+            raw = resp.read(cap + 1)
+            if len(raw) > cap:
+                raise PodHTTPError(
+                    resp.status,
+                    {"error": f"response exceeds the {cap}-byte cap"},
+                )
             try:
                 doc = json.loads(raw) if raw else {}
             except ValueError:
@@ -178,12 +224,26 @@ class PodClient:
                 return e.body
             raise
 
-    def submit(self, doc: dict, traceparent: str | None = None) -> dict:
+    def submit(
+        self,
+        doc: dict,
+        traceparent: str | None = None,
+        idempotency_key: str | None = None,
+    ) -> dict:
         """``POST /v1/sessions`` — the spec doc verbatim (the broker
         forwards what the client sent; ``serve/wire.py`` on the pod is
         the single schema authority).  ``traceparent`` rides as the W3C
-        header so the pod joins the broker's trace."""
-        headers = {"traceparent": traceparent} if traceparent else None
+        header so the pod joins the broker's trace.
+
+        One ``X-Gol-Idempotency-Key`` is minted per *call* (not per
+        attempt), so the internal transport-retry ladder — exactly the
+        path a response that died mid-body takes — replays the stored
+        receipt instead of double-placing the tenant.  Pass
+        ``idempotency_key`` to span retries ABOVE this call (the
+        broker's spill-and-retry)."""
+        headers = {IDEMPOTENCY_HEADER: idempotency_key or uuid.uuid4().hex}
+        if traceparent:
+            headers["traceparent"] = traceparent
         return self.request("POST", "/v1/sessions", doc, headers=headers)
 
     def sessions(self) -> dict:
@@ -208,6 +268,8 @@ class PodClient:
 
 
 __all__ = [
+    "DEFAULT_RESPONSE_CAP",
+    "IDEMPOTENCY_HEADER",
     "PodClient",
     "PodHTTPError",
     "PodUnreachable",

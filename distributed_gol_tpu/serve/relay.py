@@ -73,7 +73,7 @@ from distributed_gol_tpu.obs import openmetrics
 from distributed_gol_tpu.obs import tracing
 from distributed_gol_tpu.serve import ws as ws_lib
 from distributed_gol_tpu.serve.httpd import StdlibHTTPServer
-from distributed_gol_tpu.serve.ws import WsClosed
+from distributed_gol_tpu.serve.ws import WsClosed, WsTimeout
 
 #: Default per-downstream queue depth (frames) — the FramePlane default.
 DEFAULT_QUEUE_DEPTH = 8
@@ -84,6 +84,13 @@ DEFAULT_CACHE_DELTAS = 64
 #: Resubscribe backoff curve: initial and cap, seconds.
 BACKOFF_INITIAL = 0.25
 BACKOFF_MAX = 5.0
+
+#: Default upstream keepalive (ISSUE 20): frames can be arbitrarily
+#: sparse (a paused session), so silence alone is not death — but an
+#: upstream that answers neither frames NOR pongs inside this bound
+#: times 3 misses is a half-open stall, treated exactly like a
+#: disconnect (backoff-resubscribe, seq-gap latch re-anchors).
+DEFAULT_KEEPALIVE = 20.0
 
 
 def _parse_frame_header(blob) -> dict:
@@ -136,6 +143,7 @@ class RelayServer(StdlibHTTPServer):
         backoff_initial: float = BACKOFF_INITIAL,
         backoff_max: float = BACKOFF_MAX,
         connect_timeout: float = 10.0,
+        keepalive_seconds: float = DEFAULT_KEEPALIVE,
         registry=None,
     ):
         self.upstream = upstream
@@ -144,6 +152,7 @@ class RelayServer(StdlibHTTPServer):
         self._backoff_initial = backoff_initial
         self._backoff_max = backoff_max
         self._connect_timeout = connect_timeout
+        self._keepalive_seconds = float(keepalive_seconds)
 
         self._lock = threading.Lock()
         self._clients: dict[int, _Downstream] = {}
@@ -184,6 +193,7 @@ class RelayServer(StdlibHTTPServer):
         self._m_drops = reg.counter("relay.drops")
         self._m_cache_serves = reg.counter("relay.cache_serves")
         self._m_resubscribes = reg.counter("relay.resubscribes")
+        self._m_keepalive_drops = reg.counter("net.keepalive_drops")
         #: End-to-end frame age at ingest, from the ``ts`` wall-clock
         #: stamp pods put in the frame header — relays forward blobs
         #: verbatim, so a depth-N chain's last hop still measures true
@@ -251,10 +261,17 @@ class RelayServer(StdlibHTTPServer):
             except (OSError, WsClosed, ValueError):
                 continue
             self._upstream_ws = wsock
-            # Frames can be arbitrarily sparse (a paused session): the
-            # reader blocks without an idle timeout; close()/abort()
-            # unblocks it.
-            wsock.settimeout(None)
+            # Frames can be arbitrarily sparse (a paused session), so
+            # silence alone is not death — the keepalive pings through
+            # it, and only an upstream that answers neither frames nor
+            # pongs (the half-open stall) is dropped, riding the SAME
+            # backoff-resubscribe + seq-gap path as a disconnect.
+            # keepalive_seconds=0 restores the unbounded blocking read
+            # (close()/abort() still unblocks it).
+            if self._keepalive_seconds > 0:
+                wsock.enable_keepalive(self._keepalive_seconds)
+            else:
+                wsock.settimeout(None)
             with self._lock:
                 self._connected = True
                 self._gap = True
@@ -268,6 +285,10 @@ class RelayServer(StdlibHTTPServer):
                         continue
                     self._ingest(payload)
                     backoff = self._backoff_initial
+            except WsTimeout:
+                # Stalled-not-closed upstream: count it, then recover
+                # exactly like a disconnect.
+                self._m_keepalive_drops.inc()
             except (WsClosed, OSError, ValueError):
                 pass
             finally:

@@ -42,6 +42,13 @@ class WsClosed(ConnectionError):
     """The peer closed (or the socket died) — the detach signal."""
 
 
+class WsTimeout(WsClosed):
+    """The peer stopped SENDING without closing (recv deadline or
+    keepalive budget exhausted) — a half-open connection.  Subclasses
+    :class:`WsClosed` so every existing detach path already handles it;
+    catch it first to count/react to stalls specifically (ISSUE 20)."""
+
+
 def accept_key(key: str) -> str:
     """RFC 6455 §4.2.2: the Sec-WebSocket-Accept for a client key."""
     digest = hashlib.sha1((key + GUID).encode()).digest()
@@ -99,7 +106,10 @@ class WebSocket:
     gateway's reader thread pongs while the pump thread streams);
     ``recv`` is single-consumer."""
 
-    def __init__(self, rfile, wfile, *, mask: bool, sock=None):
+    def __init__(
+        self, rfile, wfile, *, mask: bool, sock=None,
+        max_payload: int = MAX_PAYLOAD,
+    ):
         self._r = rfile
         self._w = wfile
         self._mask_frames = mask
@@ -107,6 +117,14 @@ class WebSocket:
         self._send_lock = threading.Lock()
         self._close_sent = False
         self.closed = False
+        #: Inbound frame-size cap (outbound keeps the module constant —
+        #: what WE send is already bounded by construction).
+        self.max_payload = max_payload
+        #: Keepalive state (:meth:`enable_keepalive`): 0 = off.
+        self._keepalive_seconds = 0.0
+        self._keepalive_misses = 3
+        self._keepalive_budget = 0
+        self._mid_frame = False
 
     # -- send ------------------------------------------------------------------
     def send_text(self, text: str) -> int:
@@ -165,14 +183,71 @@ class WebSocket:
         return n
 
     # -- receive ---------------------------------------------------------------
+    def enable_keepalive(self, seconds: float, misses: int = 3) -> None:
+        """Arm recv-deadline keepalive: :meth:`recv` blocks at most
+        ``seconds`` per read; a timeout at a frame BOUNDARY sends a
+        ping and keeps waiting, and after ``misses`` consecutive
+        silent intervals (no frame of any kind — a live peer's auto-
+        pong answers well inside one) raises :class:`WsTimeout` — the
+        stalled-not-closed peer detected within ``seconds * misses``.
+        A timeout MID-frame raises immediately (a peer that died
+        between a header and its payload is not coming back).  The
+        socket timeout also bounds sends, so a peer that stops READING
+        cannot park a sender forever either."""
+        if seconds <= 0:
+            raise ValueError("keepalive seconds must be positive")
+        if misses < 1:
+            raise ValueError("keepalive misses must be >= 1")
+        self._keepalive_seconds = seconds
+        self._keepalive_misses = misses
+        self._keepalive_budget = misses
+        self.settimeout(seconds)
+
+    def disable_keepalive(self) -> None:
+        """Suspend the keepalive machinery (an explicit
+        ``settimeout`` poll owns the deadline from here); the
+        configuration is remembered — :attr:`keepalive` still reports
+        it, and :meth:`enable_keepalive` re-arms."""
+        self._keepalive_budget = 0
+
+    @property
+    def keepalive(self) -> tuple[float, int] | None:
+        """The configured ``(seconds, misses)``, or None if keepalive
+        was never armed — how a caller that interleaves explicit
+        ``settimeout`` polls re-arms the stream's standing policy."""
+        if self._keepalive_seconds > 0:
+            return (self._keepalive_seconds, self._keepalive_misses)
+        return None
+
     def recv(self) -> tuple[int, bytes]:
         """The next complete MESSAGE as ``(opcode, payload)`` —
         fragments assembled, pings auto-ponged, pongs swallowed.  A
         close frame (or socket EOF) raises :class:`WsClosed` after
-        echoing the close handshake."""
+        echoing the close handshake; a recv deadline past the
+        keepalive budget (:meth:`enable_keepalive`) raises
+        :class:`WsTimeout`."""
         opcode, buf = None, b""
+        silent = 0
         while True:
-            op, fin, payload = self._read_frame()
+            try:
+                op, fin, payload = self._read_frame()
+            except WsTimeout:
+                if not self._keepalive_budget or self._mid_frame:
+                    self.closed = True
+                    raise
+                silent += 1
+                if silent >= self._keepalive_budget:
+                    self.closed = True
+                    raise WsTimeout(
+                        f"keepalive timeout: no frame in "
+                        f"{silent * self._keepalive_seconds:g}s"
+                    ) from None
+                try:
+                    self.ping()
+                except WsClosed:
+                    raise WsTimeout("keepalive ping failed") from None
+                continue
+            silent = 0
             if op == OP_PING:
                 try:
                     self._send(OP_PONG, payload)
@@ -194,34 +269,71 @@ class WebSocket:
                 return opcode, buf
 
     def _read_frame(self) -> tuple[int, bool, bytes]:
+        self._mid_frame = False
         head = self._read_exact(2)
-        fin = bool(head[0] & 0x80)
-        op = head[0] & 0x0F
-        masked = bool(head[1] & 0x80)
-        n = head[1] & 0x7F
-        if n == 126:
-            n = struct.unpack(">H", self._read_exact(2))[0]
-        elif n == 127:
-            n = struct.unpack(">Q", self._read_exact(8))[0]
-        if n > MAX_PAYLOAD:
-            raise WsClosed(f"frame of {n} bytes exceeds MAX_PAYLOAD")
-        key = self._read_exact(4) if masked else None
-        payload = self._read_exact(n)
-        if key is not None:
-            payload = _mask(payload, key)  # in place: payload is ours
-        return op, fin, payload
+        self._mid_frame = True  # header started: a stall now is fatal
+        try:
+            fin = bool(head[0] & 0x80)
+            op = head[0] & 0x0F
+            if head[0] & 0x70:
+                # RSV bits without a negotiated extension (we negotiate
+                # none) are a protocol error, not garbage to forward.
+                raise WsClosed(
+                    f"protocol error: reserved bits set ({head[0]:#04x})"
+                )
+            masked = bool(head[1] & 0x80)
+            n = head[1] & 0x7F
+            if op >= OP_CLOSE and (not fin or n > 125):
+                # RFC 6455 §5.5: control frames must be unfragmented
+                # with payloads <= 125 bytes.
+                raise WsClosed(
+                    f"protocol error: fragmented/oversized control "
+                    f"frame ({op:#x})"
+                )
+            if n == 126:
+                n = struct.unpack(">H", self._read_exact(2))[0]
+            elif n == 127:
+                n = struct.unpack(">Q", self._read_exact(8))[0]
+            if n > self.max_payload:
+                raise WsClosed(
+                    f"frame of {n} bytes exceeds the {self.max_payload}"
+                    f"-byte cap"
+                )
+            key = self._read_exact(4) if masked else None
+            payload = self._read_exact(n)
+            if key is not None:
+                payload = _mask(payload, key)  # in place: payload is ours
+            return op, fin, payload
+        finally:
+            self._mid_frame = False
 
     def _read_exact(self, n: int) -> bytearray:
         """Read exactly ``n`` bytes into ONE preallocated buffer
         (``readinto`` over a memoryview) — the unmask pass then runs in
         place, so a received frame costs a single payload-sized
-        allocation end to end."""
+        allocation end to end.  A socket deadline expiring raises
+        :class:`WsTimeout` WITHOUT poisoning the endpoint (the
+        keepalive path resumes reading); any other failure closes."""
         out = bytearray(n)
         view = memoryview(out)
         got = 0
         while got < n:
             try:
                 k = self._r.readinto(view[got:])
+            except TimeoutError as e:
+                if got:
+                    # A torn read: bytes arrived, then silence — the
+                    # peer died mid-frame; keepalive must not resume
+                    # into a misaligned stream.
+                    self._mid_frame = True
+                # CPython's SocketIO poisons itself after one timeout
+                # (every later read raises "cannot read from timed out
+                # object") — clear the flag so the keepalive path can
+                # actually resume reading after its ping.
+                raw = getattr(self._r, "raw", None)
+                if getattr(raw, "_timeout_occurred", False):
+                    raw._timeout_occurred = False
+                raise WsTimeout(f"read deadline expired: {e}") from e
             except (OSError, ValueError) as e:
                 self.closed = True
                 raise WsClosed(f"read failed: {e}") from e
@@ -278,7 +390,7 @@ class WebSocket:
 
 # -- server side ---------------------------------------------------------------
 
-def server_upgrade(request) -> WebSocket | None:
+def server_upgrade(request, max_payload: int = MAX_PAYLOAD) -> WebSocket | None:
     """Upgrade a live ``BaseHTTPRequestHandler`` request to a WebSocket
     (RFC 6455 §4.2).  Returns the server-side endpoint, or None after
     answering 400 when the request is not a well-formed upgrade.  The
@@ -299,8 +411,19 @@ def server_upgrade(request) -> WebSocket | None:
     request.wfile.write(response.encode())
     request.wfile.flush()
     request.close_connection = True  # the socket is ours until EOF
+    # The HTTP layer's read deadline / slow-loris reaper stops at the
+    # upgrade boundary: a WebSocket leg owns its own deadline/keepalive
+    # policy (enable_keepalive / settimeout) from here on.
+    disarm = getattr(request.rfile, "disarm", None)
+    if disarm is not None:
+        disarm()
+    try:
+        request.connection.settimeout(None)
+    except OSError:
+        pass
     return WebSocket(
-        request.rfile, request.wfile, mask=False, sock=request.connection
+        request.rfile, request.wfile, mask=False,
+        sock=request.connection, max_payload=max_payload,
     )
 
 
@@ -364,6 +487,7 @@ __all__ = [
     "MAX_PAYLOAD",
     "WebSocket",
     "WsClosed",
+    "WsTimeout",
     "accept_key",
     "client_connect",
     "encode_server_frame",

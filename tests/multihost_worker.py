@@ -430,10 +430,12 @@ def frontier_main(coordinator, nprocs, pid, okfile, out_dir):
     multihost.initialize(coordinator, nprocs, pid)
     my_out = os.path.join(out_dir, f"p{pid}")
     os.makedirs(my_out, exist_ok=True)
-    # 1000 turns keeps the 0.3 soup far from settled on this geometry, so
-    # the frontier plan stays engaged across hundreds of adaptive
-    # dispatches — the same chain as 2000 turns at half the suite cost.
-    turns = 1000
+    # 600 turns keeps the 0.3 soup far from settled on this geometry, so
+    # the frontier plan stays engaged across a long adaptive multi-
+    # dispatch chain — the same chain as 2000 turns at a fraction of the
+    # suite cost (the soup needs thousands of turns to settle at this
+    # size, so the frontier never disengages within the run).
+    turns = 600
     params = gol.Params(
         turns=turns,
         image_width=128,

@@ -85,6 +85,18 @@ def test_bench_pilot_record_shape(tmp_path):
         f"measured rep envelope {arm['tolerance']:.1%} "
         f"(on {arm['rates']}, off {arm['scrape_off']['rates']})"
     )
+    # Wire-hardening arm (ISSUE 20): every wire guard on vs off over
+    # fresh-connection /healthz round-trips, interleaved, within the rep
+    # spread — the tier-1 proof that hardening the wire costs the clean
+    # path nothing it can feel.
+    arm = record["wire_overhead"]
+    assert arm["unit"] == "requests/sec"
+    assert arm["hardening_off"]["median"] > 0 and arm["median"] > 0
+    assert arm["within_rep_spread"] is True, (
+        f"wire-hardening overhead {arm['overhead_rel']:.1%} exceeds the "
+        f"measured rep envelope {arm['tolerance']:.1%} "
+        f"(on {arm['rates']}, off {arm['hardening_off']['rates']})"
+    )
     # Time-compression arm (ISSUE 16): the effective-rate row carries the
     # computed side (the stats lint refuses it otherwise — asserted here
     # through the real record), and the ash-dominated pilot board clears
@@ -109,6 +121,34 @@ def test_bench_pilot_record_shape(tmp_path):
     path = tmp_path / "pilot.json"
     path.write_text(json.dumps(record))
     assert bench_gate.main([str(path), str(path), "--quiet"]) == 0
+
+
+def test_committed_netchaos_artifact_pins_wire_verdicts():
+    """The committed ISSUE-20 artifact carries both wire verdicts: the
+    chaos arm observed at least the injected latency (the fault injector
+    actually fired), and the hardened-on/off clean-path overhead landed
+    within the recording rig's rep spread."""
+    from distributed_gol_tpu.obs import metrics as obs_metrics
+
+    record = json.loads((REPO / "BENCH_NETCHAOS_PR20.json").read_text())
+    assert measure.check_headline_stats(record) == []
+    assert obs_metrics.check_embedded_metrics(record) == []
+    assert record["unit"] == "requests/sec"
+    assert record["faults_fired"] > 0
+    assert record["injected_latency_seconds"] > 0
+    # The chaos arm must be at least as slow as the injected delay
+    # accounts for (proxy hop overhead rides on top, so >=).
+    assert (
+        record["observed_added_seconds"]
+        >= record["injected_latency_seconds"] * 0.5
+    )
+    assert record["clean"]["median"] > record["median"]
+    arm = record["wire_overhead"]
+    assert arm["hardening_off"]["median"] > 0
+    assert arm["within_rep_spread"] is True, (
+        f"committed wire-hardening overhead {arm['overhead_rel']:.1%} "
+        f"exceeds its recorded envelope {arm['tolerance']:.1%}"
+    )
 
 
 def test_decompose_pilot_record_shape():
@@ -153,7 +193,7 @@ def test_metrics_overhead_within_rep_spread():
         off_stats = {}
         gps, _ = bench.bench_controller_path(
             256,
-            budget_seconds=2.0,
+            budget_seconds=1.5,
             superstep=256,
             params_overrides=dict(metrics=False, flight_recorder_depth=0),
             out_stats=off_stats,
@@ -161,7 +201,7 @@ def test_metrics_overhead_within_rep_spread():
         off_rates.append(gps)
         on_stats = {}
         gps, _ = bench.bench_controller_path(
-            256, budget_seconds=2.0, superstep=256, out_stats=on_stats
+            256, budget_seconds=1.5, superstep=256, out_stats=on_stats
         )
         on_rates.append(gps)
     off_rates = [r for r in off_rates if r > 0]

@@ -589,12 +589,12 @@ def test_tracing_overhead_within_rep_spread():
     off_rates, on_rates = [], []
     for _ in range(3):
         gps, _ = bench.bench_controller_path(
-            256, budget_seconds=2.0, superstep=256
+            256, budget_seconds=1.5, superstep=256
         )
         if gps > 0:
             off_rates.append(gps)
         gps, _ = bench.bench_controller_path(
-            256, budget_seconds=2.0, superstep=256, trace_request=True
+            256, budget_seconds=1.5, superstep=256, trace_request=True
         )
         if gps > 0:
             on_rates.append(gps)

@@ -1,0 +1,133 @@
+"""Socket-hygiene lint: every socket gets a deadline (ISSUE 20).
+
+The chaos suite (``testing/netchaos.py`` + ``tests/test_netchaos.py``)
+proves what a stalled peer does to an undeadlined socket: a thread
+parked forever.  This lint keeps the fix from rotting — every
+socket-construction site in ``distributed_gol_tpu/`` and ``tools/``
+(``socket.socket``, ``socket.create_connection``,
+``http.client.HTTPConnection``, ``urllib.request.urlopen``) must show
+deadline evidence (a ``timeout=`` argument or a ``settimeout`` call)
+within the next few lines, or sit on the documented allowlist below.
+
+Both directions fail on drift, in the ``check_metric_docs.py`` mold:
+
+- a new construction site with no deadline and no allowlist entry
+  fails (undeadlined sockets cannot ship), and
+- an allowlist entry that no longer matches an undeadlined site fails
+  (the allowlist cannot rot into a list of ghosts).
+
+Runs inside tier-1 (``tests/test_netchaos.py``).
+
+Usage:
+    python tools/check_socket_hygiene.py   # lint the repo, exit 1 on drift
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: Directories scanned (relative to the repo root).  tests/ is not a
+#: wire surface; the package and the operator tools are.
+SCAN_ROOTS = ("distributed_gol_tpu", "tools")
+
+#: Construction sites that open (or wrap) a TCP/UDP socket.
+_SITE = re.compile(
+    r"\bsocket\.socket\(|\bsocket\.create_connection\("
+    r"|\bHTTPConnection\(|\burlopen\("
+)
+
+#: Deadline evidence must appear within this many lines of the
+#: construction (the construction line itself counts) — covers a
+#: ``timeout=`` keyword on a wrapped call and an immediate
+#: ``settimeout`` after construction.
+WINDOW = 6
+
+#: The documented exceptions: ``(relative path, stripped construction
+#: line) -> why no deadline is needed``.  An entry that stops matching
+#: an UNDEADLINED site is stale and fails the lint.
+ALLOWLIST: dict[tuple[str, str], str] = {
+    (
+        "distributed_gol_tpu/parallel/multihost.py",
+        "s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)",
+    ): (
+        "routing lookup only: a UDP connect() resolves the outbound "
+        "interface without sending a packet — no I/O ever blocks"
+    ),
+}
+
+
+def sites(repo: Path | None = None) -> list[tuple[str, int, str, bool]]:
+    """Every construction site as ``(relpath, lineno, stripped line,
+    has_deadline)`` — the lint's raw material, importable by tests."""
+    repo = repo or REPO
+    out = []
+    for root in SCAN_ROOTS:
+        for path in sorted((repo / root).rglob("*.py")):
+            if path.name == "check_socket_hygiene.py":
+                continue  # the allowlist's own literals are not sites
+            lines = path.read_text().splitlines()
+            rel = path.relative_to(repo).as_posix()
+            for i, line in enumerate(lines):
+                if not _SITE.search(line):
+                    continue
+                window = "\n".join(lines[i : i + WINDOW])
+                out.append(
+                    (rel, i + 1, line.strip(), "timeout" in window)
+                )
+    return out
+
+
+def check(repo: Path | None = None) -> list[str]:
+    """Returns the violations (empty = every socket is deadlined or
+    documented)."""
+    repo = repo or REPO
+    found = sites(repo)
+    problems = []
+    matched: set[tuple[str, str]] = set()
+    for rel, lineno, stripped, has_deadline in found:
+        key = (rel, stripped)
+        if has_deadline:
+            continue
+        if key in ALLOWLIST:
+            matched.add(key)
+            continue
+        problems.append(
+            f"undeadlined socket: {rel}:{lineno}: {stripped!r} — pass "
+            "timeout=, call settimeout() within "
+            f"{WINDOW} lines, or add a documented allowlist entry in "
+            "tools/check_socket_hygiene.py"
+        )
+    for key in sorted(ALLOWLIST):
+        if key not in matched:
+            rel, stripped = key
+            problems.append(
+                f"stale allowlist entry: {rel}: {stripped!r} no longer "
+                "matches an undeadlined construction site — remove it "
+                "from tools/check_socket_hygiene.py"
+            )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(p, file=sys.stderr)
+    if problems:
+        print(
+            f"{len(problems)} socket-hygiene violation(s)", file=sys.stderr
+        )
+        return 1
+    found = sites()
+    print(
+        f"socket hygiene clean: {len(found)} construction site(s), "
+        f"{len(ALLOWLIST)} documented exception(s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,7 @@ from distributed_gol_tpu.serve.ws import (  # noqa: E402
     OP_TEXT,
     WebSocket,
     WsClosed,
+    WsTimeout,
     client_connect,
 )
 
@@ -91,6 +92,8 @@ class GolClient:
         timeout: float = 60.0,
         retries: int = 0,
         retry_sleep_cap: float = 5.0,
+        connect_timeout: float | None = None,
+        stream_keepalive: float = 20.0,
     ):
         split = urlsplit(base_url if "//" in base_url else f"//{base_url}")
         self.host = split.hostname or "127.0.0.1"
@@ -98,6 +101,17 @@ class GolClient:
         self.timeout = timeout
         self.retries = max(0, retries)
         self.retry_sleep_cap = retry_sleep_cap
+        # Wire deadlines (ISSUE 20): TCP connect gets its own tighter
+        # budget (a dead address fails fast), and the WebSocket legs
+        # arm a ping/pong keepalive so a stalled-not-closed pod raises
+        # an honest WsTimeout instead of hanging the terminal forever.
+        # stream_keepalive=0 restores the old unbounded reads.
+        self.connect_timeout = (
+            float(connect_timeout)
+            if connect_timeout is not None
+            else min(timeout, 10.0)
+        )
+        self.stream_keepalive = float(stream_keepalive)
 
     # -- REST ------------------------------------------------------------------
     def _request_once(
@@ -107,10 +121,16 @@ class GolClient:
         body: dict | None = None,
         headers: dict | None = None,
     ):
+        # Connect under the (tighter) connect deadline, then widen to
+        # the read budget for the exchange itself.
         conn = http.client.HTTPConnection(
-            self.host, self.port, timeout=self.timeout
+            self.host, self.port,
+            timeout=min(self.connect_timeout, self.timeout),
         )
         try:
+            conn.connect()
+            if conn.sock is not None:
+                conn.sock.settimeout(self.timeout)
             payload = json.dumps(body).encode() if body is not None else None
             send_headers = dict(headers or {})
             if payload:
@@ -192,6 +212,8 @@ class GolClient:
             timeout=self.timeout,
             retries=self.retries,
             retry_sleep_cap=self.retry_sleep_cap,
+            connect_timeout=self.connect_timeout,
+            stream_keepalive=self.stream_keepalive,
         )
 
     def submit(
@@ -290,15 +312,32 @@ class GolClient:
             raise
 
     # -- WebSocket legs --------------------------------------------------------
+    def _attach(self, path: str, recv_buffer: int | None = None) -> WebSocket:
+        """Open one WebSocket leg under the connect deadline, then arm
+        the stream keepalive: events/frames can be arbitrarily sparse
+        (a paused session), so silence is pinged through — only a peer
+        that answers neither frames nor pongs is declared stalled
+        (:class:`WsTimeout`)."""
+        ws = client_connect(
+            self.host,
+            self.port,
+            path,
+            timeout=self.connect_timeout,
+            recv_buffer=recv_buffer,
+        )
+        if self.stream_keepalive > 0:
+            ws.enable_keepalive(self.stream_keepalive)
+        else:
+            ws.settimeout(None)
+        return ws
+
     def controller(self, tenant: str, since: int = 0) -> "ControllerStream":
         """Attach as a controller: live JSON events + control frames.
         Disconnecting is a detach — the run keeps going."""
         path = f"/v1/sessions/{tenant}/events"
         if since:
             path += f"?since={since}"
-        return ControllerStream(
-            client_connect(self.host, self.port, path, timeout=self.timeout)
-        )
+        return ControllerStream(self._attach(path))
 
     def spectate(
         self,
@@ -318,15 +357,7 @@ class GolClient:
             qs.append(f"queue={queue_depth}")
         if qs:
             path += "?" + "&".join(qs)
-        return SpectatorStream(
-            client_connect(
-                self.host,
-                self.port,
-                path,
-                timeout=self.timeout,
-                recv_buffer=recv_buffer,
-            )
-        )
+        return SpectatorStream(self._attach(path, recv_buffer=recv_buffer))
 
     def relay_spectate(
         self,
@@ -341,15 +372,23 @@ class GolClient:
         path = "/v1/frames"
         if queue_depth != 8:
             path += f"?queue={queue_depth}"
-        return SpectatorStream(
-            client_connect(
-                self.host,
-                self.port,
-                path,
-                timeout=self.timeout,
-                recv_buffer=recv_buffer,
-            )
-        )
+        return SpectatorStream(self._attach(path, recv_buffer=recv_buffer))
+
+
+def _arm_deadline(ws: WebSocket, timeout: float | None) -> None:
+    """An explicit per-call ``timeout`` is a bounded poll — the
+    standing keepalive is suspended so the caller gets its deadline
+    verbatim; ``None`` restores the stream's keepalive policy (or an
+    unbounded read when none was armed)."""
+    if timeout is not None:
+        ws.disable_keepalive()
+        ws.settimeout(timeout)
+        return
+    ka = ws.keepalive
+    if ka is not None:
+        ws.enable_keepalive(*ka)
+    else:
+        ws.settimeout(None)
 
 
 class ControllerStream:
@@ -361,7 +400,7 @@ class ControllerStream:
         self.ws = ws
 
     def recv(self, timeout: float | None = None) -> dict:
-        self.ws.settimeout(timeout)
+        _arm_deadline(self.ws, timeout)
         opcode, payload = self.ws.recv()
         if opcode != OP_TEXT:
             raise WsClosed("unexpected binary frame on the controller leg")
@@ -406,7 +445,7 @@ class SpectatorStream:
         self.ended = False
 
     def recv(self, timeout: float | None = None):
-        self.ws.settimeout(timeout)
+        _arm_deadline(self.ws, timeout)
         opcode, payload = self.ws.recv()
         if opcode == OP_TEXT:
             msg = json.loads(payload)
@@ -592,6 +631,19 @@ def main(argv=None) -> int:
         if e.retry_after is not None:
             print(f"retry after {e.retry_after:g}s", file=sys.stderr)
         return 1
+    except WsTimeout as e:
+        print(f"{args.url}: stream stalled ({e})", file=sys.stderr)
+        return 1
+    except TimeoutError:
+        # An honest timeout verdict, not a generic "unreachable": the
+        # pod accepted the connection and then went silent past the
+        # read deadline.
+        print(
+            f"{args.url}: timed out after {client.timeout:g}s "
+            "waiting for a response",
+            file=sys.stderr,
+        )
+        return 1
     except (ConnectionError, OSError) as e:
         print(f"{args.url}: unreachable ({e})", file=sys.stderr)
         return 1
@@ -687,6 +739,9 @@ def _run_verb(client: GolClient, args) -> int:
                     print(json.dumps(msg))
                     if msg.get("type") == "end":
                         return 0
+            except WsTimeout as e:
+                print(f"stream stalled: {e}", file=sys.stderr)
+                return 1
             except (WsClosed, KeyboardInterrupt):
                 return 0
     if args.verb == "watch":
@@ -726,6 +781,9 @@ def _run_verb(client: GolClient, args) -> int:
                     )
                     if args.frames and shown >= args.frames:
                         return 0
+            except WsTimeout as e:
+                print(f"stream stalled: {e}", file=sys.stderr)
+                return 1
             except (WsClosed, KeyboardInterrupt):
                 return 0
     return 2
